@@ -1,0 +1,13 @@
+"""Test harness configuration.
+
+Multi-chip sharding is validated on a virtual 8-device CPU mesh (the driver
+dry-runs the real multi-chip path separately); set the platform before any
+jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

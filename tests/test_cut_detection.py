@@ -1,0 +1,203 @@
+"""Multi-node cut detector watermark semantics, mirroring CutDetectionTest.java.
+
+Uses K=10, H=8, L=2 exactly as the reference tests (CutDetectionTest.java:34-36).
+"""
+
+import random
+import uuid
+
+import pytest
+
+from rapid_tpu.cut_detector import MultiNodeCutDetector
+from rapid_tpu.membership import MembershipView
+from rapid_tpu.types import AlertMessage, EdgeStatus, Endpoint, NodeId
+
+K, H, L = 10, 8, 2
+CONFIG_ID = -1  # does not affect the detector
+
+
+def ep(port: int, host: str = "127.0.0.2") -> Endpoint:
+    return Endpoint.from_parts(host, port)
+
+
+def src(i: int) -> Endpoint:
+    return Endpoint.from_parts("127.0.0.1", i)
+
+
+def alert(src_ep, dst_ep, status, ring) -> AlertMessage:
+    return AlertMessage(
+        edge_src=src_ep,
+        edge_dst=dst_ep,
+        edge_status=status,
+        configuration_id=CONFIG_ID,
+        ring_numbers=(ring,),
+    )
+
+
+def test_invalid_watermarks_rejected():
+    with pytest.raises(ValueError):
+        MultiNodeCutDetector(K, K + 1, L)
+    with pytest.raises(ValueError):
+        MultiNodeCutDetector(K, 3, 4)  # L > H
+    with pytest.raises(ValueError):
+        MultiNodeCutDetector(2, 2, 1)  # K < K_MIN
+    with pytest.raises(ValueError):
+        MultiNodeCutDetector(K, H, 0)
+
+
+def test_proposal_at_hth_report():
+    """CutDetectionTest.java:43-59."""
+    wb = MultiNodeCutDetector(K, H, L)
+    dst = ep(2)
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+        assert wb.num_proposals == 0
+    ret = wb.aggregate_for_proposal(alert(src(H), dst, EdgeStatus.UP, H - 1))
+    assert ret == [dst]
+    assert wb.num_proposals == 1
+
+
+def test_duplicate_reports_ignored():
+    """Same (dst, ring) reported twice counts once (MultiNodeCutDetector.java:97-101)."""
+    wb = MultiNodeCutDetector(K, H, L)
+    dst = ep(2)
+    for _ in range(H):
+        wb.aggregate_for_proposal(alert(src(1), dst, EdgeStatus.UP, 0))
+    assert wb.num_proposals == 0
+
+
+def test_blocking_one_blocker():
+    """CutDetectionTest.java:62-91: a node in (L, H) blocks another's proposal."""
+    wb = MultiNodeCutDetector(K, H, L)
+    dst1, dst2 = ep(2), ep(2, "127.0.0.3")
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(src(i + 1), dst1, EdgeStatus.UP, i)) == []
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(src(i + 1), dst2, EdgeStatus.UP, i)) == []
+    assert wb.aggregate_for_proposal(alert(src(H), dst1, EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    ret = wb.aggregate_for_proposal(alert(src(H), dst2, EdgeStatus.UP, H - 1))
+    assert sorted(map(str, ret)) == sorted(map(str, [dst1, dst2]))
+    assert wb.num_proposals == 1
+
+
+def test_blocking_three_blockers():
+    """CutDetectionTest.java:96-137."""
+    wb = MultiNodeCutDetector(K, H, L)
+    dsts = [ep(2, f"127.0.0.{i}") for i in (2, 3, 4)]
+    for dst in dsts:
+        for i in range(H - 1):
+            assert wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    assert wb.aggregate_for_proposal(alert(src(H), dsts[0], EdgeStatus.UP, H - 1)) == []
+    assert wb.aggregate_for_proposal(alert(src(H), dsts[2], EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    ret = wb.aggregate_for_proposal(alert(src(H), dsts[1], EdgeStatus.UP, H - 1))
+    assert len(ret) == 3
+    assert wb.num_proposals == 1
+
+
+def test_multiple_blockers_past_h_no_double_fire():
+    """CutDetectionTest.java:140-189: reports past H don't re-trigger."""
+    wb = MultiNodeCutDetector(K, H, L)
+    dsts = [ep(2, f"127.0.0.{i}") for i in (2, 3, 4)]
+    for dst in dsts:
+        for i in range(H - 1):
+            wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i))
+    wb.aggregate_for_proposal(alert(src(H), dsts[0], EdgeStatus.UP, H - 1))
+    # duplicate announcements for the same ring are ignored
+    assert wb.aggregate_for_proposal(alert(src(H + 1), dsts[0], EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    wb.aggregate_for_proposal(alert(src(H), dsts[2], EdgeStatus.UP, H - 1))
+    assert wb.aggregate_for_proposal(alert(src(H + 1), dsts[2], EdgeStatus.UP, H - 1)) == []
+    assert wb.num_proposals == 0
+    ret = wb.aggregate_for_proposal(alert(src(H), dsts[1], EdgeStatus.UP, H - 1))
+    assert len(ret) == 3
+    assert wb.num_proposals == 1
+
+
+def test_below_l_does_not_block():
+    """CutDetectionTest.java:192-230: a node with < L reports doesn't block."""
+    wb = MultiNodeCutDetector(K, H, L)
+    dst1, dst2, dst3 = (ep(2, f"127.0.0.{i}") for i in (2, 3, 4))
+    for i in range(H - 1):
+        wb.aggregate_for_proposal(alert(src(i + 1), dst1, EdgeStatus.UP, i))
+    for i in range(L - 1):
+        wb.aggregate_for_proposal(alert(src(i + 1), dst2, EdgeStatus.UP, i))
+    for i in range(H - 1):
+        wb.aggregate_for_proposal(alert(src(i + 1), dst3, EdgeStatus.UP, i))
+    assert wb.aggregate_for_proposal(alert(src(H), dst1, EdgeStatus.UP, H - 1)) == []
+    ret = wb.aggregate_for_proposal(alert(src(H), dst3, EdgeStatus.UP, H - 1))
+    assert len(ret) == 2
+    assert wb.num_proposals == 1
+
+
+def test_batch():
+    """CutDetectionTest.java:234-252."""
+    wb = MultiNodeCutDetector(K, H, L)
+    endpoints = [ep(2 + i) for i in range(3)]
+    proposal = []
+    for endpoint in endpoints:
+        for ring in range(K):
+            proposal.extend(
+                wb.aggregate_for_proposal(alert(src(1), endpoint, EdgeStatus.UP, ring))
+            )
+    assert len(proposal) == len(endpoints)
+
+
+def test_link_invalidation():
+    """CutDetectionTest.java:255-301: implicit detection of edges between
+    failing nodes unblocks the cut; the expected cut has 4 nodes."""
+    rng = random.Random(11)
+    view = MembershipView(K)
+    num_nodes = 30
+    endpoints = []
+    for i in range(num_nodes):
+        node = ep(2 + i)
+        endpoints.append(node)
+        view.ring_add(node, NodeId.from_uuid(uuid.UUID(int=rng.getrandbits(128))))
+
+    wb = MultiNodeCutDetector(K, H, L)
+    dst = endpoints[0]
+    observers = view.get_observers_of(dst)
+    assert len(observers) == K
+
+    # alerts from observers[0 .. H-1) about dst
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(observers[i], dst, EdgeStatus.DOWN, i)) == []
+        assert wb.num_proposals == 0
+
+    # alerts *about* observers[H-1 .. K) of dst
+    failed_observers = set()
+    for i in range(H - 1, K):
+        observers_of_observer = view.get_observers_of(observers[i])
+        failed_observers.add(observers[i])
+        for j in range(K):
+            assert (
+                wb.aggregate_for_proposal(
+                    alert(observers_of_observer[j], observers[i], EdgeStatus.DOWN, j)
+                )
+                == []
+            )
+            assert wb.num_proposals == 0
+
+    # dst sits at H-1 reports; link invalidation brings everything stable
+    ret = wb.invalidate_failing_edges(view)
+    assert len(ret) == 4
+    assert wb.num_proposals == 1
+    for node in ret:
+        assert node in failed_observers or node == dst
+
+
+def test_clear_resets_state():
+    wb = MultiNodeCutDetector(K, H, L)
+    dst = ep(2)
+    for i in range(H):
+        wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i))
+    assert wb.num_proposals == 1
+    wb.clear()
+    assert wb.num_proposals == 0
+    # detector accepts the same reports again after clear
+    for i in range(H - 1):
+        assert wb.aggregate_for_proposal(alert(src(i + 1), dst, EdgeStatus.UP, i)) == []
+    ret = wb.aggregate_for_proposal(alert(src(H), dst, EdgeStatus.UP, H - 1))
+    assert ret == [dst]

@@ -1,0 +1,92 @@
+"""xxHash64 bit-exactness and batch/scalar equivalence.
+
+The ring order and configuration IDs must match the JVM reference
+(zero-allocation-hashing LongHashFunction.xx, Utils.java:211-230), which is
+canonical XXH64 over little-endian primitive bytes -- so matching the public
+XXH64 vectors is matching the JVM.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from rapid_tpu.hashing import (
+    configuration_id,
+    endpoint_hash,
+    endpoint_hash_batch,
+    pack_hostnames,
+    to_signed,
+    xxh64,
+    xxh64_batch,
+    xxh64_int,
+    xxh64_long,
+)
+
+# Published XXH64 test vectors (xxHash reference implementation).
+KNOWN_VECTORS = [
+    (b"", 0, 0xEF46DB3751D8E999),
+    (b"a", 0, 0xD24EC4F1A98C6E5B),
+    (b"abc", 0, 0x44BC2CF5AD770999),
+    (b"Nobody inspects the spammish repetition", 0, 0xFBCEA83C8A378BF1),
+]
+
+
+@pytest.mark.parametrize("data,seed,expected", KNOWN_VECTORS)
+def test_known_vectors(data, seed, expected):
+    assert xxh64(data, seed) == expected
+
+
+def test_scalar_batch_equivalence():
+    rng = random.Random(7)
+    samples = [bytes(rng.randrange(256) for _ in range(rng.randrange(0, 150))) for _ in range(500)]
+    data, lengths = pack_hostnames(samples)
+    for seed in (0, 1, 9, 2**31 - 1, 123456789):
+        batch = xxh64_batch(data, lengths, seed)
+        scalar = np.array([xxh64(s, seed) for s in samples], dtype=np.uint64)
+        assert np.array_equal(batch, scalar)
+
+
+def test_length_boundaries():
+    """Every code path boundary: 0,1,3,4,7,8,11,12,15,16,31,32,33,63,64,65 bytes."""
+    for n in (0, 1, 3, 4, 7, 8, 11, 12, 15, 16, 31, 32, 33, 63, 64, 65, 100):
+        data = bytes(range(256))[:n] if n <= 256 else None
+        payload = (data * 3)[:n] if data is not None else b""
+        d, l = pack_hostnames([payload])
+        assert int(xxh64_batch(d, l, 5)[0]) == xxh64(payload, 5)
+
+
+def test_int_long_hashing():
+    # hashInt == hash of the 4 LE bytes, hashLong == hash of the 8 LE bytes
+    assert xxh64_int(1234, 3) == xxh64((1234).to_bytes(4, "little"), 3)
+    assert xxh64_long(-1, 0) == xxh64(b"\xff" * 8, 0)
+    assert xxh64_long(2**63 - 1, 0) == xxh64((2**63 - 1).to_bytes(8, "little"), 0)
+
+
+def test_endpoint_hash_batch_matches_scalar():
+    hosts = [f"host-{i}.example.com".encode() for i in range(200)]
+    ports = np.arange(200) + 2000
+    d, l = pack_hostnames(hosts)
+    for seed in range(10):
+        batch = endpoint_hash_batch(d, l, ports, seed)
+        scalar = np.array(
+            [endpoint_hash(h, int(p), seed) for h, p in zip(hosts, ports)],
+            dtype=np.uint64,
+        )
+        assert np.array_equal(batch, scalar)
+
+
+def test_to_signed():
+    assert to_signed(0) == 0
+    assert to_signed(2**63) == -(2**63)
+    assert to_signed(2**64 - 1) == -1
+    assert to_signed(2**63 - 1) == 2**63 - 1
+
+
+def test_configuration_id_order_sensitivity():
+    ids = [(1, 2), (3, 4)]
+    eps = [(b"127.0.0.1", 1), (b"127.0.0.1", 2)]
+    a = configuration_id(ids, eps)
+    b = configuration_id(ids, list(reversed(eps)))
+    assert a != b  # chained hash is order sensitive (MembershipView.java:535-547)
+    assert a == configuration_id(ids, eps)  # and deterministic

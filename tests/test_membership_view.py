@@ -1,0 +1,210 @@
+"""MembershipView ring semantics, mirroring MembershipViewTest.java (499 LoC).
+
+Scenarios: ring add/delete/duplicates, observer/subject cardinality, bootstrap
+expected-observers, UUID-reuse rejection, configuration-ID uniqueness across
+many adds, and order-independence of the final configuration.
+"""
+
+import random
+import uuid
+
+import pytest
+
+from rapid_tpu.membership import (
+    MembershipView,
+    NodeAlreadyInRingError,
+    NodeNotInRingError,
+    UUIDAlreadySeenError,
+)
+from rapid_tpu.types import Endpoint, JoinStatusCode, NodeId
+
+K = 10
+
+
+def ep(i: int, host: str = "127.0.0.1") -> Endpoint:
+    return Endpoint.from_parts(host, i)
+
+
+def nid(rng: random.Random) -> NodeId:
+    return NodeId.from_uuid(uuid.UUID(int=rng.getrandbits(128)))
+
+
+def test_one_ring_add():
+    rng = random.Random(0)
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(rng))
+    assert view.membership_size == 1
+    for k in range(K):
+        assert len(view.get_ring(k)) == 1
+
+
+def test_multiple_ring_additions():
+    rng = random.Random(0)
+    view = MembershipView(K)
+    for i in range(10):
+        view.ring_add(ep(i), nid(rng))
+    assert view.membership_size == 10
+    for k in range(K):
+        assert len(view.get_ring(k)) == 10
+
+
+def test_ring_readditions_throw():
+    rng = random.Random(0)
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(rng))
+    with pytest.raises(NodeAlreadyInRingError):
+        view.ring_add(ep(1), nid(rng))
+
+
+def test_delete_absent_node_throws():
+    view = MembershipView(K)
+    with pytest.raises(NodeNotInRingError):
+        view.ring_delete(ep(1))
+
+
+def test_ring_delete():
+    rng = random.Random(0)
+    view = MembershipView(K)
+    for i in range(10):
+        view.ring_add(ep(i), nid(rng))
+    view.ring_delete(ep(5))
+    assert view.membership_size == 9
+    assert not view.is_host_present(ep(5))
+
+
+def test_uuid_reuse_rejected():
+    """MembershipViewTest.java:351-434 -- an identifier can be used once, ever."""
+    rng = random.Random(0)
+    view = MembershipView(K)
+    identifier = nid(rng)
+    view.ring_add(ep(1), identifier)
+    with pytest.raises(UUIDAlreadySeenError):
+        view.ring_add(ep(2), identifier)
+    # even after deleting the original node
+    view.ring_add(ep(3), nid(rng))
+    view.ring_delete(ep(1))
+    with pytest.raises(UUIDAlreadySeenError):
+        view.ring_add(ep(4), identifier)
+    assert view.is_safe_to_join(ep(4), identifier) == JoinStatusCode.UUID_ALREADY_IN_RING
+
+
+def test_is_safe_to_join():
+    rng = random.Random(0)
+    view = MembershipView(K)
+    identifier = nid(rng)
+    view.ring_add(ep(1), identifier)
+    assert view.is_safe_to_join(ep(1), nid(rng)) == JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+    assert view.is_safe_to_join(ep(2), identifier) == JoinStatusCode.UUID_ALREADY_IN_RING
+    assert view.is_safe_to_join(ep(2), nid(rng)) == JoinStatusCode.SAFE_TO_JOIN
+
+
+def test_observer_subject_cardinality():
+    """At N >= K+1, every node has exactly K observers and K subjects
+    (MembershipViewTest.java:268-293)."""
+    rng = random.Random(1)
+    view = MembershipView(K)
+    n = K + 1
+    for i in range(n):
+        view.ring_add(ep(i), nid(rng))
+    for i in range(n):
+        assert len(view.get_observers_of(ep(i))) == K
+        assert len(view.get_subjects_of(ep(i))) == K
+
+
+def test_observers_are_ring_successors():
+    """Observer on ring k is the successor on ring k; subject the predecessor."""
+    rng = random.Random(2)
+    view = MembershipView(K)
+    n = 50
+    for i in range(n):
+        view.ring_add(ep(i), nid(rng))
+    node = ep(7)
+    observers = view.get_observers_of(node)
+    subjects = view.get_subjects_of(node)
+    for k in range(K):
+        ring = view.get_ring(k)
+        idx = ring.index(node)
+        assert observers[k] == ring[(idx + 1) % n]
+        assert subjects[k] == ring[(idx - 1) % n]
+    # observer/subject duality: if s is subject of o on ring k, o observes s
+    for k, s in enumerate(subjects):
+        assert k in view.get_ring_numbers(node, s)
+
+
+def test_expected_observers_of_absent_node():
+    """Bootstrap gatekeepers for a joiner (MembershipViewTest.java:299-344)."""
+    rng = random.Random(3)
+    view = MembershipView(K)
+    n = 20
+    for i in range(n):
+        view.ring_add(ep(i), nid(rng))
+    joiner = ep(2000)
+    expected = view.get_expected_observers_of(joiner)
+    assert len(expected) == K
+    # Reference quirk preserved: expected observers are the joiner's ring
+    # *predecessors* (MembershipView.java:293-304 calls getPredecessorsOf),
+    # which equal its post-join subjects -- while getObserversOf returns
+    # successors. Insertion does not change which members precede the joiner.
+    view.ring_add(joiner, nid(rng))
+    assert view.get_subjects_of(joiner) == expected
+
+
+def test_single_node_has_no_observers():
+    rng = random.Random(4)
+    view = MembershipView(K)
+    view.ring_add(ep(1), nid(rng))
+    assert view.get_observers_of(ep(1)) == []
+    assert view.get_subjects_of(ep(1)) == []
+
+
+def test_configuration_id_changes_on_every_add():
+    """MembershipViewTest.java:442-455 (1000 adds, all IDs unique)."""
+    rng = random.Random(5)
+    view = MembershipView(K)
+    seen = set()
+    for i in range(1000):
+        view.ring_add(ep(i), nid(rng))
+        cid = view.get_current_configuration_id()
+        assert cid not in seen
+        seen.add(cid)
+
+
+def test_configuration_order_independence():
+    """Two views fed the same nodes in different orders converge to the same
+    configuration ID (MembershipViewTest.java:464-499)."""
+    rng = random.Random(6)
+    nodes = [(ep(i), nid(rng)) for i in range(50)]
+    v1 = MembershipView(K)
+    v2 = MembershipView(K)
+    for node, identifier in nodes:
+        v1.ring_add(node, identifier)
+    shuffled = nodes[:]
+    random.Random(7).shuffle(shuffled)
+    for node, identifier in shuffled:
+        v2.ring_add(node, identifier)
+    assert v1.get_current_configuration_id() == v2.get_current_configuration_id()
+    assert v1.get_ring(0) == v2.get_ring(0)
+
+
+def test_bootstrap_from_configuration():
+    """A view rebuilt from a Configuration snapshot is identical
+    (MembershipView.java:74-90, used by joiners, Cluster.java:442-474)."""
+    rng = random.Random(8)
+    view = MembershipView(K)
+    for i in range(30):
+        view.ring_add(ep(i), nid(rng))
+    config = view.get_configuration()
+    rebuilt = MembershipView(K, node_ids=config.node_ids, endpoints=config.endpoints)
+    assert rebuilt.get_current_configuration_id() == view.get_current_configuration_id()
+    for k in range(K):
+        assert rebuilt.get_ring(k) == view.get_ring(k)
+
+
+def test_ring_order_is_seed_dependent():
+    """The K rings are distinct pseudo-random permutations."""
+    rng = random.Random(9)
+    view = MembershipView(K)
+    for i in range(100):
+        view.ring_add(ep(i), nid(rng))
+    distinct = {tuple(view.get_ring(k)) for k in range(K)}
+    assert len(distinct) == K

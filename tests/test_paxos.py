@@ -1,0 +1,273 @@
+"""Consensus unit tests, mirroring PaxosTests.java and
+FastPaxosWithoutFallbackTests.java quorum arithmetic.
+"""
+
+import random
+
+import pytest
+
+from rapid_tpu.fast_paxos import FastPaxos
+from rapid_tpu.messaging.base import IBroadcaster, IMessagingClient
+from rapid_tpu.paxos import Paxos
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.runtime.scheduler import VirtualScheduler
+from rapid_tpu.types import (
+    Endpoint,
+    FastRoundPhase2bMessage,
+    Phase1bMessage,
+    Rank,
+)
+
+
+class NoOpClient(IMessagingClient):
+    def send_message(self, remote, msg):
+        return Promise.completed(None)
+
+    def send_message_best_effort(self, remote, msg):
+        return Promise.completed(None)
+
+    def shutdown(self):
+        pass
+
+
+class NoOpBroadcaster(IBroadcaster):
+    def broadcast(self, msg):
+        return []
+
+    def set_membership(self, recipients):
+        pass
+
+
+def hosts(*specs):
+    return tuple(Endpoint.from_string(s) for s in specs)
+
+
+P1 = hosts("127.0.0.1:5891", "127.0.0.1:5821")
+P2 = hosts("127.0.0.1:5821", "127.0.0.1:5872")
+NOISE = hosts("127.0.0.1:1", "127.0.0.1:2")
+
+ADDR = Endpoint.from_parts("127.0.0.1", 1234)
+
+
+def make_paxos(n):
+    return Paxos(ADDR, 1, n, NoOpClient(), NoOpBroadcaster(), lambda v: None)
+
+
+def p1b(vrnd: Rank, vval) -> Phase1bMessage:
+    return Phase1bMessage(sender=ADDR, configuration_id=1, rnd=vrnd, vrnd=vrnd, vval=vval)
+
+
+# (N, p1_votes_at_highest_rank, p2_votes_at_lower_rank, proposals, valid choice indexes)
+# Mirrors PaxosTests.coordinatorRuleTests (PaxosTests.java:252-286).
+COORDINATOR_CASES = [
+    (6, 4, 2, (P1, P2, NOISE), {0}),
+    (6, 5, 1, (P1, P2, NOISE), {0}),
+    (6, 6, 0, (P1, P2, NOISE), {0}),
+    (9, 6, 3, (P1, P2, NOISE), {0, 1}),
+    (9, 7, 2, (P1, P2, NOISE), {0}),
+    (9, 8, 1, (P1, P2, NOISE), {0}),
+    (6, 1, 5, (P1, P2, NOISE), {0, 1}),
+    (6, 2, 4, (P1, P2, NOISE), {0, 1}),
+    (6, 3, 3, (P1, P2, NOISE), {0}),
+    (6, 3, 3, (P2, P1, NOISE), {0}),
+    (6, 4, 1, (P1, P2, NOISE), {0}),
+]
+
+
+@pytest.mark.parametrize("n,p1n,p2n,proposals,valid", COORDINATOR_CASES)
+def test_coordinator_rule(n, p1n, p2n, proposals, valid):
+    """Highest-vrnd votes dominate; >N/4 identical wins; 100 shuffled quorums."""
+    valid_values = {proposals[i] for i in valid}
+    rng = random.Random(hash((n, p1n, p2n)) & 0xFFFF)
+    for _ in range(100):
+        paxos = make_paxos(n)
+        messages = []
+        for _ in range(p1n):
+            messages.append(p1b(Rank(1, 1), proposals[0]))
+        for _ in range(p2n):
+            messages.append(p1b(Rank(0, 2**31 - 1), proposals[1]))
+        for i in range(p1n + p2n, n):
+            messages.append(p1b(Rank(0, i), NOISE))
+        rng.shuffle(messages)
+        quorum = messages[: (n // 2) + 1]
+        chosen = paxos.select_proposal_using_coordinator_rule(quorum)
+        assert chosen in valid_values, f"chose {chosen}"
+
+
+# Classic-round cases (PaxosTests.java:180-188): all votes at the same rank,
+# p2 gets `p2votes` and p1 the rest; quorum = all N.
+CLASSIC_CASES = [
+    (6, 5, {P2}),
+    (6, 1, {P1}),
+    (6, 4, {P1, P2}),
+    (6, 2, {P1, P2}),
+    (5, 4, {P2}),
+    (5, 1, {P1}),
+    (10, 4, {P1, P2}),
+    (10, 1, {P1, P2}),
+]
+
+
+@pytest.mark.parametrize("n,p2votes,valid", CLASSIC_CASES)
+def test_coordinator_rule_same_rank(n, p2votes, valid):
+    """Same vrnd for all: single distinct value or >N/4 identical decides;
+    otherwise any reported value may be picked."""
+    rng = random.Random(n * 100 + p2votes)
+    for _ in range(100):
+        paxos = make_paxos(n)
+        messages = [p1b(Rank(1, 1), P2) for _ in range(p2votes)]
+        messages += [p1b(Rank(1, 1), P1) for _ in range(n - p2votes)]
+        rng.shuffle(messages)
+        chosen = paxos.select_proposal_using_coordinator_rule(messages)
+        assert chosen in valid
+
+
+def test_empty_phase1b_raises():
+    with pytest.raises(ValueError):
+        make_paxos(5).select_proposal_using_coordinator_rule([])
+
+
+def test_all_empty_vvals_choose_nothing():
+    """Quorum of acceptors that never voted => empty choice, coordinator waits
+    (Paxos.java:308-325)."""
+    paxos = make_paxos(5)
+    msgs = [p1b(Rank(0, i), ()) for i in range(3)]
+    assert paxos.select_proposal_using_coordinator_rule(msgs) == ()
+
+
+# ---------------------------------------------------------------------------
+# Fast-round quorum arithmetic (FastPaxosWithoutFallbackTests.java:85-90)
+# ---------------------------------------------------------------------------
+
+QUORUM_TABLE = {
+    5: 4,
+    6: 5,
+    48: 37,
+    49: 37,
+    50: 38,
+    51: 39,
+    99: 75,
+    100: 76,
+    101: 76,
+    102: 77,
+}
+
+
+def voter(i: int) -> Endpoint:
+    return Endpoint.from_parts("127.0.0.1", 10_000 + i)
+
+
+def fast_vote(i: int, proposal) -> FastRoundPhase2bMessage:
+    return FastRoundPhase2bMessage(sender=voter(i), configuration_id=7, endpoints=proposal)
+
+
+def make_fast_paxos(n, on_decide):
+    return FastPaxos(
+        ADDR, 7, n, NoOpClient(), NoOpBroadcaster(), VirtualScheduler(), on_decide,
+        rng=random.Random(0),
+    )
+
+
+@pytest.mark.parametrize("n,quorum", sorted(QUORUM_TABLE.items()))
+def test_fast_round_exact_quorum(n, quorum):
+    """Decision exactly at N - floor((N-1)/4) identical votes, not before."""
+    proposal = hosts("127.0.0.9:1")
+    decided = []
+    fp = make_fast_paxos(n, decided.append)
+    for i in range(quorum - 1):
+        fp.handle_messages(fast_vote(i, proposal))
+        assert not decided
+    fp.handle_messages(fast_vote(quorum - 1, proposal))
+    assert decided == [list(proposal)]
+
+
+@pytest.mark.parametrize("n,quorum", sorted(QUORUM_TABLE.items()))
+def test_fast_round_with_f_conflicts(n, quorum):
+    """F conflicting votes still allow a decision; F+1 conflicts block it
+    (FastPaxosWithoutFallbackTests.java:131-150)."""
+    f = n - quorum
+    proposal = hosts("127.0.0.9:1")
+    conflict = hosts("127.0.0.9:2")
+    decided = []
+    fp = make_fast_paxos(n, decided.append)
+    for i in range(f):
+        fp.handle_messages(fast_vote(i, conflict))
+    for i in range(f, n):
+        fp.handle_messages(fast_vote(i, proposal))
+    assert decided == [list(proposal)]
+
+    decided2 = []
+    fp2 = make_fast_paxos(n, decided2.append)
+    for i in range(f + 1):
+        fp2.handle_messages(fast_vote(i, conflict))
+    for i in range(f + 1, n):
+        fp2.handle_messages(fast_vote(i, proposal))
+    assert decided2 == []
+
+
+def test_fast_round_duplicate_votes_ignored():
+    proposal = hosts("127.0.0.9:1")
+    decided = []
+    fp = make_fast_paxos(6, decided.append)
+    for _ in range(10):
+        fp.handle_messages(fast_vote(0, proposal))
+    assert not decided
+
+
+def test_fast_round_config_mismatch_ignored():
+    proposal = hosts("127.0.0.9:1")
+    decided = []
+    fp = make_fast_paxos(5, decided.append)
+    for i in range(5):
+        fp.handle_messages(
+            FastRoundPhase2bMessage(sender=voter(i), configuration_id=99, endpoints=proposal)
+        )
+    assert not decided
+
+
+def test_classic_fallback_end_to_end():
+    """Wire N Paxos instances directly; one coordinator runs phase1a..2b and
+    every node decides the same value."""
+    n = 5
+    addrs = [Endpoint.from_parts("127.0.0.1", 4000 + i) for i in range(n)]
+    decisions = {}
+    nodes = {}
+
+    class Net(IMessagingClient, IBroadcaster):
+        def send_message(self, remote, msg):
+            nodes[remote].__getattribute__(HANDLERS[type(msg).__name__])(msg)
+            return Promise.completed(None)
+
+        send_message_best_effort = send_message
+
+        def shutdown(self):
+            pass
+
+        def broadcast(self, msg):
+            for node in list(nodes.values()):
+                node.__getattribute__(HANDLERS[type(msg).__name__])(msg)
+            return []
+
+        def set_membership(self, recipients):
+            pass
+
+    HANDLERS = {
+        "Phase1aMessage": "handle_phase1a",
+        "Phase1bMessage": "handle_phase1b",
+        "Phase2aMessage": "handle_phase2a",
+        "Phase2bMessage": "handle_phase2b",
+    }
+    net = Net()
+    for addr in addrs:
+        nodes[addr] = Paxos(
+            addr, 1, n, net, net,
+            lambda v, a=addr: decisions.setdefault(a, tuple(v)),
+        )
+    # nobody voted in a fast round; coordinator proposes after a quorum of
+    # empty phase1bs, so seed one node with a fast-round vote first
+    value = hosts("10.0.0.1:1", "10.0.0.2:2")
+    for node in nodes.values():
+        node.register_fast_round_vote(value)
+    nodes[addrs[0]].start_phase1a(2)
+    assert len(decisions) == n
+    assert set(decisions.values()) == {value}
