@@ -1,0 +1,46 @@
+"""rapid-tpu: a TPU-native framework with the capabilities of Rapid, the
+scalable distributed membership service (USENIX ATC'18).
+
+Two execution planes:
+- the *protocol plane* (this package root): a full Rapid-equivalent membership
+  stack -- Cluster API, membership service, K-ring views, cut detection, Fast
+  Paxos -- running over pluggable messaging and failure-detector seams;
+- the *simulation plane* (``rapid_tpu.sim`` / ``rapid_tpu.shard``): the same
+  protocol vectorized as jitted JAX array programs, hosting up to 100k virtual
+  nodes in TPU HBM and sharded over device meshes.
+"""
+
+from .cluster import Cluster, ClusterBuilder, JoinException, K, H, L
+from .events import ClusterEvents, NodeStatusChange
+from .membership import Configuration, MembershipView
+from .cut_detector import MultiNodeCutDetector
+from .settings import Settings
+from .types import (
+    EdgeStatus,
+    Endpoint,
+    JoinStatusCode,
+    NodeId,
+    NodeStatus,
+)
+
+__all__ = [
+    "Cluster",
+    "ClusterBuilder",
+    "ClusterEvents",
+    "Configuration",
+    "EdgeStatus",
+    "Endpoint",
+    "JoinException",
+    "JoinStatusCode",
+    "MembershipView",
+    "MultiNodeCutDetector",
+    "NodeId",
+    "NodeStatus",
+    "NodeStatusChange",
+    "Settings",
+    "K",
+    "H",
+    "L",
+]
+
+__version__ = "0.1.0"

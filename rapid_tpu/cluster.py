@@ -1,0 +1,338 @@
+"""The public API: build, start, or join a cluster node.
+
+Reference: Cluster.java. ``Cluster.Builder(addr).start()`` bootstraps a seed;
+``.join(seed)`` runs the two-phase join protocol with up to RETRIES attempts
+(Cluster.java:303-344): phase 1 asks a seed for the configuration and the K
+expected observers; phase 2 asks those observers to vouch for the joiner, and
+the response arrives only after the resulting view change commits.
+
+Protocol constants K=10, H=9, L=4, RETRIES=5 (Cluster.java:72-75).
+
+The join client is a callback state machine (``join_async``) so the same code
+drives both the real-time scheduler and the deterministic virtual-time one;
+``join`` is the blocking wrapper for real-time mode.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .cut_detector import MultiNodeCutDetector
+from .events import ClusterEvents, NodeStatusChange
+from .membership import MembershipView
+from .messaging.base import IMessagingClient, IMessagingServer
+from .metadata import FrozenMetadata
+from .monitoring.base import IEdgeFailureDetectorFactory
+from .monitoring.pingpong import PingPongFailureDetectorFactory
+from .runtime.futures import Promise, successful_as_list
+from .runtime.resources import SharedResources
+from .runtime.scheduler import Scheduler
+from .service import MembershipService, SubscriptionCallback
+from .settings import Settings
+from .types import (
+    Endpoint,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    NodeId,
+    PreJoinMessage,
+)
+
+K = 10
+H = 9
+L = 4
+RETRIES = 5
+
+
+class JoinException(RuntimeError):
+    pass
+
+
+class Cluster:
+    def __init__(
+        self,
+        server: IMessagingServer,
+        membership_service: MembershipService,
+        resources: SharedResources,
+        listen_address: Endpoint,
+    ) -> None:
+        self._server = server
+        self._membership_service = membership_service
+        self._resources = resources
+        self._listen_address = listen_address
+        self._has_shutdown = False
+
+    @property
+    def listen_address(self) -> Endpoint:
+        return self._listen_address
+
+    def get_memberlist(self) -> List[Endpoint]:
+        self._check_running()
+        return self._membership_service.get_membership_view()
+
+    def get_membership_size(self) -> int:
+        self._check_running()
+        return self._membership_service.membership_size
+
+    def get_cluster_metadata(self) -> Dict[Endpoint, FrozenMetadata]:
+        self._check_running()
+        return self._membership_service.get_metadata()
+
+    def get_current_configuration_id(self) -> int:
+        self._check_running()
+        return self._membership_service.get_current_configuration_id()
+
+    def register_subscription(
+        self, event: ClusterEvents, callback: SubscriptionCallback
+    ) -> None:
+        self._membership_service.register_subscription(event, callback)
+
+    def leave_gracefully_async(self) -> Promise:
+        """Inform observers of the intent to leave, then shut down
+        (Cluster.java:145-149)."""
+        done: Promise = Promise()
+
+        def after_leave(_p: Promise) -> None:
+            self.shutdown()
+            done.set_result(None)
+
+        self._membership_service.leave_async().add_callback(after_leave)
+        return done
+
+    def leave_gracefully(self, timeout: float = 10.0) -> None:
+        self.leave_gracefully_async().result(timeout)
+
+    def shutdown(self) -> None:
+        if self._has_shutdown:
+            return
+        self._server.shutdown()
+        self._membership_service.shutdown()
+        self._resources.shutdown()
+        self._has_shutdown = True
+
+    def _check_running(self) -> None:
+        if self._has_shutdown:
+            raise RuntimeError("cluster instance has been shut down")
+
+    def __str__(self) -> str:
+        return f"Cluster:{self._listen_address}"
+
+
+class ClusterBuilder:
+    """Cluster.Builder (Cluster.java:162-248)."""
+
+    def __init__(self, listen_address: Endpoint) -> None:
+        self._listen_address = listen_address
+        self._metadata: FrozenMetadata = ()
+        self._settings = Settings()
+        self._fd_factory: Optional[IEdgeFailureDetectorFactory] = None
+        self._subscriptions: Dict[ClusterEvents, List[SubscriptionCallback]] = {}
+        self._client: Optional[IMessagingClient] = None
+        self._server: Optional[IMessagingServer] = None
+        self._scheduler: Optional[Scheduler] = None
+        self._rng: Optional[random.Random] = None
+
+    def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
+        self._metadata = tuple(sorted(metadata.items()))
+        return self
+
+    def set_edge_failure_detector_factory(
+        self, factory: IEdgeFailureDetectorFactory
+    ) -> "ClusterBuilder":
+        self._fd_factory = factory
+        return self
+
+    def add_subscription(
+        self, event: ClusterEvents, callback: SubscriptionCallback
+    ) -> "ClusterBuilder":
+        self._subscriptions.setdefault(event, []).append(callback)
+        return self
+
+    def use_settings(self, settings: Settings) -> "ClusterBuilder":
+        self._settings = settings
+        return self
+
+    def set_messaging_client_and_server(
+        self, client: IMessagingClient, server: IMessagingServer
+    ) -> "ClusterBuilder":
+        self._client = client
+        self._server = server
+        return self
+
+    def use_scheduler(self, scheduler: Scheduler) -> "ClusterBuilder":
+        """Share a scheduler across in-process nodes (virtual-time clusters)."""
+        self._scheduler = scheduler
+        return self
+
+    def use_rng(self, rng: random.Random) -> "ClusterBuilder":
+        """Seeded randomness for deterministic runs (node IDs, broadcast
+        shuffles, consensus jitter)."""
+        self._rng = rng
+        return self
+
+    # ------------------------------------------------------------------ #
+
+    def _prepare(self) -> Tuple[SharedResources, IMessagingClient, IMessagingServer,
+                                random.Random]:
+        if self._client is None or self._server is None:
+            raise JoinException(
+                "no transport: call set_messaging_client_and_server(...) "
+                "(e.g. InProcessClient/InProcessServer or the TCP transport)"
+            )
+        resources = SharedResources(self._scheduler, name=str(self._listen_address))
+        rng = self._rng if self._rng is not None else random.Random()
+        return resources, self._client, self._server, rng
+
+    def _fd(self, client: IMessagingClient) -> IEdgeFailureDetectorFactory:
+        if self._fd_factory is not None:
+            return self._fd_factory
+        return PingPongFailureDetectorFactory(self._listen_address, client)
+
+    def start(self) -> Cluster:
+        """Bootstrap a seed node (Cluster.java:255-280)."""
+        resources, client, server, rng = self._prepare()
+        node_id = NodeId.random(rng)
+        view = MembershipView(K, node_ids=[node_id], endpoints=[self._listen_address])
+        cut_detector = MultiNodeCutDetector(K, H, L)
+        metadata_map = (
+            {self._listen_address: self._metadata} if self._metadata else {}
+        )
+        service = MembershipService(
+            self._listen_address,
+            cut_detector,
+            view,
+            resources,
+            self._settings,
+            client,
+            self._fd(client),
+            metadata_map=metadata_map,
+            subscriptions=self._subscriptions,
+            rng=rng,
+        )
+        server.set_membership_service(service)
+        server.start()
+        return Cluster(server, service, resources, self._listen_address)
+
+    def join(self, seed_address: Endpoint, timeout: float = 60.0) -> Cluster:
+        """Blocking join for real-time mode."""
+        return self.join_async(seed_address).result(timeout)
+
+    def join_async(self, seed_address: Endpoint) -> Promise:
+        """Two-phase join state machine (Cluster.java:303-401). Resolves with a
+        Cluster or fails with JoinException after RETRIES attempts."""
+        resources, client, server, rng = self._prepare()
+        # The server starts before the join so observers can probe us; probes
+        # are answered BOOTSTRAPPING until the service is wired
+        # (Cluster.java:312, GrpcServer.java:83-95).
+        server.start()
+        result: Promise = Promise()
+        state = {"node_id": NodeId.random(rng), "attempt": 0}
+
+        def fail_all(reason: str) -> None:
+            server.shutdown()
+            client.shutdown()
+            resources.shutdown()
+            result.set_exception(
+                JoinException(f"join attempt unsuccessful {self._listen_address}: {reason}")
+            )
+
+        def next_attempt(reason: str) -> None:
+            state["attempt"] += 1
+            if state["attempt"] >= RETRIES:
+                fail_all(reason)
+            else:
+                attempt()
+
+        def attempt() -> None:
+            pre_join = PreJoinMessage(sender=self._listen_address, node_id=state["node_id"])
+            client.send_message(seed_address, pre_join).add_callback(on_phase1)
+
+        def on_phase1(p: Promise) -> None:
+            if p.exception() is not None:
+                next_attempt(f"phase 1 failed: {p.exception()}")
+                return
+            response = p.peek()
+            if not isinstance(response, JoinResponse):
+                next_attempt(f"unexpected phase 1 response {type(response).__name__}")
+                return
+            status = response.status_code
+            if status not in (
+                JoinStatusCode.SAFE_TO_JOIN,
+                JoinStatusCode.HOSTNAME_ALREADY_IN_RING,
+            ):
+                # Error responses from the seed that warrant a retry
+                # (Cluster.java:318-338)
+                if status == JoinStatusCode.UUID_ALREADY_IN_RING:
+                    state["node_id"] = NodeId.random(rng)
+                next_attempt(f"phase 1 status {status.name}")
+                return
+            # HOSTNAME_ALREADY_IN_RING: a previous attempt's view change added
+            # us; join with config id -1 so any SAFE_TO_JOIN response streams
+            # the configuration (Cluster.java:374-381).
+            config_to_join = (
+                -1
+                if status == JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+                else response.configuration_id
+            )
+            send_phase2(response, config_to_join)
+
+        def send_phase2(phase1_response: JoinResponse, config_to_join: int) -> None:
+            # Batch ring numbers per distinct observer (Cluster.java:406-437)
+            ring_numbers_per_observer: Dict[Endpoint, List[int]] = {}
+            for ring_number, observer in enumerate(phase1_response.endpoints):
+                ring_numbers_per_observer.setdefault(observer, []).append(ring_number)
+            futures = []
+            for observer, ring_numbers in ring_numbers_per_observer.items():
+                msg = JoinMessage(
+                    sender=self._listen_address,
+                    node_id=state["node_id"],
+                    ring_numbers=tuple(ring_numbers),
+                    configuration_id=config_to_join,
+                    metadata=self._metadata,
+                )
+                futures.append(client.send_message(observer, msg))
+            successful_as_list(futures).add_callback(
+                lambda p: on_phase2(p, config_to_join)
+            )
+
+        def on_phase2(p: Promise, config_to_join: int) -> None:
+            responses = p.peek()
+            # Accept the first response carrying a *different* configuration:
+            # joining is itself a view change (Cluster.java:389-399).
+            for response in responses:
+                if (
+                    isinstance(response, JoinResponse)
+                    and response.status_code == JoinStatusCode.SAFE_TO_JOIN
+                    and response.configuration_id != config_to_join
+                ):
+                    finish(response)
+                    return
+            next_attempt("phase 2 returned no valid configuration")
+
+        def finish(response: JoinResponse) -> None:
+            """createClusterFromJoinResponse (Cluster.java:442-474)."""
+            view = MembershipView(
+                K, node_ids=response.identifiers, endpoints=response.endpoints
+            )
+            cut_detector = MultiNodeCutDetector(K, H, L)
+            metadata_map = dict(response.metadata)
+            service = MembershipService(
+                self._listen_address,
+                cut_detector,
+                view,
+                resources,
+                self._settings,
+                client,
+                self._fd(client),
+                metadata_map=metadata_map,
+                subscriptions=self._subscriptions,
+                rng=rng,
+            )
+            server.set_membership_service(service)
+            result.set_result(
+                Cluster(server, service, resources, self._listen_address)
+            )
+
+        attempt()
+        return result

@@ -1,0 +1,37 @@
+"""Async retry combinator (Retries.callWithRetries, Retries.java:44-91)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..runtime.futures import Promise
+
+
+def call_with_retries(attempt: Callable[[], Promise], retries: int) -> Promise:
+    """Run ``attempt`` up to ``retries + 1`` times, resubscribing on failure."""
+    out: Promise = Promise()
+
+    def run(remaining: int) -> None:
+        try:
+            p = attempt()
+        except Exception as e:  # noqa: BLE001 -- synchronous failure counts too
+            _on_fail(e, remaining)
+            return
+        p.add_callback(lambda done: _on_done(done, remaining))
+
+    def _on_done(done: Promise, remaining: int) -> None:
+        exc = done.exception()
+        if exc is None:
+            if not out.done():
+                out.try_set_result(done._result)  # noqa: SLF001
+        else:
+            _on_fail(exc, remaining)
+
+    def _on_fail(exc: BaseException, remaining: int) -> None:
+        if remaining > 0:
+            run(remaining - 1)
+        elif not out.done():
+            out.set_exception(exc)
+
+    run(retries)
+    return out

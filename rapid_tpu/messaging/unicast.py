@@ -1,0 +1,32 @@
+"""Default broadcaster: best-effort unicast to every member.
+
+Reference: UnicastToAllBroadcaster.java:46-63. Recipients are shuffled once per
+configuration so the send order differs across nodes and spreads load.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from ..runtime.futures import Promise
+from ..types import Endpoint, RapidMessage
+from .base import IBroadcaster, IMessagingClient
+
+
+class UnicastToAllBroadcaster(IBroadcaster):
+    def __init__(self, client: IMessagingClient, rng: Optional[random.Random] = None) -> None:
+        self._client = client
+        self._recipients: List[Endpoint] = []
+        self._rng = rng if rng is not None else random.Random()
+
+    def broadcast(self, msg: RapidMessage) -> List[Promise]:
+        return [
+            self._client.send_message_best_effort(recipient, msg)
+            for recipient in self._recipients
+        ]
+
+    def set_membership(self, recipients: List[Endpoint]) -> None:
+        shuffled = list(recipients)
+        self._rng.shuffle(shuffled)
+        self._recipients = shuffled

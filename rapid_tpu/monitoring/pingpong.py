@@ -1,0 +1,116 @@
+"""Default ping-pong edge failure detector.
+
+Reference: PingPongFailureDetector.java. Per tick: if the *cumulative* failed
+probe count has reached FAILURE_THRESHOLD=10, notify once; otherwise send a
+best-effort probe. A success does NOT reset the counter (the reference's
+handleProbeOnSuccess only logs, :116-118) -- preserved for parity; see
+WindowedPingPongFailureDetector for the paper's "40% of last 10" policy.
+A subject answering BOOTSTRAPPING is tolerated BOOTSTRAP_COUNT_THRESHOLD=30
+times before counting as failure (:44,100-106).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque
+
+from ..messaging.base import IMessagingClient
+from ..runtime.futures import Promise
+from ..types import Endpoint, NodeStatus, ProbeMessage, ProbeResponse
+from .base import IEdgeFailureDetectorFactory
+
+FAILURE_THRESHOLD = 10
+BOOTSTRAP_COUNT_THRESHOLD = 30
+
+
+class PingPongFailureDetector:
+    def __init__(
+        self,
+        address: Endpoint,
+        subject: Endpoint,
+        client: IMessagingClient,
+        notifier: Callable[[], None],
+    ) -> None:
+        self._address = address
+        self._subject = subject
+        self._client = client
+        self._notifier = notifier
+        self._failure_count = 0
+        self._bootstrap_response_count = 0
+        self._notified = False
+        self._probe = ProbeMessage(sender=address)
+
+    def has_failed(self) -> bool:
+        return self._failure_count >= FAILURE_THRESHOLD
+
+    def __call__(self) -> None:
+        if self.has_failed() and not self._notified:
+            self._notified = True
+            self._notifier()
+        else:
+            self._client.send_message_best_effort(
+                self._subject, self._probe
+            ).add_callback(self._on_probe_done)
+
+    def _on_probe_done(self, promise: Promise) -> None:
+        if promise.exception() is not None:
+            self._failure_count += 1
+            return
+        response = promise.peek()
+        if not isinstance(response, ProbeResponse):
+            self._failure_count += 1
+            return
+        if response.status == NodeStatus.BOOTSTRAPPING:
+            self._bootstrap_response_count += 1
+            if self._bootstrap_response_count > BOOTSTRAP_COUNT_THRESHOLD:
+                self._failure_count += 1
+
+
+class PingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
+    def __init__(self, address: Endpoint, client: IMessagingClient) -> None:
+        self._address = address
+        self._client = client
+
+    def create_instance(
+        self, subject: Endpoint, notifier: Callable[[], None]
+    ) -> Callable[[], None]:
+        return PingPongFailureDetector(self._address, subject, self._client, notifier)
+
+
+class WindowedPingPongFailureDetector(PingPongFailureDetector):
+    """The paper's policy (atc-2018 §6): mark the edge faulty when >= 40% of
+    the last ``window`` probes failed. Offered as an option; the reference
+    code's cumulative counter remains the parity default."""
+
+    def __init__(self, address, subject, client, notifier,
+                 window: int = 10, threshold: float = 0.4) -> None:
+        super().__init__(address, subject, client, notifier)
+        self._window: Deque[bool] = deque(maxlen=window)
+        self._threshold = threshold
+
+    def has_failed(self) -> bool:
+        window = self._window
+        if len(window) < window.maxlen:  # type: ignore[arg-type]
+            return False
+        return sum(window) >= self._threshold * window.maxlen  # type: ignore[operator]
+
+    def _on_probe_done(self, promise: Promise) -> None:
+        before = self._failure_count + self._bootstrap_response_count
+        super()._on_probe_done(promise)
+        failed = (self._failure_count + self._bootstrap_response_count) > before
+        self._window.append(failed)
+
+
+class WindowedPingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
+    def __init__(self, address: Endpoint, client: IMessagingClient,
+                 window: int = 10, threshold: float = 0.4) -> None:
+        self._address = address
+        self._client = client
+        self._window = window
+        self._threshold = threshold
+
+    def create_instance(self, subject, notifier):
+        return WindowedPingPongFailureDetector(
+            self._address, subject, self._client, notifier,
+            self._window, self._threshold,
+        )

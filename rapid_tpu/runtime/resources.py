@@ -1,0 +1,81 @@
+"""Per-node shared runtime resources.
+
+Reference: SharedResources.java:48-67 -- per instance: a single-threaded
+protocol executor that serializes ALL protocol logic, a scheduled background
+executor for timers, and transport event loops. rapid-tpu collapses these onto
+the Scheduler seam:
+
+- virtual mode: one VirtualScheduler shared by every in-process node; the
+  protocol executor is `schedule(0, fn)` -- globally serialized and
+  deterministic, which is strictly stronger than the reference's per-node
+  serialization.
+- real mode: a RealScheduler for timers plus a dedicated single worker thread
+  per node for protocol serialization.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from .scheduler import RealScheduler, Scheduler, VirtualScheduler
+
+
+class ProtocolExecutor:
+    """Serialized executor for a node's protocol logic."""
+
+    def execute(self, fn: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class _SchedulerExecutor(ProtocolExecutor):
+    def __init__(self, scheduler: Scheduler) -> None:
+        self._scheduler = scheduler
+
+    def execute(self, fn: Callable[[], None]) -> None:
+        self._scheduler.schedule(0, fn)
+
+
+class _ThreadExecutor(ProtocolExecutor):
+    def __init__(self, name: str) -> None:
+        self._queue: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._queue.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 -- executor must survive task errors
+                import logging
+
+                logging.getLogger(__name__).exception("protocol task failed")
+
+    def execute(self, fn: Callable[[], None]) -> None:
+        self._queue.put(fn)
+
+    def shutdown(self) -> None:
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+
+
+class SharedResources:
+    def __init__(self, scheduler: Optional[Scheduler] = None, name: str = "node") -> None:
+        self.scheduler: Scheduler = scheduler if scheduler is not None else RealScheduler()
+        self._owns_scheduler = scheduler is None
+        if isinstance(self.scheduler, VirtualScheduler):
+            self.protocol_executor: ProtocolExecutor = _SchedulerExecutor(self.scheduler)
+        else:
+            self.protocol_executor = _ThreadExecutor(f"{name}-protocol")
+
+    def shutdown(self) -> None:
+        self.protocol_executor.shutdown()
+        if self._owns_scheduler:
+            self.scheduler.shutdown()

@@ -1,0 +1,496 @@
+"""The membership protocol engine.
+
+Reference: MembershipService.java -- the single dispatch point for all protocol
+messages (:171-193), join gatekeeping (:200-286), alert batching (:602-626),
+cut-detector driving (:297-348), view-change application (:379-433), failure
+detector lifecycle (:686-703) and event subscriptions.
+
+Threading model: every handler body hops onto the node's serialized protocol
+executor, exactly like the reference's single-threaded protocolExecutor
+(SharedResources.java:53, MembershipService.java:68-72). Under the virtual-time
+scheduler this additionally makes whole-cluster runs deterministic.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .cut_detector import MultiNodeCutDetector
+from .events import ClusterEvents, NodeStatusChange
+from .fast_paxos import FastPaxos
+from .hashing import endpoint_hash, to_signed
+from .membership import MembershipView
+from .messaging.base import IBroadcaster, IMessagingClient
+from .messaging.unicast import UnicastToAllBroadcaster
+from .metadata import FrozenMetadata, MetadataManager
+from .monitoring.base import IEdgeFailureDetectorFactory
+from .runtime.futures import Promise, successful_as_list
+from .runtime.resources import SharedResources
+from .runtime.scheduler import ScheduledTask
+from .settings import Settings
+from .types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    CONSENSUS_MESSAGE_TYPES,
+    ConsensusResponse,
+    EdgeStatus,
+    Endpoint,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    LeaveMessage,
+    NodeId,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    RapidMessage,
+    Response,
+)
+
+LOG = logging.getLogger(__name__)
+
+SubscriptionCallback = Callable[[int, List[NodeStatusChange]], None]
+
+
+def address_comparator_key(endpoint: Endpoint) -> int:
+    """Seed-0 ring order, used to canonicalize proposals before consensus
+    (MembershipService.java:340-342)."""
+    return to_signed(endpoint_hash(endpoint.hostname, endpoint.port, 0))
+
+
+class MembershipService:
+    def __init__(
+        self,
+        my_addr: Endpoint,
+        cut_detector: MultiNodeCutDetector,
+        membership_view: MembershipView,
+        resources: SharedResources,
+        settings: Settings,
+        client: IMessagingClient,
+        edge_failure_detector: IEdgeFailureDetectorFactory,
+        metadata_map: Optional[Dict[Endpoint, FrozenMetadata]] = None,
+        subscriptions: Optional[Dict[ClusterEvents, List[SubscriptionCallback]]] = None,
+        rng: Optional[random.Random] = None,
+        broadcaster: Optional[IBroadcaster] = None,
+    ) -> None:
+        self._my_addr = my_addr
+        self._cut_detection = cut_detector
+        self._view = membership_view
+        self._resources = resources
+        self._scheduler = resources.scheduler
+        self._settings = settings
+        self._client = client
+        self._fd_factory = edge_failure_detector
+        self._rng = rng if rng is not None else random.Random()
+        self._metadata_manager = MetadataManager()
+        if metadata_map:
+            self._metadata_manager.add_metadata(metadata_map)
+        self._broadcaster = (
+            broadcaster
+            if broadcaster is not None
+            else UnicastToAllBroadcaster(client, rng=self._rng)
+        )
+        self._subscriptions: Dict[ClusterEvents, List[SubscriptionCallback]] = {
+            event: [] for event in ClusterEvents
+        }
+        if subscriptions:
+            for event, callbacks in subscriptions.items():
+                self._subscriptions[event].extend(callbacks)
+
+        self._joiners_to_respond_to: Dict[Endpoint, List[Promise]] = {}
+        self._joiner_uuid: Dict[Endpoint, NodeId] = {}
+        self._joiner_metadata: Dict[Endpoint, FrozenMetadata] = {}
+        self._announced_proposal = False
+        self._alert_send_queue: List[AlertMessage] = []
+        self._last_enqueue_ms = -1
+        self._failure_detector_jobs: List[ScheduledTask] = []
+        self._shut_down = False
+
+        self._alert_batcher_job = self._scheduler.schedule_at_fixed_rate(
+            0, settings.batching_window_ms, self._alert_batcher_tick
+        )
+        self._broadcaster.set_membership(self._view.get_ring(0))
+        self._fast_paxos = self._new_fast_paxos()
+        self._create_failure_detectors()
+
+        # Initial VIEW_CHANGE callbacks: start/join completed
+        # (MembershipService.java:162-165)
+        configuration_id = self._view.get_current_configuration_id()
+        initial = [
+            NodeStatusChange(node, EdgeStatus.UP, self._metadata_manager.get(node))
+            for node in self._view.get_ring(0)
+        ]
+        self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, initial)
+
+    # ------------------------------------------------------------------ #
+    # Message dispatch (MembershipService.java:171-193)
+    # ------------------------------------------------------------------ #
+
+    def handle_message(self, msg: RapidMessage) -> Promise:
+        if isinstance(msg, PreJoinMessage):
+            return self._handle_pre_join(msg)
+        if isinstance(msg, JoinMessage):
+            return self._handle_join(msg)
+        if isinstance(msg, BatchedAlertMessage):
+            return self._handle_batched_alerts(msg)
+        if isinstance(msg, ProbeMessage):
+            return Promise.completed(ProbeResponse())
+        if isinstance(msg, CONSENSUS_MESSAGE_TYPES):
+            return self._handle_consensus(msg)
+        if isinstance(msg, LeaveMessage):
+            self._edge_failure_notification(
+                msg.sender, self._view.get_current_configuration_id()
+            )
+            return Promise.completed(Response())
+        raise TypeError(f"unidentified request type {type(msg).__name__}")
+
+    # ------------------------------------------------------------------ #
+    # Join protocol, server side
+    # ------------------------------------------------------------------ #
+
+    def _handle_pre_join(self, msg: PreJoinMessage) -> Promise:
+        """Phase-1 gatekeeping at a seed (MembershipService.java:200-221)."""
+        future: Promise = Promise()
+
+        def task() -> None:
+            status = self._view.is_safe_to_join(msg.sender, msg.node_id)
+            endpoints: Tuple[Endpoint, ...] = ()
+            if status in (
+                JoinStatusCode.SAFE_TO_JOIN,
+                JoinStatusCode.HOSTNAME_ALREADY_IN_RING,
+            ):
+                endpoints = tuple(self._view.get_expected_observers_of(msg.sender))
+            future.set_result(
+                JoinResponse(
+                    sender=self._my_addr,
+                    status_code=status,
+                    configuration_id=self._view.get_current_configuration_id(),
+                    endpoints=endpoints,
+                )
+            )
+
+        self._resources.protocol_executor.execute(task)
+        return future
+
+    def _handle_join(self, msg: JoinMessage) -> Promise:
+        """Phase-2 at an observer: park the response until the view change
+        commits (MembershipService.java:229-286)."""
+        future: Promise = Promise()
+
+        def task() -> None:
+            current_configuration = self._view.get_current_configuration_id()
+            if current_configuration == msg.configuration_id:
+                self._joiners_to_respond_to.setdefault(msg.sender, []).append(future)
+                alert = AlertMessage(
+                    edge_src=self._my_addr,
+                    edge_dst=msg.sender,
+                    edge_status=EdgeStatus.UP,
+                    configuration_id=current_configuration,
+                    ring_numbers=msg.ring_numbers,
+                    node_id=msg.node_id,
+                    metadata=msg.metadata,
+                )
+                self._enqueue_alert(alert)
+            else:
+                # Configuration changed between join phases 1 and 2.
+                config = self._view.get_configuration()
+                if self._view.is_host_present(msg.sender) and self._view.is_identifier_present(
+                    msg.node_id
+                ):
+                    # The cut already admitted this joiner; stream the config.
+                    future.set_result(self._make_join_response(JoinStatusCode.SAFE_TO_JOIN))
+                else:
+                    future.set_result(
+                        JoinResponse(
+                            sender=self._my_addr,
+                            status_code=JoinStatusCode.CONFIG_CHANGED,
+                            configuration_id=config.configuration_id,
+                        )
+                    )
+
+        self._resources.protocol_executor.execute(task)
+        return future
+
+    def _make_join_response(self, status: JoinStatusCode) -> JoinResponse:
+        config = self._view.get_configuration()
+        return JoinResponse(
+            sender=self._my_addr,
+            status_code=status,
+            configuration_id=config.configuration_id,
+            endpoints=config.endpoints,
+            identifiers=config.node_ids,
+            metadata=tuple(self._metadata_manager.get_all_metadata().items()),
+        )
+
+    # ------------------------------------------------------------------ #
+    # Alerts -> cut detection -> consensus (MembershipService.java:297-348)
+    # ------------------------------------------------------------------ #
+
+    def _handle_batched_alerts(self, batch: BatchedAlertMessage) -> Promise:
+        future: Promise = Promise()
+
+        def task() -> None:
+            current_configuration_id = self._view.get_current_configuration_id()
+            membership_size = self._view.membership_size
+            valid_alerts = [
+                self._extract_joiner_details(msg)
+                for msg in batch.messages
+                if self._filter_alert(msg, membership_size, current_configuration_id)
+            ]
+            if self._announced_proposal:
+                # We already initiated consensus and cannot go back on it.
+                future.set_result(Response())
+                return
+            proposal: Set[Endpoint] = set()
+            for alert in valid_alerts:
+                proposal.update(self._cut_detection.aggregate_for_proposal(alert))
+            proposal.update(self._cut_detection.invalidate_failing_edges(self._view))
+            if proposal:
+                self._announced_proposal = True
+                changes = self._node_status_changes(proposal)
+                self._fire(
+                    ClusterEvents.VIEW_CHANGE_PROPOSAL, current_configuration_id, changes
+                )
+                self._fast_paxos.propose(sorted(proposal, key=address_comparator_key))
+            future.set_result(Response())
+
+        self._resources.protocol_executor.execute(task)
+        return future
+
+    def _filter_alert(
+        self, alert: AlertMessage, membership_size: int, current_configuration_id: int
+    ) -> bool:
+        """Drop stale/invariant-violating alerts (MembershipService.java:633-664)."""
+        if alert.configuration_id != current_configuration_id:
+            return False
+        if alert.edge_status == EdgeStatus.UP and self._view.is_host_present(alert.edge_dst):
+            return False
+        if alert.edge_status == EdgeStatus.DOWN and not self._view.is_host_present(
+            alert.edge_dst
+        ):
+            return False
+        return True
+
+    def _extract_joiner_details(self, alert: AlertMessage) -> AlertMessage:
+        """Stash joiner UUID/metadata for the eventual ringAdd
+        (MembershipService.java:666-674)."""
+        if alert.edge_status == EdgeStatus.UP:
+            assert alert.node_id is not None
+            self._joiner_uuid[alert.edge_dst] = alert.node_id
+            self._joiner_metadata[alert.edge_dst] = alert.metadata
+        return alert
+
+    def _handle_consensus(self, msg: RapidMessage) -> Promise:
+        future: Promise = Promise()
+        self._resources.protocol_executor.execute(
+            lambda: future.set_result(self._fast_paxos.handle_messages(msg))
+        )
+        return future
+
+    # ------------------------------------------------------------------ #
+    # View-change application (MembershipService.java:379-433)
+    # ------------------------------------------------------------------ #
+
+    def _decide_view_change(self, proposal: List[Endpoint]) -> None:
+        self._cancel_failure_detectors()
+        status_changes: List[NodeStatusChange] = []
+        for node in proposal:
+            if self._view.is_host_present(node):
+                self._view.ring_delete(node)
+                status_changes.append(
+                    NodeStatusChange(node, EdgeStatus.DOWN, self._metadata_manager.get(node))
+                )
+                self._metadata_manager.remove_node(node)
+            else:
+                assert node in self._joiner_uuid, f"no joiner UUID stashed for {node}"
+                node_id = self._joiner_uuid.pop(node)
+                self._view.ring_add(node, node_id)
+                metadata = self._joiner_metadata.pop(node, ())
+                if metadata:
+                    self._metadata_manager.add_metadata({node: metadata})
+                status_changes.append(NodeStatusChange(node, EdgeStatus.UP, metadata))
+
+        configuration_id = self._view.get_current_configuration_id()
+        self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, status_changes)
+
+        self._cut_detection.clear()
+        self._announced_proposal = False
+        self._fast_paxos = self._new_fast_paxos()
+        self._broadcaster.set_membership(self._view.get_ring(0))
+
+        if self._view.is_host_present(self._my_addr):
+            self._create_failure_detectors()
+        else:
+            # We were removed: gracefully self-evict.
+            self._fire(ClusterEvents.KICKED, configuration_id, status_changes)
+
+        self._respond_to_joiners(proposal)
+
+    def _new_fast_paxos(self) -> FastPaxos:
+        return FastPaxos(
+            self._my_addr,
+            self._view.get_current_configuration_id(),
+            self._view.membership_size,
+            self._client,
+            self._broadcaster,
+            self._scheduler,
+            self._on_consensus_decide,
+            consensus_fallback_base_delay_ms=self._settings.consensus_fallback_base_delay_ms,
+            rng=self._rng,
+        )
+
+    def _on_consensus_decide(self, proposal: List[Endpoint]) -> None:
+        # Decisions may surface from within a protocol task (message handling)
+        # -- re-serialize onto the protocol executor.
+        self._resources.protocol_executor.execute(
+            lambda: self._decide_view_change(proposal)
+        )
+
+    def _respond_to_joiners(self, proposal: List[Endpoint]) -> None:
+        """Unblock parked phase-2 join futures with the new configuration
+        (MembershipService.java:708-733)."""
+        response = self._make_join_response(JoinStatusCode.SAFE_TO_JOIN)
+        for node in proposal:
+            futures = self._joiners_to_respond_to.pop(node, None)
+            if futures:
+                for future in futures:
+                    self._scheduler.execute(
+                        lambda f=future: f.try_set_result(response)
+                    )
+
+    # ------------------------------------------------------------------ #
+    # Failure detection (MembershipService.java:461-484, 686-703)
+    # ------------------------------------------------------------------ #
+
+    def _edge_failure_notification(self, subject: Endpoint, configuration_id: int) -> None:
+        def task() -> None:
+            if configuration_id != self._view.get_current_configuration_id():
+                return  # stale notification from an old configuration
+            if not self._view.is_host_present(subject):
+                return
+            alert = AlertMessage(
+                edge_src=self._my_addr,
+                edge_dst=subject,
+                edge_status=EdgeStatus.DOWN,
+                configuration_id=configuration_id,
+                ring_numbers=tuple(self._view.get_ring_numbers(self._my_addr, subject)),
+            )
+            self._enqueue_alert(alert)
+
+        self._resources.protocol_executor.execute(task)
+
+    def _create_failure_detectors(self) -> None:
+        try:
+            subjects = self._view.get_subjects_of(self._my_addr)
+        except Exception:  # not in the ring (shouldn't happen; be safe)
+            subjects = []
+        for subject in subjects:
+            config_id = self._view.get_current_configuration_id()
+            notifier = (
+                lambda s=subject, c=config_id: self._edge_failure_notification(s, c)
+            )
+            runnable = self._fd_factory.create_instance(subject, notifier)
+            job = self._scheduler.schedule_at_fixed_rate(
+                0, self._settings.failure_detector_interval_ms, runnable
+            )
+            self._failure_detector_jobs.append(job)
+
+    def _cancel_failure_detectors(self) -> None:
+        for job in self._failure_detector_jobs:
+            job.cancel()
+        self._failure_detector_jobs.clear()
+
+    # ------------------------------------------------------------------ #
+    # Alert batching (MembershipService.java:561-626)
+    # ------------------------------------------------------------------ #
+
+    def _enqueue_alert(self, msg: AlertMessage) -> None:
+        self._last_enqueue_ms = self._scheduler.now_ms()
+        self._alert_send_queue.append(msg)
+
+    def _alert_batcher_tick(self) -> None:
+        """Quiescence-based flush: only send once a full batching window has
+        passed since the last enqueue (MembershipService.java:602-626)."""
+        if not self._alert_send_queue or self._last_enqueue_ms < 0:
+            return
+        if (
+            self._scheduler.now_ms() - self._last_enqueue_ms
+            <= self._settings.batching_window_ms
+        ):
+            return
+        messages = tuple(self._alert_send_queue)
+        self._alert_send_queue.clear()
+        self._broadcaster.broadcast(
+            BatchedAlertMessage(sender=self._my_addr, messages=messages)
+        )
+
+    # ------------------------------------------------------------------ #
+    # Public surface
+    # ------------------------------------------------------------------ #
+
+    def get_membership_view(self) -> List[Endpoint]:
+        return self._view.get_ring(0)
+
+    @property
+    def membership_size(self) -> int:
+        return self._view.membership_size
+
+    def get_metadata(self) -> Dict[Endpoint, FrozenMetadata]:
+        return self._metadata_manager.get_all_metadata()
+
+    def get_current_configuration_id(self) -> int:
+        return self._view.get_current_configuration_id()
+
+    def register_subscription(
+        self, event: ClusterEvents, callback: SubscriptionCallback
+    ) -> None:
+        self._subscriptions[event].append(callback)
+
+    def leave_async(self) -> Promise:
+        """Proactively trigger DOWN alerts at our observers
+        (MembershipService.java:534-554); completes when observers answered
+        or the leave timeout passed."""
+        done: Promise = Promise()
+        try:
+            observers = self._view.get_observers_of(self._my_addr)
+        except Exception:  # already removed: nothing to announce
+            done.set_result(None)
+            return done
+        leave = LeaveMessage(sender=self._my_addr)
+        responses = successful_as_list(
+            [self._client.send_message_best_effort(obs, leave) for obs in observers]
+        )
+        responses.add_callback(lambda _: done.try_set_result(None))
+        self._scheduler.schedule(
+            self._settings.leave_message_timeout_ms,
+            lambda: done.try_set_result(None),
+        )
+        return done
+
+    def shutdown(self) -> None:
+        if self._shut_down:
+            return
+        self._shut_down = True
+        self._alert_batcher_job.cancel()
+        self._cancel_failure_detectors()
+        self._client.shutdown()
+
+    # ------------------------------------------------------------------ #
+
+    def _node_status_changes(self, proposal) -> List[NodeStatusChange]:
+        return [
+            NodeStatusChange(
+                node,
+                EdgeStatus.DOWN if self._view.is_host_present(node) else EdgeStatus.UP,
+                self._metadata_manager.get(node),
+            )
+            for node in sorted(proposal, key=address_comparator_key)
+        ]
+
+    def _fire(
+        self, event: ClusterEvents, configuration_id: int, changes: List[NodeStatusChange]
+    ) -> None:
+        for callback in self._subscriptions[event]:
+            callback(configuration_id, changes)

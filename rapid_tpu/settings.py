@@ -1,0 +1,40 @@
+"""Configuration knobs.
+
+Reference: Settings.java:21-112 -- one mutable object implementing the narrow
+per-consumer ISettings interfaces. Python needs no interface split; consumers
+take the whole Settings (defaults cited per reference location).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Settings:
+    # Transport timeouts/retries (GrpcClient.java:55-59)
+    message_timeout_ms: int = 1000
+    join_message_timeout_ms: int = 5000
+    probe_message_timeout_ms: int = 1000
+    message_retries: int = 5
+
+    # Protocol engine (MembershipService.java:75-77)
+    failure_detector_interval_ms: int = 1000
+    batching_window_ms: int = 100
+
+    # Consensus fallback (FastPaxos.java:46)
+    consensus_fallback_base_delay_ms: int = 1000
+
+    # Graceful leave wait (MembershipService.java:78)
+    leave_message_timeout_ms: int = 1500
+
+    def timeout_for(self, msg) -> int:
+        """Per-message-type deadline (GrpcClient.getTimeoutForMessageMs,
+        GrpcClient.java:194-203)."""
+        from .types import JoinMessage, PreJoinMessage, ProbeMessage
+
+        if isinstance(msg, (JoinMessage, PreJoinMessage)):
+            return self.join_message_timeout_ms
+        if isinstance(msg, ProbeMessage):
+            return self.probe_message_timeout_ms
+        return self.message_timeout_ms

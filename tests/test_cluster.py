@@ -1,0 +1,188 @@
+"""Functional battery over the public Cluster API, mirroring ClusterTest.java
+(805 LoC): joins (sequential, parallel, staged), crash failures, asymmetric
+message drops, join races under drops, churn, and graceful leave -- all
+in-process on deterministic virtual time.
+"""
+
+import pytest
+
+from rapid_tpu import ClusterEvents, Endpoint
+from rapid_tpu.monitoring.pingpong import PingPongFailureDetectorFactory
+from rapid_tpu.types import JoinMessage, PreJoinMessage, ProbeMessage
+
+from harness import ClusterHarness
+
+
+@pytest.fixture
+def harness():
+    h = ClusterHarness(seed=42)
+    yield h
+    h.shutdown()
+
+
+def test_single_node_cluster(harness):
+    seed = harness.start_seed()
+    assert seed.get_membership_size() == 1
+    assert seed.get_memberlist() == [seed.listen_address]
+
+
+def test_sequential_joins(harness):
+    """ClusterTest.java:150-175."""
+    harness.start_seed()
+    for i in range(1, 10):
+        harness.join(i)
+        harness.wait_and_verify_agreement(i + 1)
+    assert all(c.get_membership_size() == 10 for c in harness.instances.values())
+
+
+def test_parallel_joins_through_single_seed(harness):
+    """ClusterTest.java:184-191 (scaled to 30 in-process nodes)."""
+    harness.create_cluster(30, parallel=True)
+    harness.wait_and_verify_agreement(30)
+
+
+def test_staged_join_waves(harness):
+    """ClusterTest.java:198-206: waves of concurrent joiners."""
+    harness.start_seed()
+    total = 1
+    for wave in range(3):
+        promises = [harness.join_async(total + i) for i in range(5)]
+        ok = harness.scheduler.run_until(
+            lambda: all(p.done() and p.exception() is None for p in promises),
+            timeout_ms=300_000,
+        )
+        assert ok
+        total += 5
+        harness.wait_and_verify_agreement(total)
+
+
+def test_crash_one_node(harness):
+    harness.create_cluster(10)
+    harness.wait_and_verify_agreement(10)
+    harness.fail_nodes([harness.addr(9)])
+    harness.wait_and_verify_agreement(9)
+
+
+def test_crash_multiple_nodes(harness):
+    """ClusterTest.java:276-315 (12/50 there; 6/25 here -- same >20% ratio)."""
+    harness.create_cluster(25)
+    harness.wait_and_verify_agreement(25)
+    failing = [harness.addr(i) for i in range(19, 25)]
+    harness.fail_nodes(failing)
+    harness.wait_and_verify_agreement(19)
+    for cluster in harness.instances.values():
+        members = set(cluster.get_memberlist())
+        assert not members & set(failing)
+
+
+def test_crash_seed_node(harness):
+    harness.create_cluster(10)
+    harness.wait_and_verify_agreement(10)
+    harness.fail_nodes([harness.addr(0)])
+    harness.wait_and_verify_agreement(9)
+
+
+def test_asymmetric_probe_drops(harness):
+    """ClusterTest.java:343-358: drop all probes *to* some nodes; the cluster
+    must remove exactly those nodes despite them being able to send."""
+    h = ClusterHarness(seed=7, use_static_fd=False)
+    try:
+        from rapid_tpu.messaging.inprocess import InProcessClient
+
+        def pingpong(i):
+            addr = h.addr(i)
+            return PingPongFailureDetectorFactory(
+                addr, InProcessClient(addr, h.network, h.settings)
+            )
+
+        h.start_seed(0, fd=pingpong(0))
+        for i in range(1, 12):
+            h.join(i, fd=pingpong(i))
+        h.wait_and_verify_agreement(12)
+        victims = {h.addr(10), h.addr(11)}
+        h.network.add_filter(
+            lambda s, d, m: not (isinstance(m, ProbeMessage) and d in victims)
+        )
+        for victim in victims:
+            h.instances.pop(victim)
+        h.wait_and_verify_agreement(10, timeout_ms=600_000)
+        for cluster in h.instances.values():
+            assert not set(cluster.get_memberlist()) & victims
+    finally:
+        h.shutdown()
+
+
+def test_join_with_dropped_join_messages(harness):
+    """ClusterTest.java:365-412: seed drops the first phase-1 and phase-2
+    messages; the joiner's retry logic must still get it in."""
+    harness.start_seed()
+    seed_server = harness.servers[harness.addr(0)]
+    dropped = {"prejoin": 0, "join": 0}
+
+    def drop_first_n(msg) -> bool:
+        if isinstance(msg, PreJoinMessage) and dropped["prejoin"] < 1:
+            dropped["prejoin"] += 1
+            return False
+        if isinstance(msg, JoinMessage) and dropped["join"] < 1:
+            dropped["join"] += 1
+            return False
+        return True
+
+    seed_server.interceptors.append(drop_first_n)
+    harness.join(1, timeout_ms=600_000)
+    harness.wait_and_verify_agreement(2)
+    assert dropped["prejoin"] == 1 and dropped["join"] == 1
+
+
+def test_rejoin_after_crash(harness):
+    """ClusterTest.java:418-504 (churn): a crashed node rejoins with the same
+    address and a fresh identifier."""
+    harness.create_cluster(10)
+    harness.wait_and_verify_agreement(10)
+    victim = harness.addr(9)
+    harness.fail_nodes([victim])
+    harness.wait_and_verify_agreement(9)
+    harness.blacklist.discard(victim)
+    harness.join(9)
+    harness.wait_and_verify_agreement(10)
+
+
+def test_churn_loop(harness):
+    """Repeated crash+rejoin cycles keep converging."""
+    harness.create_cluster(8)
+    harness.wait_and_verify_agreement(8)
+    for _ in range(3):
+        victim = harness.addr(7)
+        harness.fail_nodes([victim])
+        harness.wait_and_verify_agreement(7)
+        harness.blacklist.discard(victim)
+        harness.join(7)
+        harness.wait_and_verify_agreement(8)
+
+
+def test_graceful_leave(harness):
+    """ClusterTest.java:510-522: leaveGracefully triggers a proactive DOWN cut
+    without waiting for failure detection."""
+    harness.create_cluster(10)
+    harness.wait_and_verify_agreement(10)
+    leaver = harness.instances.pop(harness.addr(9))
+    done = leaver.leave_gracefully_async()
+    ok = harness.scheduler.run_until(done.done, timeout_ms=120_000)
+    assert ok
+    harness.wait_and_verify_agreement(9)
+
+
+def test_join_nonexistent_seed_fails(harness):
+    promise = harness._builder(harness.addr(1)).join_async(harness.addr(99))
+    ok = harness.scheduler.run_until(promise.done, timeout_ms=600_000)
+    assert ok
+    assert promise.exception() is not None
+
+
+def test_memberlist_identical_across_nodes(harness):
+    harness.create_cluster(15)
+    harness.wait_and_verify_agreement(15)
+    lists = [tuple(c.get_memberlist()) for c in harness.instances.values()]
+    assert len(set(lists)) == 1
+    configs = {c.get_current_configuration_id() for c in harness.instances.values()}
+    assert len(configs) == 1

@@ -1,0 +1,121 @@
+"""Event subscription semantics, mirroring SubscriptionsTest.java (264 LoC):
+callback counts and ordering on join and failure, metadata delivery in DOWN
+notifications, and KICKED self-eviction.
+"""
+
+import pytest
+
+from rapid_tpu import ClusterEvents, EdgeStatus
+
+from harness import ClusterHarness
+
+
+@pytest.fixture
+def harness():
+    h = ClusterHarness(seed=99)
+    yield h
+    h.shutdown()
+
+
+def collect(events):
+    def cb(configuration_id, changes):
+        events.append((configuration_id, list(changes)))
+
+    return cb
+
+
+def test_initial_view_change_on_start(harness):
+    """Start fires one VIEW_CHANGE with the node itself UP
+    (MembershipService.java:162-165)."""
+    events = []
+    harness.start_seed(0, subscriptions=[(ClusterEvents.VIEW_CHANGE, collect(events))])
+    assert len(events) == 1
+    _, changes = events[0]
+    assert len(changes) == 1
+    assert changes[0].status == EdgeStatus.UP
+
+
+def test_view_change_on_each_join(harness):
+    events = []
+    harness.start_seed(0, subscriptions=[(ClusterEvents.VIEW_CHANGE, collect(events))])
+    for i in range(1, 5):
+        harness.join(i)
+        harness.wait_and_verify_agreement(i + 1)
+    # 1 initial + 4 joins
+    assert len(events) == 5
+    for idx, (_, changes) in enumerate(events[1:], start=1):
+        assert all(c.status == EdgeStatus.UP for c in changes)
+    # configuration ids strictly change
+    config_ids = [cid for cid, _ in events]
+    assert len(set(config_ids)) == len(config_ids)
+
+
+def test_proposal_and_view_change_on_failure(harness):
+    proposals = []
+    view_changes = []
+    harness.start_seed(
+        0,
+        subscriptions=[
+            (ClusterEvents.VIEW_CHANGE_PROPOSAL, collect(proposals)),
+            (ClusterEvents.VIEW_CHANGE, collect(view_changes)),
+        ],
+    )
+    for i in range(1, 6):
+        harness.join(i)
+    harness.wait_and_verify_agreement(6)
+    n_proposals = len(proposals)
+    victim = harness.addr(5)
+    harness.fail_nodes([victim])
+    harness.wait_and_verify_agreement(5)
+    assert len(proposals) > n_proposals
+    _, changes = proposals[-1]
+    assert [c.endpoint for c in changes] == [victim]
+    assert changes[0].status == EdgeStatus.DOWN
+    _, vc = view_changes[-1]
+    assert [c.endpoint for c in vc] == [victim]
+
+
+def test_metadata_in_down_notification(harness):
+    """Metadata tags survive to the DOWN notification
+    (SubscriptionsTest.java:158-247)."""
+    down_events = []
+    harness.start_seed(
+        0, subscriptions=[(ClusterEvents.VIEW_CHANGE, collect(down_events))]
+    )
+    harness.join(1, metadata={"role": b"backend"})
+    for i in range(2, 5):
+        harness.join(i)
+    harness.wait_and_verify_agreement(5)
+    victim = harness.addr(1)
+    # metadata visible cluster-wide after the join
+    assert dict(harness.instances[harness.addr(0)].get_cluster_metadata()[victim]) == {
+        "role": b"backend"
+    }
+    harness.fail_nodes([victim])
+    harness.wait_and_verify_agreement(4)
+    _, changes = down_events[-1]
+    assert changes[0].endpoint == victim
+    assert changes[0].status == EdgeStatus.DOWN
+    assert dict(changes[0].metadata) == {"role": b"backend"}
+
+
+def test_kicked_event_on_removed_node(harness):
+    """A node that is cut from the view fires KICKED locally
+    (MembershipService.java:424-429)."""
+    kicked = []
+    harness.start_seed(0)
+    for i in range(1, 5):
+        if i == 4:
+            harness.join(i, subscriptions=[(ClusterEvents.KICKED, collect(kicked))])
+        else:
+            harness.join(i)
+    harness.wait_and_verify_agreement(5)
+    victim = harness.addr(4)
+    victim_cluster = harness.instances.pop(victim)
+    # Blacklist it for the others but keep its process "running" so it can
+    # observe its own removal.
+    harness.blacklist.add(victim)
+    harness.wait_and_verify_agreement(4)
+    ok = harness.scheduler.run_until(lambda: len(kicked) > 0, timeout_ms=300_000)
+    assert ok, "victim never observed its own removal"
+    victim_cluster.shutdown()
