@@ -1,0 +1,72 @@
+"""Headline benchmark: time-to-stable-view for a 100k-node membership
+simulation with a 1% correlated crash burst, on real TPU hardware.
+
+BASELINE.json north star: "simulate a 100k-node cluster converging on a 1%
+correlated-failure cut in <5s ... with cut-set identical to the JVM
+reference". value = wall ms from fault injection to the decided view (jit
+warmed); vs_baseline = value / 5000ms (fraction of the north-star budget;
+< 1.0 means the target is beaten). Cut-set parity is asserted before
+reporting: the decided cut must be exactly the crashed set, and the resulting
+configuration ID is computed with the bit-exact JVM hash chain.
+
+Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_NODES = 100_000
+FAIL_FRACTION = 0.01
+BASELINE_MS = 5000.0  # north-star budget (BASELINE.json)
+
+
+def main() -> None:
+    from rapid_tpu.sim.driver import Simulator
+
+    rng = np.random.default_rng(1234)
+    t_build0 = time.perf_counter()
+    sim = Simulator(N_NODES, seed=1234)
+    build_s = time.perf_counter() - t_build0
+
+    victims = rng.choice(N_NODES, size=int(N_NODES * FAIL_FRACTION), replace=False)
+
+    # Warm the jit cache on an identical-shape run, then reset.
+    sim.crash(victims)
+    warm = sim.run_until_decision(max_rounds=16, batch=16)
+    assert warm is not None and set(warm.cut) == set(victims), "warmup parity failed"
+    warm_wall = warm.wall_time_s
+
+    sim2 = Simulator(N_NODES, seed=5678)
+    victims2 = rng.choice(N_NODES, size=int(N_NODES * FAIL_FRACTION), replace=False)
+    sim2.crash(victims2)
+    t0 = time.perf_counter()
+    record = sim2.run_until_decision(max_rounds=16, batch=16)
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+
+    assert record is not None, "no decision reached"
+    assert set(record.cut) == set(victims2), "cut-set parity violated"
+    assert record.membership_size == N_NODES - len(victims2)
+
+    print(
+        json.dumps(
+            {
+                "metric": "time_to_stable_view_100k_nodes_1pct_crash_sim",
+                "value": round(wall_ms, 1),
+                "unit": "ms",
+                "vs_baseline": round(wall_ms / BASELINE_MS, 4),
+            }
+        )
+    )
+    print(
+        f"# membership=100000->{record.membership_size} cut={len(record.cut)} nodes "
+        f"virtual_time={record.virtual_time_ms}ms config_id={record.configuration_id} "
+        f"build={build_s:.1f}s warmup_wall={warm_wall:.1f}s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,270 @@
+"""Host driver: runs the device round loop and applies view changes.
+
+The host owns the control plane -- the exact mirror of what real Rapid nodes do
+outside the hot loop: ring/adjacency construction at configuration changes
+(MembershipView ringAdd/ringDelete), configuration identity (the chained
+xxHash64, bit-compatible with the JVM), and the identifiersSeen set (which is
+append-only across the cluster's lifetime, MembershipView.java:51,155).
+
+The fault API mirrors the BASELINE.json scenarios: correlated crash bursts,
+asymmetric one-way link loss, lossy ingress, flip-flop reachability, and join
+waves. Faults persist across configurations the way they would against a real
+cluster: crashes stay crashed, ingress partitions are re-mapped onto the new
+adjacency, and pending joiners re-attempt in each new configuration (a real
+joiner whose phase-2 landed in a superseded configuration retries,
+Cluster.java:313-344).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import (
+    SimConfig,
+    SimState,
+    const_inputs,
+    initial_state,
+    run_rounds_const,
+)
+from .topology import (
+    VirtualCluster,
+    configuration_id_vectorized,
+    ring_order,
+)
+
+
+@dataclass
+class ViewChangeRecord:
+    """One decided configuration change."""
+
+    cut: np.ndarray  # node ids added/removed
+    added: np.ndarray
+    removed: np.ndarray
+    configuration_id: int
+    virtual_time_ms: int  # protocol-time of the decision
+    wall_time_s: float  # host+device time spent simulating to it
+    membership_size: int
+
+
+class Simulator:
+    def __init__(
+        self,
+        n_nodes: int,
+        capacity: Optional[int] = None,
+        config: Optional[SimConfig] = None,
+        seed: int = 0,
+    ) -> None:
+        capacity = capacity if capacity is not None else n_nodes
+        assert n_nodes <= capacity
+        self.config = config if config is not None else SimConfig(capacity=capacity)
+        assert self.config.capacity == capacity
+        self.cluster = VirtualCluster.synthesize(capacity, self.config.k, seed=seed)
+        self.active = np.zeros(capacity, dtype=bool)
+        self.active[:n_nodes] = True
+        self.alive = self.active.copy()
+        # identifiersSeen is append-only: node slots whose identifier has been
+        # used. A rejoin needs a fresh slot (= fresh identifier), exactly as a
+        # real rejoining process draws a fresh UUID (Cluster.java:327-331).
+        self.identifiers_seen: Set[int] = set(np.flatnonzero(self.active))
+        self.seed = seed
+        self.state = initial_state(self.config, self.cluster, self.active, seed=seed)
+        self.virtual_ms = 0
+        self._billed_rounds = 0  # rounds of this configuration already billed
+        self.view_changes: List[ViewChangeRecord] = []
+        # fault plane
+        self._ingress_partitioned: Set[int] = set()
+        self._drop_prob = np.zeros(capacity, dtype=np.float32)
+        self._pending_joiners: Set[int] = set()
+        self._join_reports_armed = False
+
+    # ------------------------------------------------------------------ #
+    # Fault injection (BASELINE.json configs)
+    # ------------------------------------------------------------------ #
+
+    def crash(self, node_ids: np.ndarray) -> None:
+        """Crash-stop burst: nodes stop responding to probes and stop voting."""
+        self.alive[np.atleast_1d(node_ids)] = False
+
+    def revive(self, node_ids: np.ndarray) -> None:
+        """Flip-flop support: nodes become reachable again (cumulative FD
+        counters are deliberately NOT reset -- PingPongFailureDetector.java:116-118)."""
+        node_ids = np.atleast_1d(node_ids)
+        self.alive[node_ids] = self.active[node_ids]
+
+    def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
+        """Asymmetric failure: probes TO these nodes are lost, their own
+        traffic still flows (paper §7, iptables INPUT partitions). Persists
+        across view changes until lifted."""
+        self._ingress_partitioned.update(int(i) for i in np.atleast_1d(node_ids))
+
+    def ingress_loss(self, node_ids: np.ndarray, probability: float) -> None:
+        """Lossy ingress (e.g. 80% loss): probes to these nodes fail with
+        the given probability each round."""
+        self._drop_prob[np.atleast_1d(node_ids)] = probability
+
+    def clear_link_faults(self) -> None:
+        self._ingress_partitioned.clear()
+        self._drop_prob[:] = 0.0
+
+    def _probe_drop_mask(self) -> np.ndarray:
+        """Map the partitioned-destination set onto the current adjacency."""
+        mask = np.zeros(self.config.capacity, dtype=bool)
+        if self._ingress_partitioned:
+            mask[list(self._ingress_partitioned)] = True
+        subjects = np.asarray(self.state.subjects)
+        return mask[subjects]
+
+    # ------------------------------------------------------------------ #
+    # Joins
+    # ------------------------------------------------------------------ #
+
+    def request_joins(self, node_ids: np.ndarray) -> None:
+        """A join wave: each joining slot's K expected observers emit UP
+        alerts with the ring numbers the joiner assigned
+        (MembershipService.java:229-251). Pending joiners re-attempt in every
+        new configuration until admitted."""
+        for node in np.atleast_1d(node_ids):
+            node = int(node)
+            assert not self.active[node], f"node {node} already a member"
+            assert node not in self.identifiers_seen, f"identifier reuse at {node}"
+            self._pending_joiners.add(node)
+        self._join_reports_armed = False
+
+    def _arm_pending_joins(self) -> Optional[np.ndarray]:
+        """Build this configuration's join reports and write each joiner's
+        expected observers into its (otherwise unused) observers row so the
+        implicit-invalidation pass covers joins (MultiNodeCutDetector.java:146-158)."""
+        if not self._pending_joiners or self._join_reports_armed:
+            return None
+        self._join_reports_armed = True
+        k = self.config.k
+        join_reports = np.zeros((self.config.capacity, k), dtype=bool)
+        observers = np.asarray(self.state.observers).copy()
+        for node in sorted(self._pending_joiners):
+            obs_ids, obs_alive = self._expected_observers(node)
+            join_reports[node, :] = obs_alive
+            observers[node, :] = obs_ids
+        self.state = dataclasses.replace(self.state, observers=jnp.asarray(observers))
+        return join_reports
+
+    def _expected_observers(self, node: int) -> Tuple[np.ndarray, np.ndarray]:
+        """The joiner's would-be ring predecessors (MembershipView.java:293-304)
+        and whether each is alive to vouch."""
+        k = self.config.k
+        ids = np.zeros(k, dtype=np.int32)
+        alive = np.zeros(k, dtype=bool)
+        active_idx = np.flatnonzero(self.active)
+        for ring in range(k):
+            hashes = self.cluster.ring_hashes[ring, active_idx].view(np.int64)
+            me = np.int64(self.cluster.ring_hashes[ring, node].view(np.int64))
+            order = np.argsort(hashes, kind="stable")
+            ring_nodes = active_idx[order]
+            sorted_hashes = hashes[order]
+            pos = np.searchsorted(sorted_hashes, me)
+            pred = ring_nodes[pos - 1] if pos > 0 else ring_nodes[-1]
+            ids[ring] = pred
+            alive[ring] = self.alive[pred]
+        return ids, alive
+
+    # ------------------------------------------------------------------ #
+    # Round loop
+    # ------------------------------------------------------------------ #
+
+    def run_until_decision(
+        self, max_rounds: int = 64, batch: int = 8
+    ) -> Optional[ViewChangeRecord]:
+        """Run device batches until consensus decides a cut, then apply the
+        view change. Returns the record, or None if no decision in budget."""
+        t0 = time.perf_counter()
+        rounds_done = 0
+        while rounds_done < max_rounds:
+            join_reports = self._arm_pending_joins()
+            inputs = const_inputs(
+                self.config,
+                self.alive,
+                probe_drop=self._probe_drop_mask(),
+                drop_prob=self._drop_prob,
+                join_reports=join_reports,
+            )
+            n = min(batch, max_rounds - rounds_done)
+            self.state = run_rounds_const(self.config, self.state, inputs, n)
+            rounds_done += n
+            if bool(self.state.decided):
+                return self._apply_view_change(t0)
+        self.virtual_ms += rounds_done * self.config.fd_interval_ms
+        self._billed_rounds += rounds_done
+        return None
+
+    def _apply_view_change(self, t0: float) -> ViewChangeRecord:
+        jax.block_until_ready(self.state.proposal)
+        cut = np.asarray(self.state.proposal)
+        decided_round = int(self.state.decided_round)
+        removed = np.flatnonzero(cut & self.active)
+        added = np.flatnonzero(cut & ~self.active)
+        self.active[removed] = False
+        self.active[added] = True
+        self.alive[added] = True
+        self.identifiers_seen.update(int(i) for i in added)
+        self._pending_joiners.difference_update(int(i) for i in added)
+        self._ingress_partitioned.difference_update(int(i) for i in removed)
+        self._join_reports_armed = False  # still-pending joiners re-attempt
+
+        # protocol-time: only the rounds of this configuration not yet billed,
+        # plus the batching window before the deciding broadcast
+        unbilled = decided_round - self._billed_rounds
+        self.virtual_ms += (
+            unbilled * self.config.fd_interval_ms + self.config.batching_window_ms
+        )
+        self._billed_rounds = 0
+        record = ViewChangeRecord(
+            cut=np.flatnonzero(cut),
+            added=added,
+            removed=removed,
+            configuration_id=self.configuration_id(),
+            virtual_time_ms=self.virtual_ms,
+            wall_time_s=time.perf_counter() - t0,
+            membership_size=int(self.active.sum()),
+        )
+        self.view_changes.append(record)
+        # new configuration: rebuild adjacency, reset per-config state;
+        # crashes persist across configurations
+        self.state = initial_state(
+            self.config, self.cluster, self.active,
+            seed=self.seed + len(self.view_changes),
+        )
+        self.state = dataclasses.replace(
+            self.state, alive=jnp.asarray(self.alive & self.active)
+        )
+        return record
+
+    # ------------------------------------------------------------------ #
+
+    def configuration_id(self) -> int:
+        """Bit-exact configuration identity of the current membership."""
+        ids = np.array(sorted(self.identifiers_seen), dtype=np.int64)
+        # NodeId ordering is (high, low) signed lexicographic
+        high = self.cluster.id_high[ids]
+        low = self.cluster.id_low[ids]
+        order = np.lexsort((low, high))
+        order0 = ring_order(self.cluster, self.active, 0)
+        return configuration_id_vectorized(
+            high[order],
+            low[order],
+            self.cluster.hostnames[order0],
+            self.cluster.host_lengths[order0],
+            self.cluster.ports[order0],
+        )
+
+    @property
+    def membership_size(self) -> int:
+        return int(self.active.sum())
+
+    def members(self) -> np.ndarray:
+        return np.flatnonzero(self.active)

@@ -1,0 +1,271 @@
+"""Device-side data plane: the jitted protocol round step.
+
+This is the TPU execution backend for Rapid's steady-state loop
+(SURVEY.md §3.3, MembershipService.java:297-348): each simulated round
+1. evaluates every monitoring edge's probe (PingPongFailureDetector semantics:
+   cumulative failure counter, threshold 10 -- PingPongFailureDetector.java:40,69-77),
+2. scatters newly-crossed edges as DOWN alerts along the observer->subject
+   adjacency (alert fan-out, MembershipService.java:602-626),
+3. updates the per-destination H/L watermark report table and applies one
+   implicit-invalidation pass (MultiNodeCutDetector.java:76-164),
+4. tallies fast-round votes and decides at the 3/4 supermajority
+   (FastPaxos.java:145-150).
+
+All state lives in capacity-padded arrays (static shapes; membership churn is
+an active-mask update + host-side adjacency rebuild). ``run_rounds`` scans R
+rounds per device dispatch; once ``decided`` latches the remaining rounds are
+masked no-ops, so the host can run large batches without losing the decision
+round. Everything here is elementwise/gather/scatter arithmetic on [C, K]
+arrays -- HBM-bandwidth bound, which is exactly what the TPU vector units eat.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .topology import VirtualCluster, build_adjacency
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Static protocol parameters (hashable; part of the jit cache key)."""
+
+    capacity: int
+    k: int = 10
+    h: int = 9
+    l: int = 4
+    fd_threshold: int = 10  # PingPongFailureDetector.FAILURE_THRESHOLD
+    fd_interval_ms: int = 1000  # MembershipService.java:77
+    batching_window_ms: int = 100  # MembershipService.java:75
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SimState:
+    """Per-round mutable protocol state (a pytree of device arrays)."""
+
+    active: jax.Array  # bool[C] current membership
+    alive: jax.Array  # bool[C] fault-model liveness (crashed => False)
+    subjects: jax.Array  # int32[C, K] monitored node per ring
+    observers: jax.Array  # int32[C, K] monitoring node per ring
+    fd_fail: jax.Array  # int32[C, K] cumulative failed probes per edge
+    alerted: jax.Array  # bool[C, K] edge already reported DOWN
+    reports: jax.Array  # bool[C, K] cut-detector report table (dst, ring)
+    seen_down: jax.Array  # bool[] any DOWN alert this configuration
+    announced: jax.Array  # bool[] proposal announced (consensus started)
+    proposal: jax.Array  # bool[C] latched proposal mask
+    decided: jax.Array  # bool[] consensus reached
+    decided_round: jax.Array  # int32[] round at which decision happened
+    round: jax.Array  # int32[] rounds elapsed in this configuration
+    rng_key: jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class RoundInputs:
+    """Per-round fault-plane inputs (leading axis = rounds when scanned)."""
+
+    alive: jax.Array  # bool[C] liveness this round
+    probe_drop: jax.Array  # bool[C, K] deterministic probe drops (one-way loss)
+    drop_prob: jax.Array  # float32[C] random ingress-loss probability per dst
+    join_reports: jax.Array  # bool[C, K] UP-alert reports for joining slots
+
+
+def initial_state(
+    config: SimConfig,
+    cluster: VirtualCluster,
+    active: np.ndarray,
+    seed: int = 0,
+) -> SimState:
+    subjects, observers = build_adjacency(cluster, active)
+    c, k = config.capacity, config.k
+    return SimState(
+        active=jnp.asarray(active),
+        alive=jnp.asarray(active),
+        subjects=jnp.asarray(subjects),
+        observers=jnp.asarray(observers),
+        fd_fail=jnp.zeros((c, k), jnp.int32),
+        alerted=jnp.zeros((c, k), bool),
+        reports=jnp.zeros((c, k), bool),
+        seen_down=jnp.asarray(False),
+        announced=jnp.asarray(False),
+        proposal=jnp.zeros(c, bool),
+        decided=jnp.asarray(False),
+        decided_round=jnp.asarray(0, jnp.int32),
+        round=jnp.asarray(0, jnp.int32),
+        rng_key=jax.random.PRNGKey(seed),
+    )
+
+
+def _scatter_alerts(
+    reports: jax.Array, subjects: jax.Array, new_alerts: jax.Array
+) -> jax.Array:
+    """OR each observer-edge alert into its (dst, ring) report slot.
+
+    For a fixed ring k, ``subjects[:, k]`` restricted to active nodes is a
+    permutation, so at most one observer reports a given (dst, ring): the
+    scatter-max has no real conflicts.
+    """
+    c, k = reports.shape
+    rows = subjects.reshape(-1)
+    cols = jnp.tile(jnp.arange(k, dtype=jnp.int32), c)
+    return reports.at[rows, cols].max(new_alerts.reshape(-1))
+
+
+def cut_and_tally(
+    config: SimConfig,
+    state: SimState,
+    reports: jax.Array,
+    seen_down: jax.Array,
+    active: jax.Array,
+    alive: jax.Array,
+):
+    """The replicated protocol phase, shared by the single-device and sharded
+    steps: H/L watermark cut detection, one implicit-invalidation pass,
+    proposal emission, and the fast-round vote tally.
+
+    Returns (reports, announced, proposal, decided, decided_round).
+    """
+    # --- cut detection: H/L watermarks ------------------------------------
+    counts = reports.sum(axis=1)
+    in_flux = (counts >= config.l) & (counts < config.h)
+    stable = counts >= config.h
+
+    # One implicit-invalidation pass (per-batch call in the reference,
+    # MembershipService.java:327): edges from observers that are themselves
+    # in flux or stable count as implicit reports. Applies to failing members
+    # (DOWN edges, via their successors) AND to joining slots (UP edges, via
+    # their expected observers -- MultiNodeCutDetector.java:146-158); the
+    # driver writes each joiner's expected observers into its observers row.
+    obs_in_flux = (in_flux | stable)[state.observers]  # [C, K]
+    implicit = seen_down & in_flux[:, None] & obs_in_flux & ~reports
+    reports = reports | implicit
+    counts = reports.sum(axis=1)
+    in_flux = (counts >= config.l) & (counts < config.h)
+    stable = counts >= config.h
+
+    # --- proposal emission (almost-everywhere agreement) -------------------
+    emit = jnp.any(stable) & ~jnp.any(in_flux) & ~state.announced
+    announced = state.announced | emit
+    proposal = jnp.where(emit, stable, state.proposal)
+
+    # --- fast-round vote tally --------------------------------------------
+    # Under uniform alert delivery every live member proposes the same cut, so
+    # the tally is the live-member count; quorum is N - floor((N-1)/4)
+    # (FastPaxos.java:145-150).
+    n = active.sum()
+    voters = (active & alive).sum()
+    quorum = n - (n - 1) // 4
+    decide_now = announced & ~state.decided & (voters >= quorum)
+    decided = state.decided | decide_now
+    decided_round = jnp.where(decide_now, state.round + 1, state.decided_round)
+    return reports, announced, proposal, decided, decided_round
+
+
+def step(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimState:
+    """One protocol round. Pure; jit/scan-friendly."""
+    c, k = config.capacity, config.k
+    halt = state.decided
+
+    key, probe_key = jax.random.split(state.rng_key)
+    active = state.active
+    alive = inputs.alive & active  # membership ∩ fault-model liveness
+
+    # --- failure detection (one probe per monitoring edge per round) -------
+    subj = state.subjects
+    edge_live = active[:, None] & active[subj]  # edge exists in this config
+    observer_up = alive[:, None]
+    target_up = alive[subj]
+    rand_drop = (
+        jax.random.uniform(probe_key, (c, k)) < inputs.drop_prob[subj]
+    )
+    probe_ok = target_up & ~inputs.probe_drop & ~rand_drop
+    fail_event = edge_live & observer_up & ~probe_ok
+    fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
+
+    # --- alert generation + scatter (batched broadcast) --------------------
+    new_down = (
+        edge_live
+        & observer_up
+        & (fd_fail >= config.fd_threshold)
+        & ~state.alerted
+    )
+    alerted = state.alerted | new_down
+    reports = _scatter_alerts(state.reports, subj, new_down)
+    reports = reports | inputs.join_reports
+    seen_down = state.seen_down | jnp.any(new_down)
+
+    reports, announced, proposal, decided, decided_round = cut_and_tally(
+        config, state, reports, seen_down, active, alive
+    )
+
+    new_state = SimState(
+        active=active,
+        alive=inputs.alive,
+        subjects=state.subjects,
+        observers=state.observers,
+        fd_fail=fd_fail,
+        alerted=alerted,
+        reports=reports,
+        seen_down=seen_down,
+        announced=announced,
+        proposal=proposal,
+        decided=decided,
+        decided_round=decided_round,
+        round=state.round + 1,
+        rng_key=key,
+    )
+    # After a decision the configuration is frozen until the host applies the
+    # view change: all updates become no-ops.
+    return jax.tree_util.tree_map(
+        lambda old, new: jnp.where(halt, old, new), state, new_state
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def run_rounds(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimState:
+    """Scan ``step`` over stacked per-round inputs (leading axis = rounds)."""
+
+    def body(carry: SimState, per_round: RoundInputs):
+        return step(config, carry, per_round), ()
+
+    final, _ = jax.lax.scan(body, state, inputs)
+    return final
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def run_rounds_const(
+    config: SimConfig, state: SimState, inputs: RoundInputs, rounds: int
+) -> SimState:
+    """Scan ``rounds`` rounds under a constant fault plane (inputs without a
+    leading rounds axis). Avoids materializing [R, C, K] fault arrays -- the
+    path used for large-capacity runs."""
+
+    def body(carry: SimState, _):
+        return step(config, carry, inputs), ()
+
+    final, _ = jax.lax.scan(body, state, None, length=rounds)
+    return final
+
+
+def const_inputs(
+    config: SimConfig,
+    alive: np.ndarray,
+    probe_drop: Optional[np.ndarray] = None,
+    drop_prob: Optional[np.ndarray] = None,
+    join_reports: Optional[np.ndarray] = None,
+) -> RoundInputs:
+    """A single-round fault plane (for run_rounds_const)."""
+    c, k = config.capacity, config.k
+    return RoundInputs(
+        alive=jnp.asarray(alive),
+        probe_drop=jnp.zeros((c, k), bool) if probe_drop is None else jnp.asarray(probe_drop),
+        drop_prob=jnp.zeros(c, jnp.float32) if drop_prob is None else jnp.asarray(drop_prob),
+        join_reports=jnp.zeros((c, k), bool) if join_reports is None else jnp.asarray(join_reports),
+    )

@@ -1,0 +1,154 @@
+"""Host-side control plane: vectorized ring/adjacency construction.
+
+The sim's equivalent of MembershipView for N up to 100k: all K ring orderings
+are computed at once with the batched xxHash64 (rapid_tpu.hashing.xxh64_batch)
+and numpy argsorts -- bit-identical ordering to the JVM reference's seeded
+TreeSets (Utils.java:211-230), so the observer/subject adjacency and the
+configuration identity of the simulated cluster match what real Rapid nodes
+would compute.
+
+Ring construction happens only at configuration changes (rare); the per-round
+protocol work stays on device (rapid_tpu.sim.engine).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..hashing import endpoint_hash_batch, pack_hostnames, xxh64_batch
+
+_U64 = np.uint64
+
+
+@dataclass
+class VirtualCluster:
+    """Identity of up to ``capacity`` virtual nodes; row index == node id."""
+
+    hostnames: np.ndarray  # [C, max_len] uint8
+    host_lengths: np.ndarray  # [C] int64
+    ports: np.ndarray  # [C] int64
+    id_high: np.ndarray  # [C] int64  (NodeId.high, Java signed)
+    id_low: np.ndarray  # [C] int64
+    # per-ring endpoint hashes, computed once: [K, C] uint64
+    ring_hashes: np.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return len(self.ports)
+
+    @staticmethod
+    def synthesize(capacity: int, k: int, seed: int = 0) -> "VirtualCluster":
+        """Synthetic but *realistic* identities: distinct host:port strings and
+        UUID-style node ids, hashed exactly as the JVM would."""
+        rng = np.random.default_rng(seed)
+        hostnames = [
+            f"10.{i >> 16 & 0xFF}.{i >> 8 & 0xFF}.{i & 0xFF}".encode()
+            for i in range(capacity)
+        ]
+        data, lengths = pack_hostnames(hostnames)
+        ports = np.full(capacity, 5000, dtype=np.int64) + (
+            np.arange(capacity, dtype=np.int64) % 1000
+        )
+        id_high = rng.integers(-(2**63), 2**63, size=capacity, dtype=np.int64)
+        id_low = rng.integers(-(2**63), 2**63, size=capacity, dtype=np.int64)
+        ring_hashes = np.stack(
+            [endpoint_hash_batch(data, lengths, ports, ring) for ring in range(k)]
+        )
+        return VirtualCluster(
+            hostnames=data,
+            host_lengths=lengths,
+            ports=ports,
+            id_high=id_high,
+            id_low=id_low,
+            ring_hashes=ring_hashes,
+        )
+
+
+def build_adjacency(
+    cluster: VirtualCluster, active: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """subjects[C, K] and observers[C, K] over the active membership.
+
+    subjects[i, k] is the ring-k predecessor of node i (the node i monitors,
+    MembershipView.java:309-323); observers[i, k] the ring-k successor
+    (MembershipView.java:235-258). Inactive rows are set to the node itself.
+    """
+    k_rings, capacity = cluster.ring_hashes.shape
+    subjects = np.tile(np.arange(capacity, dtype=np.int32)[:, None], (1, k_rings))
+    observers = subjects.copy()
+    active_idx = np.flatnonzero(active)
+    n = len(active_idx)
+    if n <= 1:
+        return subjects, observers
+    signed = cluster.ring_hashes[:, active_idx].view(np.int64)
+    for ring in range(k_rings):
+        order = np.argsort(signed[ring], kind="stable")  # ring order, signed-hash domain
+        ring_nodes = active_idx[order]
+        preds = np.roll(ring_nodes, 1)
+        succs = np.roll(ring_nodes, -1)
+        subjects[ring_nodes, ring] = preds
+        observers[ring_nodes, ring] = succs
+    return subjects, observers
+
+
+def ring_order(cluster: VirtualCluster, active: np.ndarray, ring: int = 0) -> np.ndarray:
+    """Active node ids in ring-``ring`` order (the reference's getRing)."""
+    active_idx = np.flatnonzero(active)
+    signed = cluster.ring_hashes[ring, active_idx].view(np.int64)
+    return active_idx[np.argsort(signed, kind="stable")]
+
+
+def configuration_id_vectorized(
+    id_high: np.ndarray,
+    id_low: np.ndarray,
+    hostnames: np.ndarray,
+    host_lengths: np.ndarray,
+    ports: np.ndarray,
+) -> int:
+    """Chained configuration hash (MembershipView.java:535-547), vectorized.
+
+    The fold h = h*37 + x_i over m elements equals
+    ``37^m + sum_i x_i * 37^(m-1-i)`` (mod 2^64); with precomputed power
+    ladders this is O(m) vector ops instead of an O(m) Python loop.
+    Inputs must already be ordered: identifiers by NodeId order, endpoints in
+    ring-0 order.
+    """
+    with np.errstate(over="ignore"):
+        id_high_h = xxh64_batch(
+            id_high.astype(np.int64).view(np.uint64)[:, None].view(np.uint8).reshape(-1, 8),
+            np.full(len(id_high), 8, dtype=np.int64),
+            0,
+        )
+        id_low_h = xxh64_batch(
+            id_low.astype(np.int64).view(np.uint64)[:, None].view(np.uint8).reshape(-1, 8),
+            np.full(len(id_low), 8, dtype=np.int64),
+            0,
+        )
+        host_h = xxh64_batch(hostnames, host_lengths, 0)
+        port_bytes = np.zeros((len(ports), 4), dtype=np.uint8)
+        p = ports.astype(np.uint32)
+        for i in range(4):
+            port_bytes[:, i] = ((p >> np.uint32(8 * i)) & np.uint32(0xFF)).astype(np.uint8)
+        port_h = xxh64_batch(port_bytes, np.full(len(ports), 4, dtype=np.int64), 0)
+
+        # interleave: id_high_0, id_low_0, id_high_1, ... then host_0, port_0, ...
+        ids = np.empty(2 * len(id_high), dtype=_U64)
+        ids[0::2] = id_high_h
+        ids[1::2] = id_low_h
+        eps = np.empty(2 * len(ports), dtype=_U64)
+        eps[0::2] = host_h
+        eps[1::2] = port_h
+        xs = np.concatenate([ids, eps])
+        m = len(xs)
+        # pw[t] = 37^t mod 2^64 (uint64 cumprod wraps modulo 2^64)
+        pw = np.ones(m + 1, dtype=_U64)
+        if m:
+            pw[1:] = np.cumprod(np.full(m, 37, dtype=_U64))
+        powers = pw[:m][::-1]  # [37^(m-1), ..., 37^0]
+        # h = 1*37^m + sum x_j * 37^(m-1-j)
+        total = pw[m] + (xs * powers).sum(dtype=_U64)
+    as_signed = int(total.astype(np.int64))
+    return as_signed

@@ -1,13 +1,18 @@
 """Test harness configuration.
 
 Multi-chip sharding is validated on a virtual 8-device CPU mesh (the driver
-dry-runs the real multi-chip path separately); set the platform before any
-jax import.
+dry-runs the real multi-chip path separately). The axon TPU plugin in this
+image overrides JAX_PLATFORMS from the environment, so the platform must be
+forced through jax.config before any test imports jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
