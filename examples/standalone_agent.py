@@ -1,0 +1,73 @@
+"""Standalone cluster agent over the real TCP transport.
+
+Equivalent of the reference's CLI agent (StandaloneAgent.java:94-116): start a
+seed with --listen-address only, or join via --seed-address; subscribes to the
+cluster events and prints the membership once per second.
+
+    python examples/standalone_agent.py --listen-address 127.0.0.1:1234
+    python examples/standalone_agent.py --listen-address 127.0.0.1:1235 \
+        --seed-address 127.0.0.1:1234
+"""
+
+import argparse
+import logging
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+from rapid_tpu import ClusterBuilder, ClusterEvents, Endpoint, Settings
+from rapid_tpu.messaging.tcp import TcpClientServer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="rapid-tpu standalone agent")
+    parser.add_argument("--listen-address", required=True, help="host:port to listen on")
+    parser.add_argument("--seed-address", help="host:port of a seed to join")
+    parser.add_argument("--fd-interval-ms", type=int, default=1000)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    log = logging.getLogger("agent")
+
+    listen = Endpoint.from_string(args.listen_address)
+    settings = Settings(failure_detector_interval_ms=args.fd_interval_ms)
+    transport = TcpClientServer(listen, settings)
+
+    def on_event(name):
+        def callback(configuration_id, changes):
+            log.info("%s config=%d changes=%s", name, configuration_id,
+                     [str(c) for c in changes])
+
+        return callback
+
+    builder = (
+        ClusterBuilder(listen)
+        .use_settings(settings)
+        .set_messaging_client_and_server(transport, transport)
+        .add_subscription(ClusterEvents.VIEW_CHANGE_PROPOSAL, on_event("VIEW_CHANGE_PROPOSAL"))
+        .add_subscription(ClusterEvents.VIEW_CHANGE, on_event("VIEW_CHANGE"))
+        .add_subscription(ClusterEvents.KICKED, on_event("KICKED"))
+    )
+    if args.seed_address:
+        cluster = builder.join(Endpoint.from_string(args.seed_address))
+    else:
+        cluster = builder.start()
+    log.info("agent started at %s", listen)
+
+    try:
+        while True:
+            time.sleep(1)
+            members = cluster.get_memberlist()
+            log.info("membership size=%d members=%s", len(members),
+                     [str(m) for m in members])
+    except KeyboardInterrupt:
+        cluster.leave_gracefully()
+
+
+if __name__ == "__main__":
+    main()

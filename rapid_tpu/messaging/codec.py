@@ -1,0 +1,110 @@
+"""Binary wire codec for the framed TCP transport.
+
+The reference's alternative transport serializes a WrappedRapidRequest
+{long reqNo, RapidRequest} with Java object streams over length-prefixed TCP
+frames (NettyClientServer.java:283-303). Here the envelope is
+``(request_no: u64, type_tag: u8, msgpack payload)`` inside a u32
+length-prefixed frame -- compact, language-neutral, and with explicit type
+tags playing the role of the reference's protobuf ``oneof`` envelope
+(rapid.proto:21-45).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Tuple, Type
+
+import msgpack
+
+from .. import types as T
+
+# stable wire tags per message type (appending only; never renumber)
+_TYPES: Tuple[Type, ...] = (
+    T.PreJoinMessage,  # 0
+    T.JoinMessage,  # 1
+    T.JoinResponse,  # 2
+    T.BatchedAlertMessage,  # 3
+    T.AlertMessage,  # 4
+    T.ProbeMessage,  # 5
+    T.ProbeResponse,  # 6
+    T.FastRoundPhase2bMessage,  # 7
+    T.Phase1aMessage,  # 8
+    T.Phase1bMessage,  # 9
+    T.Phase2aMessage,  # 10
+    T.Phase2bMessage,  # 11
+    T.LeaveMessage,  # 12
+    T.Response,  # 13
+    T.ConsensusResponse,  # 14
+)
+_TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
+
+HEADER = struct.Struct("!I")  # frame length
+ENVELOPE = struct.Struct("!QB")  # request number, type tag
+
+
+def _enc(obj: Any) -> Any:
+    if isinstance(obj, T.Endpoint):
+        return {"__ep": [obj.hostname, obj.port]}
+    if isinstance(obj, T.NodeId):
+        return {"__id": [obj.high, obj.low]}
+    if isinstance(obj, T.Rank):
+        return {"__rk": [obj.round, obj.node_index]}
+    if isinstance(obj, (T.EdgeStatus, T.JoinStatusCode, T.NodeStatus)):
+        return {"__en": [type(obj).__name__, int(obj)]}
+    if isinstance(obj, tuple):
+        return [_enc(x) for x in obj]
+    if isinstance(obj, T.AlertMessage):
+        return {"__al": {k: _enc(v) for k, v in _fields_of(obj).items()}}
+    if isinstance(obj, dict):
+        return {k: _enc(v) for k, v in obj.items()}
+    return obj
+
+
+_ENUMS = {"EdgeStatus": T.EdgeStatus, "JoinStatusCode": T.JoinStatusCode,
+          "NodeStatus": T.NodeStatus}
+
+
+def _dec(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if "__ep" in obj:
+            host, port = obj["__ep"]
+            return T.Endpoint(bytes(host), int(port))
+        if "__id" in obj:
+            return T.NodeId(*obj["__id"])
+        if "__rk" in obj:
+            return T.Rank(*obj["__rk"])
+        if "__en" in obj:
+            name, value = obj["__en"]
+            return _ENUMS[name](value)
+        if "__al" in obj:
+            return T.AlertMessage(**{k: _tupled(_dec(v)) for k, v in obj["__al"].items()})
+        return {k: _dec(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_dec(x) for x in obj]
+    return obj
+
+
+def _fields_of(msg: Any) -> Dict[str, Any]:
+    return {name: getattr(msg, name) for name in msg.__dataclass_fields__}
+
+
+def _tupled(value: Any) -> Any:
+    """dataclass fields that are tuples on the way in come back as lists."""
+    if isinstance(value, list):
+        return tuple(_tupled(v) for v in value)
+    return value
+
+
+def encode(request_no: int, msg: Any) -> bytes:
+    tag = _TAG_OF[type(msg)]
+    payload = {k: _enc(v) for k, v in _fields_of(msg).items()}
+    body = msgpack.packb(payload, use_bin_type=True)
+    return ENVELOPE.pack(request_no, tag) + body
+
+
+def decode(frame: bytes) -> Tuple[int, Any]:
+    request_no, tag = ENVELOPE.unpack_from(frame)
+    cls = _TYPES[tag]
+    raw = msgpack.unpackb(frame[ENVELOPE.size :], raw=False)
+    kwargs = {name: _tupled(_dec(value)) for name, value in raw.items()}
+    return request_no, cls(**kwargs)

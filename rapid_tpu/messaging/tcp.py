@@ -1,0 +1,250 @@
+"""Real-socket TCP transport: length-prefixed frames, request/response
+correlation, connection reuse.
+
+The analogue of the reference's raw-Netty alternative transport
+(NettyClientServer.java): one class implements both IMessagingClient and
+IMessagingServer (:65); responses are matched to requests via a per-connection
+request number (:267-277); outbound channels are cached per remote. Framing
+and payload encoding live in rapid_tpu.messaging.codec.
+
+Built on threads + blocking sockets (one reader thread per connection): the
+protocol's fan-out is K-bounded per node, so a node talks to tens of peers,
+not thousands. Used by the standalone agent and the multi-process
+integration tests (tier 3 of the test strategy, SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..runtime.futures import Promise
+from ..settings import Settings
+from ..types import Endpoint, NodeStatus, ProbeMessage, ProbeResponse, RapidMessage
+from .base import IMessagingClient, IMessagingServer
+from .codec import HEADER, decode, encode
+from .retries import call_with_retries
+
+LOG = logging.getLogger(__name__)
+
+
+def _read_exactly(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_frame(sock: socket.socket) -> Optional[bytes]:
+    header = _read_exactly(sock, HEADER.size)
+    if header is None:
+        return None
+    (length,) = HEADER.unpack(header)
+    if length > 64 * 1024 * 1024:
+        raise ValueError(f"oversized frame: {length}")
+    return _read_exactly(sock, length)
+
+
+def _write_frame(sock: socket.socket, frame: bytes) -> None:
+    sock.sendall(HEADER.pack(len(frame)) + frame)
+
+
+class _Connection:
+    """One outbound connection: writer + response-correlating reader."""
+
+    def __init__(self, remote: Endpoint, timeout_s: float) -> None:
+        self.sock = socket.create_connection(
+            (remote.hostname.decode(), remote.port), timeout=timeout_s
+        )
+        self.sock.settimeout(None)
+        self.lock = threading.Lock()
+        self.outstanding: Dict[int, Promise] = {}
+        self.closed = False
+        self.reader = threading.Thread(
+            target=self._read_loop, name=f"tcp-client-{remote}", daemon=True
+        )
+        self.reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = _read_frame(self.sock)
+                if frame is None:
+                    break
+                request_no, response = decode(frame)
+                with self.lock:
+                    promise = self.outstanding.pop(request_no, None)
+                if promise is not None:
+                    promise.try_set_result(response)
+        except (OSError, ValueError):
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        with self.lock:
+            if self.closed:
+                return
+            self.closed = True
+            pending = list(self.outstanding.values())
+            self.outstanding.clear()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        for promise in pending:
+            if not promise.done():
+                try:
+                    promise.set_exception(ConnectionError("connection closed"))
+                except Exception:  # noqa: BLE001 -- lost race with completion
+                    pass
+
+
+class TcpClientServer(IMessagingClient, IMessagingServer):
+    """Both halves of the transport in one object, like the reference's
+    NettyClientServer."""
+
+    def __init__(self, listen_address: Endpoint, settings: Optional[Settings] = None) -> None:
+        self.address = listen_address
+        self._settings = settings if settings is not None else Settings()
+        self._service = None
+        self._request_no = itertools.count()
+        self._connections: Dict[Endpoint, _Connection] = {}
+        self._conn_lock = threading.Lock()
+        self._server_sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # -- server side ---------------------------------------------------------
+
+    def start(self) -> None:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.address.hostname.decode(), self.address.port))
+        sock.listen(128)
+        self._server_sock = sock
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"tcp-server-{self.address}", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        assert self._server_sock is not None
+        while self._running:
+            try:
+                conn, _ = self._server_sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_connection, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_connection(self, sock: socket.socket) -> None:
+        write_lock = threading.Lock()
+        try:
+            while True:
+                frame = _read_frame(sock)
+                if frame is None:
+                    return
+                request_no, msg = decode(frame)
+                self._dispatch(msg).add_callback(
+                    lambda p, rn=request_no: self._reply(sock, write_lock, rn, p)
+                )
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _reply(self, sock: socket.socket, write_lock: threading.Lock,
+               request_no: int, promise: Promise) -> None:
+        if promise.exception() is not None:
+            return  # no response; the caller's deadline handles it
+        response = promise._result  # noqa: SLF001
+        if response is None:
+            return
+        try:
+            with write_lock:
+                _write_frame(sock, encode(request_no, response))
+        except OSError:
+            pass
+
+    def _dispatch(self, msg: RapidMessage) -> Promise:
+        service = self._service
+        if service is None:
+            if isinstance(msg, ProbeMessage):
+                return Promise.completed(ProbeResponse(NodeStatus.BOOTSTRAPPING))
+            return Promise()  # dropped until the service is wired
+        try:
+            return service.handle_message(msg)
+        except Exception as e:  # noqa: BLE001
+            return Promise.failed(e)
+
+    def set_membership_service(self, service) -> None:
+        self._service = service
+
+    # -- client side ---------------------------------------------------------
+
+    def _connection(self, remote: Endpoint) -> _Connection:
+        with self._conn_lock:
+            conn = self._connections.get(remote)
+            if conn is None or conn.closed:
+                conn = _Connection(remote, self._settings.message_timeout_ms / 1000.0)
+                self._connections[remote] = conn
+            return conn
+
+    def _send_once(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        out: Promise = Promise()
+        try:
+            conn = self._connection(remote)
+            request_no = next(self._request_no)
+            with conn.lock:
+                conn.outstanding[request_no] = out
+            _write_frame(conn.sock, encode(request_no, msg))
+        except OSError as e:
+            if not out.done():
+                out.set_exception(e)
+            return out
+        timeout_s = self._settings.timeout_for(msg) / 1000.0
+        timer = threading.Timer(
+            timeout_s,
+            lambda: out.done()
+            or out.set_exception(TimeoutError(f"no response from {remote}")),
+        )
+        timer.daemon = True
+        timer.start()
+        out.add_callback(lambda _: timer.cancel())
+        return out
+
+    def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        return call_with_retries(
+            lambda: self._send_once(remote, msg), self._settings.message_retries
+        )
+
+    def send_message_best_effort(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        return self._send_once(remote, msg)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._running = False
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            connections = list(self._connections.values())
+            self._connections.clear()
+        for conn in connections:
+            conn.close()

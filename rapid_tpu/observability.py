@@ -1,0 +1,94 @@
+"""Tracing and metrics.
+
+The reference has no tracing/metrics subsystem (SURVEY.md §5.1: jacoco +
+surefire wall-times only; §5.5: four subscription events are the whole
+observable surface). Since this framework's headline metric is
+time-to-stable-view, observability is first-class here:
+
+- ``Metrics``: cheap named counters, used by the protocol plane (messages by
+  type, alerts, proposals, view changes) and the simulator (rounds, device
+  dispatches).
+- ``Tracer``: wall/virtual-time spans with a single flat log, suitable for
+  both the event-driven protocol plane and the round-driven simulator.
+- ``device_trace``: context manager around jax.profiler for capturing a TPU
+  trace of the simulation hot loop (view in TensorBoard/XProf).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+
+class Metrics:
+    """Process-wide counter registry (per-Cluster instances get their own)."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, int] = collections.defaultdict(int)
+
+    def incr(self, name: str, amount: int = 1) -> None:
+        self._counters[name] += amount
+
+    def get(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        return dict(self._counters)
+
+    def reset(self) -> None:
+        self._counters.clear()
+
+
+@dataclass
+class Span:
+    name: str
+    wall_start_s: float
+    wall_end_s: float = 0.0
+    virtual_start_ms: Optional[int] = None
+    virtual_end_ms: Optional[int] = None
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def wall_ms(self) -> float:
+        return (self.wall_end_s - self.wall_start_s) * 1000.0
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self.spans: List[Span] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, virtual_ms: Optional[int] = None, **attrs) -> Iterator[Span]:
+        s = Span(name=name, wall_start_s=time.perf_counter(),
+                 virtual_start_ms=virtual_ms, attrs=dict(attrs))
+        try:
+            yield s
+        finally:
+            s.wall_end_s = time.perf_counter()
+            self.spans.append(s)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-name aggregate: count, total/mean wall ms."""
+        agg: Dict[str, Dict[str, float]] = {}
+        for s in self.spans:
+            entry = agg.setdefault(s.name, {"count": 0, "total_ms": 0.0})
+            entry["count"] += 1
+            entry["total_ms"] += s.wall_ms
+        for entry in agg.values():
+            entry["mean_ms"] = entry["total_ms"] / entry["count"]
+        return agg
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax/XLA profiler trace of everything inside the block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

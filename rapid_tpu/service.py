@@ -26,6 +26,7 @@ from .messaging.base import IBroadcaster, IMessagingClient
 from .messaging.unicast import UnicastToAllBroadcaster
 from .metadata import FrozenMetadata, MetadataManager
 from .monitoring.base import IEdgeFailureDetectorFactory
+from .observability import Metrics
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
 from .runtime.scheduler import ScheduledTask
@@ -99,6 +100,7 @@ class MembershipService:
             for event, callbacks in subscriptions.items():
                 self._subscriptions[event].extend(callbacks)
 
+        self.metrics = Metrics()
         self._joiners_to_respond_to: Dict[Endpoint, List[Promise]] = {}
         self._joiner_uuid: Dict[Endpoint, NodeId] = {}
         self._joiner_metadata: Dict[Endpoint, FrozenMetadata] = {}
@@ -129,6 +131,7 @@ class MembershipService:
     # ------------------------------------------------------------------ #
 
     def handle_message(self, msg: RapidMessage) -> Promise:
+        self.metrics.incr(f"messages.{type(msg).__name__}")
         if isinstance(msg, PreJoinMessage):
             return self._handle_pre_join(msg)
         if isinstance(msg, JoinMessage):
@@ -249,6 +252,7 @@ class MembershipService:
             proposal.update(self._cut_detection.invalidate_failing_edges(self._view))
             if proposal:
                 self._announced_proposal = True
+                self.metrics.incr("proposals")
                 changes = self._node_status_changes(proposal)
                 self._fire(
                     ClusterEvents.VIEW_CHANGE_PROPOSAL, current_configuration_id, changes
@@ -313,6 +317,7 @@ class MembershipService:
                 status_changes.append(NodeStatusChange(node, EdgeStatus.UP, metadata))
 
         configuration_id = self._view.get_current_configuration_id()
+        self.metrics.incr("view_changes")
         self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, status_changes)
 
         self._cut_detection.clear()
@@ -407,6 +412,7 @@ class MembershipService:
     # ------------------------------------------------------------------ #
 
     def _enqueue_alert(self, msg: AlertMessage) -> None:
+        self.metrics.incr("alerts_enqueued")
         self._last_enqueue_ms = self._scheduler.now_ms()
         self._alert_send_queue.append(msg)
 

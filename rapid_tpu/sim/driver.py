@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import Metrics, Tracer
 from .engine import (
     SimConfig,
     SimState,
@@ -51,6 +52,7 @@ class ViewChangeRecord:
     virtual_time_ms: int  # protocol-time of the decision
     wall_time_s: float  # host+device time spent simulating to it
     membership_size: int
+    via_classic_round: bool = False  # decided by the Paxos fallback
 
 
 class Simulator:
@@ -78,6 +80,8 @@ class Simulator:
         self.virtual_ms = 0
         self._billed_rounds = 0  # rounds of this configuration already billed
         self.view_changes: List[ViewChangeRecord] = []
+        self.metrics = Metrics()
+        self.tracer = Tracer()
         # fault plane
         self._ingress_partitioned: Set[int] = set()
         self._drop_prob = np.zeros(capacity, dtype=np.float32)
@@ -178,12 +182,22 @@ class Simulator:
     # ------------------------------------------------------------------ #
 
     def run_until_decision(
-        self, max_rounds: int = 64, batch: int = 8
+        self, max_rounds: int = 64, batch: int = 8,
+        classic_fallback_after_rounds: Optional[int] = 8,
     ) -> Optional[ViewChangeRecord]:
         """Run device batches until consensus decides a cut, then apply the
-        view change. Returns the record, or None if no decision in budget."""
+        view change. Returns the record, or None if no decision in budget.
+
+        If the fast round stalls (proposal announced but the 3/4 supermajority
+        is unreachable, e.g. too many members crashed to vote) for
+        ``classic_fallback_after_rounds`` rounds, the host runs the classic
+        Paxos recovery round among the live members (FastPaxos.java:189-195):
+        every live acceptor voted the identical proposal in the fast round, so
+        the coordinator rule picks it, and it decides iff live members form a
+        majority (> N/2, Paxos.java:168,229)."""
         t0 = time.perf_counter()
         rounds_done = 0
+        announced_for = 0
         while rounds_done < max_rounds:
             join_reports = self._arm_pending_joins()
             inputs = const_inputs(
@@ -194,15 +208,41 @@ class Simulator:
                 join_reports=join_reports,
             )
             n = min(batch, max_rounds - rounds_done)
-            self.state = run_rounds_const(self.config, self.state, inputs, n)
+            with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
+                self.state = run_rounds_const(self.config, self.state, inputs, n)
+                decided = bool(self.state.decided)  # syncs the device batch
+            self.metrics.incr("rounds", n)
+            self.metrics.incr("device_dispatches")
             rounds_done += n
-            if bool(self.state.decided):
+            if decided:
                 return self._apply_view_change(t0)
+            if bool(self.state.announced):
+                announced_for += n
+                if (
+                    classic_fallback_after_rounds is not None
+                    and announced_for >= classic_fallback_after_rounds
+                    and self._classic_round_decides()
+                ):
+                    self.state = dataclasses.replace(
+                        self.state, decided=jnp.asarray(True),
+                        decided_round=self.state.round,
+                    )
+                    record = self._apply_view_change(t0)
+                    record.via_classic_round = True
+                    return record
         self.virtual_ms += rounds_done * self.config.fd_interval_ms
         self._billed_rounds += rounds_done
         return None
 
+    def _classic_round_decides(self) -> bool:
+        """Classic-round quorum check: live members must form a majority of
+        the current configuration."""
+        n = int(self.active.sum())
+        live = int((self.active & self.alive).sum())
+        return live > n // 2
+
     def _apply_view_change(self, t0: float) -> ViewChangeRecord:
+        self.metrics.incr("view_changes")
         jax.block_until_ready(self.state.proposal)
         cut = np.asarray(self.state.proposal)
         decided_round = int(self.state.decided_round)

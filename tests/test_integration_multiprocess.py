@@ -1,0 +1,94 @@
+"""Tier-3 integration: real OS processes running the standalone agent over
+real sockets, mirroring the reference's multi-JVM harness
+(RapidNodeRunner.runNode, RapidNodeRunner.java:64-87: shell out the agent,
+redirect output, assert liveness and convergence, reap processes).
+"""
+
+import os
+import random
+import re
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+AGENT = REPO / "examples" / "standalone_agent.py"
+
+
+class AgentRunner:
+    """RapidNodeRunner equivalent: launches and reaps agent processes."""
+
+    def __init__(self, tmpdir: Path):
+        self.tmpdir = tmpdir
+        self.procs = []
+
+    def run_node(self, listen: str, seed: str = None, fd_interval_ms: int = 100):
+        log_path = self.tmpdir / f"agent-{listen.replace(':', '-')}.log"
+        cmd = [sys.executable, str(AGENT), "--listen-address", listen,
+               "--fd-interval-ms", str(fd_interval_ms)]
+        if seed:
+            cmd += ["--seed-address", seed]
+        log = open(log_path, "w")
+        env = dict(os.environ, PYTHONUNBUFFERED="1")
+        proc = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT, env=env, cwd=str(REPO)
+        )
+        self.procs.append((proc, log_path))
+        return proc, log_path
+
+    def kill_all(self):
+        for proc, _ in self.procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+        for proc, _ in self.procs:
+            proc.wait(timeout=10)
+
+
+@pytest.fixture
+def runner(tmp_path):
+    r = AgentRunner(tmp_path)
+    yield r
+    r.kill_all()
+
+
+def wait_for_membership(log_path: Path, size: int, timeout_s: float = 30) -> bool:
+    pattern = re.compile(rf"membership size={size}\b")
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if log_path.exists() and pattern.search(log_path.read_text()):
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_single_agent_liveness(runner):
+    """RapidNodeRunnerTest.java:27-38."""
+    port = random.randint(21000, 29000)
+    proc, log = runner.run_node(f"127.0.0.1:{port}")
+    assert wait_for_membership(log, 1, 20), log.read_text()
+    assert proc.poll() is None
+
+
+def test_three_agents_converge(runner):
+    """Seed + 2 joiners in separate OS processes converge to size 3; killing
+    one converges the survivors to size 2."""
+    base = random.randint(30000, 39000)
+    seed_addr = f"127.0.0.1:{base}"
+    _, seed_log = runner.run_node(seed_addr)
+    assert wait_for_membership(seed_log, 1, 20)
+    _, log1 = runner.run_node(f"127.0.0.1:{base + 1}", seed=seed_addr)
+    assert wait_for_membership(log1, 2, 30), log1.read_text()
+    _, log2 = runner.run_node(f"127.0.0.1:{base + 2}", seed=seed_addr)
+    for log in (seed_log, log1, log2):
+        assert wait_for_membership(log, 3, 30), log.read_text()
+
+    # crash the last joiner; survivors must converge to 2
+    victim_proc, _ = runner.procs[-1]
+    victim_proc.send_signal(signal.SIGKILL)
+    victim_proc.wait(timeout=10)
+    assert wait_for_membership(seed_log, 2, 60), seed_log.read_text()[-2000:]
+    assert wait_for_membership(log1, 2, 60)

@@ -1,0 +1,58 @@
+"""Tracing/metrics subsystem."""
+
+import numpy as np
+
+from rapid_tpu.observability import Metrics, Tracer
+from rapid_tpu.sim.driver import Simulator
+
+from harness import ClusterHarness
+
+
+def test_metrics_counters():
+    m = Metrics()
+    m.incr("a")
+    m.incr("a", 2)
+    assert m.get("a") == 3
+    assert m.get("missing") == 0
+    assert m.snapshot() == {"a": 3}
+    m.reset()
+    assert m.snapshot() == {}
+
+
+def test_tracer_spans_and_summary():
+    t = Tracer()
+    with t.span("phase", virtual_ms=5, rounds=2) as s:
+        pass
+    with t.span("phase"):
+        pass
+    summary = t.summary()
+    assert summary["phase"]["count"] == 2
+    assert summary["phase"]["total_ms"] >= 0
+    assert t.spans[0].attrs == {"rounds": 2}
+
+
+def test_simulator_records_metrics_and_spans():
+    sim = Simulator(10, seed=1)
+    sim.crash(np.array([3]))
+    rec = sim.run_until_decision(max_rounds=40)
+    assert rec is not None
+    snap = sim.metrics.snapshot()
+    assert snap["view_changes"] == 1
+    assert snap["rounds"] >= 10
+    assert snap["device_dispatches"] >= 1
+    assert sim.tracer.summary()["device_rounds"]["count"] >= 1
+
+
+def test_service_metrics():
+    h = ClusterHarness(seed=1)
+    try:
+        seed = h.start_seed()
+        h.join(1)
+        h.wait_and_verify_agreement(2)
+        snap = seed._membership_service.metrics.snapshot()
+        assert snap["view_changes"] >= 1
+        assert snap["proposals"] >= 1
+        assert snap["alerts_enqueued"] >= 1
+        assert any(k.startswith("messages.") for k in snap)
+    finally:
+        h.shutdown()

@@ -225,3 +225,23 @@ def test_two_join_requests_both_delivered():
     rec = sim.run_until_decision(max_rounds=10)
     assert rec is not None
     assert set(rec.added) == {20, 21}
+
+
+def test_classic_paxos_fallback_when_fast_quorum_unreachable():
+    """N=8, 2 crashed: fast-round quorum is 7 but only 6 can vote -- the
+    classic recovery round among the live majority must decide the cut
+    (FastPaxos.java:189-195, Paxos.java:97-236)."""
+    sim = Simulator(8, seed=11)
+    victims = np.array([6, 7])
+    sim.crash(victims)
+    rec = sim.run_until_decision(max_rounds=40)
+    assert rec is not None, "fallback did not decide"
+    assert rec.via_classic_round
+    assert set(rec.cut) == set(victims)
+    assert sim.membership_size == 6
+
+    # with the fallback disabled, the same scenario stalls
+    sim2 = Simulator(8, seed=11)
+    sim2.crash(victims)
+    rec2 = sim2.run_until_decision(max_rounds=40, classic_fallback_after_rounds=None)
+    assert rec2 is None

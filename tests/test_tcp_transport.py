@@ -1,0 +1,219 @@
+"""Real-socket TCP transport: codec roundtrips, request/response correlation
+under load, and a live real-time cluster -- mirroring NettyClientServerTest
+(100 clients -> 1 server, 1 client -> N servers) and the tier-3 strategy.
+"""
+
+import threading
+
+import pytest
+
+from rapid_tpu import ClusterBuilder, Endpoint, NodeId, Settings
+from rapid_tpu.messaging import codec
+from rapid_tpu.messaging.tcp import TcpClientServer
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    EdgeStatus,
+    FastRoundPhase2bMessage,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    NodeStatus,
+    Phase1bMessage,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    Rank,
+    Response,
+)
+
+EP1 = Endpoint.from_parts("127.0.0.1", 7101)
+EP2 = Endpoint.from_parts("127.0.0.1", 7102)
+NID = NodeId(123456789, -987654321)
+
+
+ROUNDTRIP_MESSAGES = [
+    PreJoinMessage(sender=EP1, node_id=NID),
+    JoinMessage(sender=EP1, node_id=NID, ring_numbers=(0, 3, 9),
+                configuration_id=-5, metadata=(("role", b"backend"),)),
+    JoinResponse(sender=EP2, status_code=JoinStatusCode.SAFE_TO_JOIN,
+                 configuration_id=42, endpoints=(EP1, EP2), identifiers=(NID,),
+                 metadata=((EP1, (("k", b"v"),)),)),
+    BatchedAlertMessage(sender=EP1, messages=(
+        AlertMessage(edge_src=EP1, edge_dst=EP2, edge_status=EdgeStatus.DOWN,
+                     configuration_id=7, ring_numbers=(1, 2)),
+        AlertMessage(edge_src=EP2, edge_dst=EP1, edge_status=EdgeStatus.UP,
+                     configuration_id=7, ring_numbers=(0,), node_id=NID,
+                     metadata=(("a", b"b"),)),
+    )),
+    ProbeMessage(sender=EP1),
+    ProbeResponse(NodeStatus.BOOTSTRAPPING),
+    FastRoundPhase2bMessage(sender=EP1, configuration_id=9, endpoints=(EP1, EP2)),
+    Phase1bMessage(sender=EP2, configuration_id=9, rnd=Rank(2, -7),
+                   vrnd=Rank(1, 1), vval=(EP1,)),
+    Response(),
+]
+
+
+@pytest.mark.parametrize("msg", ROUNDTRIP_MESSAGES, ids=lambda m: type(m).__name__)
+def test_codec_roundtrip(msg):
+    request_no, decoded = codec.decode(codec.encode(77, msg))
+    assert request_no == 77
+    assert decoded == msg
+
+
+class EchoService:
+    """Answers probes; counts messages."""
+
+    def __init__(self):
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def handle_message(self, msg):
+        with self.lock:
+            self.count += 1
+        if isinstance(msg, ProbeMessage):
+            return Promise.completed(ProbeResponse(NodeStatus.OK))
+        return Promise.completed(Response())
+
+
+@pytest.fixture
+def port_base():
+    # spread ports across tests to dodge TIME_WAIT
+    import random
+
+    return random.randint(20000, 50000)
+
+
+def test_many_clients_one_server(port_base):
+    """NettyClientServerTest.java:41-81 (100 clients -> 1 server)."""
+    server_addr = Endpoint.from_parts("127.0.0.1", port_base)
+    server = TcpClientServer(server_addr)
+    service = EchoService()
+    server.set_membership_service(service)
+    server.start()
+    try:
+        clients = [
+            TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 1 + i))
+            for i in range(20)
+        ]
+        promises = [
+            c.send_message(server_addr, ProbeMessage(sender=c.address))
+            for c in clients
+            for _ in range(5)
+        ]
+        for p in promises:
+            assert p.result(10) == ProbeResponse(NodeStatus.OK)
+        assert service.count == 100
+        for c in clients:
+            c.shutdown()
+    finally:
+        server.shutdown()
+
+
+def test_one_client_many_servers(port_base):
+    """NettyClientServerTest.java:83-117."""
+    servers = []
+    for i in range(10):
+        addr = Endpoint.from_parts("127.0.0.1", port_base + i)
+        server = TcpClientServer(addr)
+        server.set_membership_service(EchoService())
+        server.start()
+        servers.append(server)
+    client = TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 100))
+    try:
+        promises = [
+            client.send_message(s.address, ProbeMessage(sender=client.address))
+            for s in servers
+        ]
+        for p in promises:
+            assert p.result(10) == ProbeResponse(NodeStatus.OK)
+    finally:
+        client.shutdown()
+        for s in servers:
+            s.shutdown()
+
+
+def test_bootstrapping_before_service_wired(port_base):
+    """Probes answered BOOTSTRAPPING before set_membership_service
+    (GrpcServer.java:83-95 semantics over TCP)."""
+    addr = Endpoint.from_parts("127.0.0.1", port_base)
+    server = TcpClientServer(addr)
+    server.start()
+    client = TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 1))
+    try:
+        p = client.send_message_best_effort(addr, ProbeMessage(sender=client.address))
+        assert p.result(10) == ProbeResponse(NodeStatus.BOOTSTRAPPING)
+        # non-probe messages are dropped (sender times out)
+        settings = Settings(message_timeout_ms=200)
+        fast_client = TcpClientServer(
+            Endpoint.from_parts("127.0.0.1", port_base + 2), settings
+        )
+        p2 = fast_client.send_message_best_effort(
+            addr, PreJoinMessage(sender=fast_client.address, node_id=NID)
+        )
+        with pytest.raises(TimeoutError):
+            p2.result(5)
+        fast_client.shutdown()
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_real_time_tcp_cluster(port_base):
+    """A live 3-node cluster over real sockets and the real-time scheduler:
+    join, converge, crash one, converge again."""
+    blacklist = set()
+    settings = Settings(
+        failure_detector_interval_ms=30,
+        batching_window_ms=10,
+        consensus_fallback_base_delay_ms=200,
+    )
+
+    def build(i, seed=None):
+        addr = Endpoint.from_parts("127.0.0.1", port_base + i)
+        transport = TcpClientServer(addr, settings)
+        builder = (
+            ClusterBuilder(addr)
+            .use_settings(settings)
+            .set_messaging_client_and_server(transport, transport)
+            .set_edge_failure_detector_factory(StaticFailureDetectorFactory(blacklist))
+        )
+        if seed is None:
+            return builder.start()
+        return builder.join(seed, timeout=30)
+
+    seed = build(0)
+    c1 = build(1, seed.listen_address)
+    c2 = build(2, seed.listen_address)
+    try:
+        import time
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (
+                seed.get_membership_size()
+                == c1.get_membership_size()
+                == c2.get_membership_size()
+                == 3
+            ):
+                break
+            time.sleep(0.05)
+        assert seed.get_membership_size() == 3
+        assert seed.get_memberlist() == c1.get_memberlist() == c2.get_memberlist()
+
+        # crash c2
+        blacklist.add(c2.listen_address)
+        c2.shutdown()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if seed.get_membership_size() == 2 and c1.get_membership_size() == 2:
+                break
+            time.sleep(0.05)
+        assert seed.get_membership_size() == 2
+        assert c1.get_membership_size() == 2
+    finally:
+        seed.shutdown()
+        c1.shutdown()
