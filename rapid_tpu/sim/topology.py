@@ -54,9 +54,13 @@ class VirtualCluster:
         )
         id_high = rng.integers(-(2**63), 2**63, size=capacity, dtype=np.int64)
         id_low = rng.integers(-(2**63), 2**63, size=capacity, dtype=np.int64)
-        ring_hashes = np.stack(
-            [endpoint_hash_batch(data, lengths, ports, ring) for ring in range(k)]
-        )
+        from .. import native
+
+        ring_hashes = native.ring_hashes(data, lengths, ports, k)
+        if ring_hashes is None:
+            ring_hashes = np.stack(
+                [endpoint_hash_batch(data, lengths, ports, ring) for ring in range(k)]
+            )
         return VirtualCluster(
             hostnames=data,
             host_lengths=lengths,
@@ -76,6 +80,12 @@ def build_adjacency(
     MembershipView.java:309-323); observers[i, k] the ring-k successor
     (MembershipView.java:235-258). Inactive rows are set to the node itself.
     """
+    from .. import native
+
+    native_result = native.build_adjacency(cluster.ring_hashes, active)
+    if native_result is not None:
+        return native_result
+
     k_rings, capacity = cluster.ring_hashes.shape
     subjects = np.tile(np.arange(capacity, dtype=np.int32)[:, None], (1, k_rings))
     observers = subjects.copy()

@@ -1,0 +1,71 @@
+"""Native (C++) control plane: bit-exactness against the pure-Python xxHash64
+and the numpy adjacency builder. Skipped when no toolchain can build the
+library (the framework falls back to numpy everywhere).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from rapid_tpu import native
+from rapid_tpu.hashing import endpoint_hash_batch, pack_hostnames, xxh64
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain?)"
+)
+
+
+def test_native_xxh64_bit_exact():
+    rng = random.Random(3)
+    samples = [bytes(rng.randrange(256) for _ in range(n)) for n in range(0, 80)]
+    data, lengths = pack_hostnames(samples)
+    for seed in (0, 5, 2**31 - 1, 2**64 - 3):
+        out = native.xxh64_batch(data, lengths, seed)
+        ref = np.array([xxh64(s, seed) for s in samples], dtype=np.uint64)
+        assert np.array_equal(out, ref)
+
+
+def test_native_ring_hashes_match_numpy():
+    hosts = [f"host-{i}.example".encode() for i in range(500)]
+    ports = np.arange(500, dtype=np.int64) + 4000
+    data, lengths = pack_hostnames(hosts)
+    out = native.ring_hashes(data, lengths, ports, 10)
+    ref = np.stack([endpoint_hash_batch(data, lengths, ports, k) for k in range(10)])
+    assert np.array_equal(out, ref)
+
+
+def test_native_adjacency_matches_membership_view():
+    """End to end through VirtualCluster (which now prefers the native path):
+    adjacency must still match the object-model MembershipView."""
+    from rapid_tpu.membership import MembershipView
+    from rapid_tpu.sim.topology import VirtualCluster, build_adjacency
+    from rapid_tpu.types import Endpoint, NodeId
+
+    k = 10
+    vc = VirtualCluster.synthesize(40, k, seed=4)
+    active = np.ones(40, dtype=bool)
+    active[[3, 12]] = False
+    subjects, observers = build_adjacency(vc, active)
+
+    view = MembershipView(k)
+    eps = []
+    for i in range(40):
+        host = bytes(vc.hostnames[i, : vc.host_lengths[i]])
+        eps.append(Endpoint(host, int(vc.ports[i])))
+        if active[i]:
+            view.ring_add(eps[i], NodeId(int(vc.id_high[i]), int(vc.id_low[i])))
+    for i in np.flatnonzero(active):
+        assert [eps[s] for s in subjects[i]] == view.get_subjects_of(eps[i])
+        assert [eps[o] for o in observers[i]] == view.get_observers_of(eps[i])
+    # inactive rows stay self-loops
+    assert (subjects[3] == 3).all() and (observers[12] == 12).all()
+
+
+def test_config_fold_matches_python():
+    lib = native.load()
+    xs = np.array([5, 2**63 + 7, 12345678901234567], dtype=np.uint64)
+    h = 1
+    for x in xs:
+        h = (h * 37 + int(x)) & (2**64 - 1)
+    assert int(lib.rapid_config_fold(xs, len(xs))) == h
