@@ -1,0 +1,367 @@
+"""gRPC transport speaking the reference's exact wire format.
+
+The analogue of the default GrpcClient/GrpcServer pair (GrpcClient.java,
+GrpcServer.java): one unary RPC ``remoting.MembershipService/sendRequest``
+carrying the RapidRequest/RapidResponse ``oneof`` envelopes, so a rapid-tpu
+node is byte-compatible on the wire with JVM Rapid peers. Client side keeps a
+channel cache with per-message-type deadlines and async retries
+(GrpcClient.java:87-131,194-203); server side answers probes BOOTSTRAPPING
+until the membership service is wired (GrpcServer.java:77-96).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures as cf
+from typing import Dict, Optional
+
+import grpc
+
+from .. import types as T
+from ..runtime.futures import Promise
+from ..settings import Settings
+from .base import IMessagingClient, IMessagingServer
+from .retries import call_with_retries
+from .wire_schema import GRPC_METHOD_PATH, MSG
+
+LOG = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# dataclass <-> proto conversion
+# ---------------------------------------------------------------------------
+
+
+def _ep(endpoint: T.Endpoint):
+    out = MSG["Endpoint"]()
+    out.hostname = endpoint.hostname
+    out.port = endpoint.port
+    return out
+
+
+def _ep_back(msg) -> T.Endpoint:
+    return T.Endpoint(bytes(msg.hostname), int(msg.port))
+
+
+def _nid(node_id: T.NodeId):
+    out = MSG["NodeId"]()
+    out.high = node_id.high
+    out.low = node_id.low
+    return out
+
+
+def _nid_back(msg) -> T.NodeId:
+    return T.NodeId(int(msg.high), int(msg.low))
+
+
+def _meta(metadata) :
+    out = MSG["Metadata"]()
+    for key, value in metadata:
+        out.metadata[key] = value
+    return out
+
+
+def _meta_back(msg):
+    return tuple(sorted((k, bytes(v)) for k, v in msg.metadata.items()))
+
+
+def _alert(alert: T.AlertMessage):
+    out = MSG["AlertMessage"]()
+    out.edgeSrc.CopyFrom(_ep(alert.edge_src))
+    out.edgeDst.CopyFrom(_ep(alert.edge_dst))
+    out.edgeStatus = int(alert.edge_status)
+    out.configurationId = alert.configuration_id
+    out.ringNumber.extend(alert.ring_numbers)
+    if alert.node_id is not None:
+        out.nodeId.CopyFrom(_nid(alert.node_id))
+    out.metadata.CopyFrom(_meta(alert.metadata))
+    return out
+
+
+def _alert_back(msg) -> T.AlertMessage:
+    return T.AlertMessage(
+        edge_src=_ep_back(msg.edgeSrc),
+        edge_dst=_ep_back(msg.edgeDst),
+        edge_status=T.EdgeStatus(msg.edgeStatus),
+        configuration_id=int(msg.configurationId),
+        ring_numbers=tuple(msg.ringNumber),
+        node_id=_nid_back(msg.nodeId) if msg.HasField("nodeId") else None,
+        metadata=_meta_back(msg.metadata),
+    )
+
+
+def to_wire_request(msg: T.RapidMessage):
+    """Wrap a protocol dataclass into the RapidRequest oneof envelope."""
+    req = MSG["RapidRequest"]()
+    if isinstance(msg, T.PreJoinMessage):
+        req.preJoinMessage.sender.CopyFrom(_ep(msg.sender))
+        req.preJoinMessage.nodeId.CopyFrom(_nid(msg.node_id))
+    elif isinstance(msg, T.JoinMessage):
+        j = req.joinMessage
+        j.sender.CopyFrom(_ep(msg.sender))
+        j.nodeId.CopyFrom(_nid(msg.node_id))
+        j.ringNumber.extend(msg.ring_numbers)
+        j.configurationId = msg.configuration_id
+        j.metadata.CopyFrom(_meta(msg.metadata))
+    elif isinstance(msg, T.BatchedAlertMessage):
+        b = req.batchedAlertMessage
+        b.sender.CopyFrom(_ep(msg.sender))
+        for alert in msg.messages:
+            b.messages.append(_alert(alert))
+    elif isinstance(msg, T.ProbeMessage):
+        req.probeMessage.sender.CopyFrom(_ep(msg.sender))
+    elif isinstance(msg, T.FastRoundPhase2bMessage):
+        f = req.fastRoundPhase2bMessage
+        f.sender.CopyFrom(_ep(msg.sender))
+        f.configurationId = msg.configuration_id
+        f.endpoints.extend(_ep(e) for e in msg.endpoints)
+    elif isinstance(msg, T.Phase1aMessage):
+        p = req.phase1aMessage
+        p.sender.CopyFrom(_ep(msg.sender))
+        p.configurationId = msg.configuration_id
+        p.rank.round = msg.rank.round
+        p.rank.nodeIndex = msg.rank.node_index
+    elif isinstance(msg, T.Phase1bMessage):
+        p = req.phase1bMessage
+        p.sender.CopyFrom(_ep(msg.sender))
+        p.configurationId = msg.configuration_id
+        p.rnd.round, p.rnd.nodeIndex = msg.rnd.round, msg.rnd.node_index
+        p.vrnd.round, p.vrnd.nodeIndex = msg.vrnd.round, msg.vrnd.node_index
+        p.vval.extend(_ep(e) for e in msg.vval)
+    elif isinstance(msg, T.Phase2aMessage):
+        p = req.phase2aMessage
+        p.sender.CopyFrom(_ep(msg.sender))
+        p.configurationId = msg.configuration_id
+        p.rnd.round, p.rnd.nodeIndex = msg.rnd.round, msg.rnd.node_index
+        p.vval.extend(_ep(e) for e in msg.vval)
+    elif isinstance(msg, T.Phase2bMessage):
+        p = req.phase2bMessage
+        p.sender.CopyFrom(_ep(msg.sender))
+        p.configurationId = msg.configuration_id
+        p.rnd.round, p.rnd.nodeIndex = msg.rnd.round, msg.rnd.node_index
+        p.endpoints.extend(_ep(e) for e in msg.endpoints)
+    elif isinstance(msg, T.LeaveMessage):
+        req.leaveMessage.sender.CopyFrom(_ep(msg.sender))
+    else:
+        raise TypeError(f"not a request type: {type(msg).__name__}")
+    return req
+
+
+def from_wire_request(req) -> T.RapidMessage:
+    which = req.WhichOneof("content")
+    if which == "preJoinMessage":
+        m = req.preJoinMessage
+        return T.PreJoinMessage(sender=_ep_back(m.sender), node_id=_nid_back(m.nodeId))
+    if which == "joinMessage":
+        m = req.joinMessage
+        return T.JoinMessage(
+            sender=_ep_back(m.sender),
+            node_id=_nid_back(m.nodeId),
+            ring_numbers=tuple(m.ringNumber),
+            configuration_id=int(m.configurationId),
+            metadata=_meta_back(m.metadata),
+        )
+    if which == "batchedAlertMessage":
+        m = req.batchedAlertMessage
+        return T.BatchedAlertMessage(
+            sender=_ep_back(m.sender),
+            messages=tuple(_alert_back(a) for a in m.messages),
+        )
+    if which == "probeMessage":
+        return T.ProbeMessage(sender=_ep_back(req.probeMessage.sender))
+    if which == "fastRoundPhase2bMessage":
+        m = req.fastRoundPhase2bMessage
+        return T.FastRoundPhase2bMessage(
+            sender=_ep_back(m.sender),
+            configuration_id=int(m.configurationId),
+            endpoints=tuple(_ep_back(e) for e in m.endpoints),
+        )
+    if which == "phase1aMessage":
+        m = req.phase1aMessage
+        return T.Phase1aMessage(
+            sender=_ep_back(m.sender),
+            configuration_id=int(m.configurationId),
+            rank=T.Rank(int(m.rank.round), int(m.rank.nodeIndex)),
+        )
+    if which == "phase1bMessage":
+        m = req.phase1bMessage
+        return T.Phase1bMessage(
+            sender=_ep_back(m.sender),
+            configuration_id=int(m.configurationId),
+            rnd=T.Rank(int(m.rnd.round), int(m.rnd.nodeIndex)),
+            vrnd=T.Rank(int(m.vrnd.round), int(m.vrnd.nodeIndex)),
+            vval=tuple(_ep_back(e) for e in m.vval),
+        )
+    if which == "phase2aMessage":
+        m = req.phase2aMessage
+        return T.Phase2aMessage(
+            sender=_ep_back(m.sender),
+            configuration_id=int(m.configurationId),
+            rnd=T.Rank(int(m.rnd.round), int(m.rnd.nodeIndex)),
+            vval=tuple(_ep_back(e) for e in m.vval),
+        )
+    if which == "phase2bMessage":
+        m = req.phase2bMessage
+        return T.Phase2bMessage(
+            sender=_ep_back(m.sender),
+            configuration_id=int(m.configurationId),
+            rnd=T.Rank(int(m.rnd.round), int(m.rnd.nodeIndex)),
+            endpoints=tuple(_ep_back(e) for e in m.endpoints),
+        )
+    if which == "leaveMessage":
+        return T.LeaveMessage(sender=_ep_back(req.leaveMessage.sender))
+    raise ValueError(f"empty RapidRequest envelope: {which}")
+
+
+def to_wire_response(msg) :
+    resp = MSG["RapidResponse"]()
+    if isinstance(msg, T.JoinResponse):
+        j = resp.joinResponse
+        j.sender.CopyFrom(_ep(msg.sender))
+        j.statusCode = int(msg.status_code)
+        j.configurationId = msg.configuration_id
+        j.endpoints.extend(_ep(e) for e in msg.endpoints)
+        j.identifiers.extend(_nid(i) for i in msg.identifiers)
+        for endpoint, metadata in msg.metadata:
+            j.metadataKeys.append(_ep(endpoint))
+            j.metadataValues.append(_meta(metadata))
+    elif isinstance(msg, T.ProbeResponse):
+        resp.probeResponse.status = int(msg.status)
+    elif isinstance(msg, T.ConsensusResponse):
+        resp.consensusResponse.SetInParent()
+    else:  # Response / None -> empty ack
+        resp.response.SetInParent()
+    return resp
+
+
+def from_wire_response(resp):
+    which = resp.WhichOneof("content")
+    if which == "joinResponse":
+        m = resp.joinResponse
+        return T.JoinResponse(
+            sender=_ep_back(m.sender),
+            status_code=T.JoinStatusCode(m.statusCode),
+            configuration_id=int(m.configurationId),
+            endpoints=tuple(_ep_back(e) for e in m.endpoints),
+            identifiers=tuple(_nid_back(i) for i in m.identifiers),
+            metadata=tuple(
+                (_ep_back(k), _meta_back(v))
+                for k, v in zip(m.metadataKeys, m.metadataValues)
+            ),
+        )
+    if which == "probeResponse":
+        return T.ProbeResponse(T.NodeStatus(resp.probeResponse.status))
+    if which == "consensusResponse":
+        return T.ConsensusResponse()
+    return T.Response()
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+
+class GrpcServer(IMessagingServer):
+    def __init__(self, listen_address: T.Endpoint, max_workers: int = 8) -> None:
+        self.address = listen_address
+        self._service = None
+        self._server: Optional[grpc.Server] = None
+        self._max_workers = max_workers
+
+    def _handle(self, request, context):
+        service = self._service
+        if service is None:
+            msg = from_wire_request(request)
+            if isinstance(msg, T.ProbeMessage):
+                return to_wire_response(T.ProbeResponse(T.NodeStatus.BOOTSTRAPPING))
+            context.abort(grpc.StatusCode.UNAVAILABLE, "membership service not ready")
+        promise = service.handle_message(from_wire_request(request))
+        try:
+            result = promise.result(timeout=30)
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return to_wire_response(result)
+
+    def start(self) -> None:
+        handler = grpc.unary_unary_rpc_method_handler(
+            self._handle,
+            request_deserializer=MSG["RapidRequest"].FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+        service = grpc.method_handlers_generic_handler(
+            "remoting.MembershipService", {"sendRequest": handler}
+        )
+        self._server = grpc.server(cf.ThreadPoolExecutor(max_workers=self._max_workers))
+        self._server.add_generic_rpc_handlers((service,))
+        self._server.add_insecure_port(
+            f"{self.address.hostname.decode()}:{self.address.port}"
+        )
+        self._server.start()
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None
+
+    def set_membership_service(self, service) -> None:
+        self._service = service
+
+
+class GrpcClient(IMessagingClient):
+    def __init__(self, address: T.Endpoint, settings: Optional[Settings] = None) -> None:
+        self.address = address
+        self._settings = settings if settings is not None else Settings()
+        self._channels: Dict[T.Endpoint, grpc.Channel] = {}
+        self._stubs: Dict[T.Endpoint, object] = {}
+        self._lock = threading.Lock()
+
+    def _stub(self, remote: T.Endpoint):
+        with self._lock:
+            stub = self._stubs.get(remote)
+            if stub is None:
+                channel = grpc.insecure_channel(
+                    f"{remote.hostname.decode()}:{remote.port}"
+                )
+                stub = channel.unary_unary(
+                    GRPC_METHOD_PATH,
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=MSG["RapidResponse"].FromString,
+                )
+                self._channels[remote] = channel
+                self._stubs[remote] = stub
+            return stub
+
+    def _send_once(self, remote: T.Endpoint, msg: T.RapidMessage) -> Promise:
+        out: Promise = Promise()
+        try:
+            stub = self._stub(remote)
+            timeout_s = self._settings.timeout_for(msg) / 1000.0
+            future = stub.future(to_wire_request(msg), timeout=timeout_s)
+        except Exception as e:  # noqa: BLE001
+            out.set_exception(e)
+            return out
+
+        def on_done(f):
+            try:
+                out.try_set_result(from_wire_response(f.result()))
+            except Exception as e:  # noqa: BLE001
+                if not out.done():
+                    out.set_exception(e)
+
+        future.add_done_callback(on_done)
+        return out
+
+    def send_message(self, remote: T.Endpoint, msg: T.RapidMessage) -> Promise:
+        return call_with_retries(
+            lambda: self._send_once(remote, msg), self._settings.message_retries
+        )
+
+    def send_message_best_effort(self, remote: T.Endpoint, msg: T.RapidMessage) -> Promise:
+        return self._send_once(remote, msg)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for channel in self._channels.values():
+                channel.close()
+            self._channels.clear()
+            self._stubs.clear()

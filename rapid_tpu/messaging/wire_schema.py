@@ -1,0 +1,227 @@
+"""The Rapid wire schema, constructed programmatically.
+
+Wire compatibility with the reference is defined by field numbers and types
+(rapid/src/main/proto/rapid.proto:13-206), not by .proto source text -- so the
+schema lives here as a table and is compiled into protobuf message classes at
+import time via FileDescriptorProto. A rapid-tpu node speaking this schema
+over the gRPC transport is byte-compatible with JVM Rapid peers
+(tests/test_grpc_transport.py proves it by round-tripping through classes
+protoc-generated from the reference's own .proto).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_F = descriptor_pb2.FieldDescriptorProto
+
+_SCALARS = {
+    "bytes": _F.TYPE_BYTES,
+    "string": _F.TYPE_STRING,
+    "int32": _F.TYPE_INT32,
+    "int64": _F.TYPE_INT64,
+}
+
+# (name, type, number, repeated?) -- type "M:Name" = message, "E:Name" = enum
+_MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
+    "Endpoint": [("hostname", "bytes", 1, False), ("port", "int32", 2, False)],
+    "NodeId": [("high", "int64", 1, False), ("low", "int64", 2, False)],
+    "PreJoinMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("nodeId", "M:NodeId", 2, False),
+        ("ringNumber", "int32", 3, True),
+        ("configurationId", "int64", 4, False),
+    ],
+    "JoinMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("nodeId", "M:NodeId", 2, False),
+        ("ringNumber", "int32", 3, True),
+        ("configurationId", "int64", 4, False),
+        ("metadata", "M:Metadata", 5, False),
+    ],
+    "JoinResponse": [
+        ("sender", "M:Endpoint", 1, False),
+        ("statusCode", "E:JoinStatusCode", 2, False),
+        ("configurationId", "int64", 3, False),
+        ("endpoints", "M:Endpoint", 4, True),
+        ("identifiers", "M:NodeId", 5, True),
+        ("metadataKeys", "M:Endpoint", 6, True),
+        ("metadataValues", "M:Metadata", 7, True),
+    ],
+    "BatchedAlertMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("messages", "M:AlertMessage", 3, True),
+    ],
+    "AlertMessage": [
+        ("edgeSrc", "M:Endpoint", 1, False),
+        ("edgeDst", "M:Endpoint", 2, False),
+        ("edgeStatus", "E:EdgeStatus", 3, False),
+        ("configurationId", "int64", 4, False),
+        ("ringNumber", "int32", 5, True),
+        ("nodeId", "M:NodeId", 6, False),
+        ("metadata", "M:Metadata", 7, False),
+    ],
+    "Response": [],
+    "FastRoundPhase2bMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("configurationId", "int64", 2, False),
+        ("endpoints", "M:Endpoint", 3, True),
+    ],
+    "Rank": [("round", "int32", 1, False), ("nodeIndex", "int32", 2, False)],
+    "Phase1aMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("configurationId", "int64", 2, False),
+        ("rank", "M:Rank", 3, False),
+    ],
+    "Phase1bMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("configurationId", "int64", 2, False),
+        ("rnd", "M:Rank", 3, False),
+        ("vrnd", "M:Rank", 4, False),
+        ("vval", "M:Endpoint", 5, True),
+    ],
+    "Phase2aMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("configurationId", "int64", 2, False),
+        ("rnd", "M:Rank", 3, False),
+        ("vval", "M:Endpoint", 5, True),
+    ],
+    "Phase2bMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("configurationId", "int64", 2, False),
+        ("rnd", "M:Rank", 3, False),
+        ("endpoints", "M:Endpoint", 4, True),
+    ],
+    "ConsensusResponse": [],
+    "LeaveMessage": [("sender", "M:Endpoint", 1, False)],
+    "ProbeMessage": [("sender", "M:Endpoint", 1, False), ("payload", "bytes", 3, True)],
+    "ProbeResponse": [("status", "E:NodeStatus", 1, False)],
+}
+
+# The oneof envelopes (rapid.proto:21-45): (field, message type, number)
+_REQUEST_ONEOF = [
+    ("preJoinMessage", "PreJoinMessage", 1),
+    ("joinMessage", "JoinMessage", 2),
+    ("batchedAlertMessage", "BatchedAlertMessage", 3),
+    ("probeMessage", "ProbeMessage", 4),
+    ("fastRoundPhase2bMessage", "FastRoundPhase2bMessage", 5),
+    ("phase1aMessage", "Phase1aMessage", 6),
+    ("phase1bMessage", "Phase1bMessage", 7),
+    ("phase2aMessage", "Phase2aMessage", 8),
+    ("phase2bMessage", "Phase2bMessage", 9),
+    ("leaveMessage", "LeaveMessage", 10),
+]
+_RESPONSE_ONEOF = [
+    ("joinResponse", "JoinResponse", 1),
+    ("response", "Response", 2),
+    ("consensusResponse", "ConsensusResponse", 3),
+    ("probeResponse", "ProbeResponse", 4),
+]
+
+_ENUMS = {
+    "JoinStatusCode": [
+        ("HOSTNAME_ALREADY_IN_RING", 0),
+        ("UUID_ALREADY_IN_RING", 1),
+        ("SAFE_TO_JOIN", 2),
+        ("CONFIG_CHANGED", 3),
+        ("MEMBERSHIP_REJECTED", 4),
+    ],
+    "EdgeStatus": [("UP", 0), ("DOWN", 1)],
+    "NodeStatus": [("OK", 0), ("BOOTSTRAPPING", 1)],
+}
+
+PACKAGE = "remoting"
+SERVICE = "MembershipService"
+METHOD = "sendRequest"
+GRPC_METHOD_PATH = f"/{PACKAGE}.{SERVICE}/{METHOD}"
+
+
+def _field(
+    name: str, type_spec: str, number: int, repeated: bool,
+    oneof_index: Optional[int] = None,
+) -> _F:
+    f = _F()
+    f.name = name
+    f.number = number
+    f.label = _F.LABEL_REPEATED if repeated else _F.LABEL_OPTIONAL
+    if type_spec in _SCALARS:
+        f.type = _SCALARS[type_spec]
+    elif type_spec.startswith("M:"):
+        f.type = _F.TYPE_MESSAGE
+        f.type_name = f".{PACKAGE}.{type_spec[2:]}"
+    elif type_spec.startswith("E:"):
+        f.type = _F.TYPE_ENUM
+        f.type_name = f".{PACKAGE}.{type_spec[2:]}"
+    else:
+        raise ValueError(type_spec)
+    if oneof_index is not None:
+        f.oneof_index = oneof_index
+    return f
+
+
+def _build_file() -> descriptor_pb2.FileDescriptorProto:
+    file_proto = descriptor_pb2.FileDescriptorProto()
+    file_proto.name = "rapid_tpu_wire.proto"
+    file_proto.package = PACKAGE
+    file_proto.syntax = "proto3"
+
+    for enum_name, values in _ENUMS.items():
+        enum = file_proto.enum_type.add()
+        enum.name = enum_name
+        for value_name, number in values:
+            v = enum.value.add()
+            v.name = value_name
+            v.number = number
+
+    # Metadata with its map<string, bytes> (maps are a nested entry message
+    # with the map_entry option set)
+    metadata = file_proto.message_type.add()
+    metadata.name = "Metadata"
+    entry = metadata.nested_type.add()
+    entry.name = "MetadataEntry"
+    entry.options.map_entry = True
+    entry.field.append(_field("key", "string", 1, False))
+    entry.field.append(_field("value", "bytes", 2, False))
+    map_field = _field("metadata", "M:Metadata.MetadataEntry", 1, True)
+    metadata.field.append(map_field)
+
+    for msg_name, fields in _MESSAGES.items():
+        msg = file_proto.message_type.add()
+        msg.name = msg_name
+        for name, type_spec, number, repeated in fields:
+            msg.field.append(_field(name, type_spec, number, repeated))
+
+    for envelope_name, entries in (
+        ("RapidRequest", _REQUEST_ONEOF),
+        ("RapidResponse", _RESPONSE_ONEOF),
+    ):
+        msg = file_proto.message_type.add()
+        msg.name = envelope_name
+        oneof = msg.oneof_decl.add()
+        oneof.name = "content"
+        for name, type_name, number in entries:
+            msg.field.append(_field(name, f"M:{type_name}", number, False, oneof_index=0))
+
+    service = file_proto.service.add()
+    service.name = SERVICE
+    method = service.method.add()
+    method.name = METHOD
+    method.input_type = f".{PACKAGE}.RapidRequest"
+    method.output_type = f".{PACKAGE}.RapidResponse"
+    return file_proto
+
+
+_pool = descriptor_pool.DescriptorPool()
+_file_descriptor = _pool.Add(_build_file())
+
+
+def _message_class(name: str):
+    return message_factory.GetMessageClass(_pool.FindMessageTypeByName(f"{PACKAGE}.{name}"))
+
+
+MSG = {
+    name: _message_class(name)
+    for name in list(_MESSAGES) + ["Metadata", "RapidRequest", "RapidResponse"]
+}
