@@ -208,8 +208,11 @@ class Simulator:
                 join_reports=join_reports,
             )
             n = min(batch, max_rounds - rounds_done)
+            random_loss = bool((self._drop_prob > 0).any())
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
-                self.state = run_rounds_const(self.config, self.state, inputs, n)
+                self.state = run_rounds_const(
+                    self.config, self.state, inputs, n, random_loss
+                )
                 decided = bool(self.state.decided)  # syncs the device batch
             self.metrics.incr("rounds", n)
             self.metrics.incr("device_dispatches")
@@ -308,3 +311,74 @@ class Simulator:
 
     def members(self) -> np.ndarray:
         return np.flatnonzero(self.active)
+
+    # ------------------------------------------------------------------ #
+    # Checkpoint / resume
+    # ------------------------------------------------------------------ #
+
+    def save_configuration(self, path: str) -> None:
+        """Persist the configuration snapshot -- the same information a real
+        Rapid node needs to bootstrap an identical view (MembershipView
+        Configuration, MembershipView.java:517-548): node identities, current
+        membership, the append-only identifiersSeen set, and the clock.
+        Per-round device state is deliberately NOT persisted; a restarted
+        simulator, like a restarted process, starts a fresh configuration."""
+        np.savez_compressed(
+            path,
+            hostnames=self.cluster.hostnames,
+            host_lengths=self.cluster.host_lengths,
+            ports=self.cluster.ports,
+            id_high=self.cluster.id_high,
+            id_low=self.cluster.id_low,
+            ring_hashes=self.cluster.ring_hashes,
+            active=self.active,
+            alive=self.alive,
+            identifiers_seen=np.array(sorted(self.identifiers_seen), dtype=np.int64),
+            virtual_ms=np.int64(self.virtual_ms),
+            params=np.array(
+                [self.config.capacity, self.config.k, self.config.h, self.config.l,
+                 self.config.fd_threshold, self.config.fd_interval_ms,
+                 self.config.batching_window_ms, self.seed],
+                dtype=np.int64,
+            ),
+        )
+
+    @staticmethod
+    def from_configuration(path: str) -> "Simulator":
+        """Rebuild a simulator from a configuration snapshot; the
+        configuration id of the restored instance equals the saved one."""
+        with np.load(path) as data:
+            (capacity, k, h, l, fd_threshold, fd_interval_ms,
+             batching_window_ms, seed) = (int(x) for x in data["params"])
+            config = SimConfig(
+                capacity=capacity, k=k, h=h, l=l, fd_threshold=fd_threshold,
+                fd_interval_ms=fd_interval_ms, batching_window_ms=batching_window_ms,
+            )
+            sim = Simulator.__new__(Simulator)
+            sim.config = config
+            sim.cluster = VirtualCluster(
+                hostnames=data["hostnames"],
+                host_lengths=data["host_lengths"],
+                ports=data["ports"],
+                id_high=data["id_high"],
+                id_low=data["id_low"],
+                ring_hashes=data["ring_hashes"],
+            )
+            sim.active = data["active"].copy()
+            sim.alive = data["alive"].copy()
+            sim.identifiers_seen = set(int(i) for i in data["identifiers_seen"])
+            sim.seed = seed
+            sim.virtual_ms = int(data["virtual_ms"])
+        sim.state = initial_state(sim.config, sim.cluster, sim.active, seed=sim.seed)
+        sim.state = dataclasses.replace(
+            sim.state, alive=jnp.asarray(sim.alive & sim.active)
+        )
+        sim._billed_rounds = 0
+        sim.view_changes = []
+        sim.metrics = Metrics()
+        sim.tracer = Tracer()
+        sim._ingress_partitioned = set()
+        sim._drop_prob = np.zeros(sim.config.capacity, dtype=np.float32)
+        sim._pending_joiners = set()
+        sim._join_reports_armed = False
+        return sim

@@ -103,19 +103,24 @@ def initial_state(
     )
 
 
-def _scatter_alerts(
-    reports: jax.Array, subjects: jax.Array, new_alerts: jax.Array
+def _gather_alerts(
+    reports: jax.Array, observers: jax.Array, new_alerts: jax.Array,
+    active: jax.Array,
 ) -> jax.Array:
     """OR each observer-edge alert into its (dst, ring) report slot.
 
-    For a fixed ring k, ``subjects[:, k]`` restricted to active nodes is a
-    permutation, so at most one observer reports a given (dst, ring): the
-    scatter-max has no real conflicts.
+    On ring k the subject map (i -> subjects[i,k]) and the observer map
+    (d -> observers[d,k]) are inverse permutations over the active set, so the
+    scatter "alert from observer i lands at (subjects[i,k], k)" is exactly the
+    gather ``reports[d,k] |= new_alerts[observers[d,k], k]`` -- and gathers
+    are far cheaper than scatters on TPU. The gather is masked to active
+    destinations: inactive rows' observers entries are either self-loops or
+    (for pending joiners) their *expected* observers, whose DOWN alerts are
+    about different destinations entirely.
     """
-    c, k = reports.shape
-    rows = subjects.reshape(-1)
-    cols = jnp.tile(jnp.arange(k, dtype=jnp.int32), c)
-    return reports.at[rows, cols].max(new_alerts.reshape(-1))
+    k = reports.shape[1]
+    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+    return reports | (new_alerts[observers, cols] & active[:, None])
 
 
 def cut_and_tally(
@@ -168,8 +173,14 @@ def cut_and_tally(
     return reports, announced, proposal, decided, decided_round
 
 
-def step(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimState:
-    """One protocol round. Pure; jit/scan-friendly."""
+def step(config: SimConfig, state: SimState, inputs: RoundInputs,
+         random_loss: bool = True) -> SimState:
+    """One protocol round. Pure; jit/scan-friendly.
+
+    ``random_loss`` statically elides the per-edge RNG draw when no lossy
+    ingress fault is active (the common case) -- the threefry generation over
+    [C, K] per round is otherwise a real bandwidth cost at C=100k.
+    """
     c, k = config.capacity, config.k
     halt = state.decided
 
@@ -182,9 +193,10 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimState:
     edge_live = active[:, None] & active[subj]  # edge exists in this config
     observer_up = alive[:, None]
     target_up = alive[subj]
-    rand_drop = (
-        jax.random.uniform(probe_key, (c, k)) < inputs.drop_prob[subj]
-    )
+    if random_loss:
+        rand_drop = jax.random.uniform(probe_key, (c, k)) < inputs.drop_prob[subj]
+    else:
+        rand_drop = jnp.zeros((c, k), bool)
     probe_ok = target_up & ~inputs.probe_drop & ~rand_drop
     fail_event = edge_live & observer_up & ~probe_ok
     fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
@@ -197,7 +209,7 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimState:
         & ~state.alerted
     )
     alerted = state.alerted | new_down
-    reports = _scatter_alerts(state.reports, subj, new_down)
+    reports = _gather_alerts(state.reports, state.observers, new_down, active)
     reports = reports | inputs.join_reports
     seen_down = state.seen_down | jnp.any(new_down)
 
@@ -239,16 +251,17 @@ def run_rounds(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimSt
     return final
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
 def run_rounds_const(
-    config: SimConfig, state: SimState, inputs: RoundInputs, rounds: int
+    config: SimConfig, state: SimState, inputs: RoundInputs, rounds: int,
+    random_loss: bool = True,
 ) -> SimState:
     """Scan ``rounds`` rounds under a constant fault plane (inputs without a
     leading rounds axis). Avoids materializing [R, C, K] fault arrays -- the
     path used for large-capacity runs."""
 
     def body(carry: SimState, _):
-        return step(config, carry, inputs), ()
+        return step(config, carry, inputs, random_loss), ()
 
     final, _ = jax.lax.scan(body, state, None, length=rounds)
     return final

@@ -1,0 +1,165 @@
+"""Run every BASELINE.json configuration and report time-to-stable-view.
+
+Prints one JSON line per scenario:
+  {"config", "n", "virtual_ms", "wall_s", "cut_ok", ...}
+
+- virtual_ms: protocol time a real cluster would need (FD rounds + batching).
+- wall_s: simulation wall time on this host/chip.
+- cut_ok: the decided cut equals the injected fault set (cut-set parity).
+
+Scenario 1 is the cross-plane parity config: the *protocol plane* (full
+object-model cluster with real message passing on the deterministic
+virtual-time scheduler) and the *simulation plane* run the same 10-node
+membership with the same crash; their cuts, final memberships, and
+configuration behavior must agree.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def scenario_10_node_cross_plane():
+    """10-node ring, 1 crash-stop: protocol plane vs simulation plane."""
+    import random
+
+    from rapid_tpu import ClusterBuilder, Endpoint
+    from rapid_tpu.membership import MembershipView
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.types import NodeId
+    sys.path.insert(0, "tests")
+    from harness import ClusterHarness
+
+    t0 = time.perf_counter()
+    # protocol plane
+    h = ClusterHarness(seed=1)
+    h.create_cluster(10, parallel=False)
+    h.wait_and_verify_agreement(10)
+    victim = h.addr(9)
+    start_virtual = h.scheduler.now_ms()
+    h.fail_nodes([victim])
+    h.wait_and_verify_agreement(9)
+    protocol_virtual_ms = h.scheduler.now_ms() - start_virtual
+    survivors_protocol = set(h.instances[h.addr(0)].get_memberlist())
+    h.shutdown()
+
+    # simulation plane: same shape of fault
+    sim = Simulator(10, seed=1)
+    sim.crash(np.array([9]))
+    rec = sim.run_until_decision(max_rounds=40)
+    cut_ok = rec is not None and list(rec.cut) == [9]
+
+    # cross-plane configuration-id parity on identical identities
+    vc = sim.cluster
+    view = MembershipView(10)
+    for i in range(10):
+        host = bytes(vc.hostnames[i, : vc.host_lengths[i]])
+        view.ring_add(Endpoint(host, int(vc.ports[i])),
+                      NodeId(int(vc.id_high[i]), int(vc.id_low[i])))
+    view.ring_delete(Endpoint(
+        bytes(vc.hostnames[9, : vc.host_lengths[9]]), int(vc.ports[9])))
+    config_parity = view.get_current_configuration_id() == rec.configuration_id
+
+    return {
+        "config": "10-node ring, 1 crash-stop (cross-plane parity)",
+        "n": 10,
+        "virtual_ms": rec.virtual_time_ms,
+        "protocol_plane_virtual_ms": protocol_virtual_ms,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": bool(cut_ok and len(survivors_protocol) == 9),
+        "config_id_parity": bool(config_parity),
+    }
+
+
+def scenario_crash(n, n_fail, seed, label):
+    from rapid_tpu.sim.driver import Simulator
+
+    rng = np.random.default_rng(seed)
+    sim = Simulator(n, seed=seed)
+    victims = rng.choice(n, size=n_fail, replace=False)
+    sim.crash(victims)
+    t0 = time.perf_counter()
+    rec = sim.run_until_decision(max_rounds=32, batch=16)
+    wall = time.perf_counter() - t0
+    return {
+        "config": label,
+        "n": n,
+        "virtual_ms": rec.virtual_time_ms if rec else None,
+        "wall_s": round(wall, 3),
+        "cut_ok": bool(rec is not None and set(rec.cut) == set(victims)),
+    }
+
+
+def scenario_one_way_loss(n, n_fail, seed):
+    from rapid_tpu.sim.driver import Simulator
+
+    rng = np.random.default_rng(seed)
+    sim = Simulator(n, seed=seed)
+    victims = rng.choice(n, size=n_fail, replace=False)
+    sim.one_way_ingress_partition(victims)
+    t0 = time.perf_counter()
+    rec = sim.run_until_decision(max_rounds=32, batch=16)
+    wall = time.perf_counter() - t0
+    return {
+        "config": f"{n//1000}k nodes, asymmetric one-way link loss",
+        "n": n,
+        "virtual_ms": rec.virtual_time_ms if rec else None,
+        "wall_s": round(wall, 3),
+        "cut_ok": bool(rec is not None and set(rec.cut) == set(victims)),
+    }
+
+
+def scenario_flip_flop_with_join_wave(n, capacity, seed):
+    from rapid_tpu.sim.driver import Simulator
+
+    rng = np.random.default_rng(seed)
+    sim = Simulator(n, capacity=capacity, seed=seed)
+    victims = rng.choice(n, size=n // 100, replace=False)
+    joiners = np.arange(n, capacity)
+    sim.request_joins(joiners)
+    t0 = time.perf_counter()
+    flip = True
+    decided = []
+    for _ in range(12):
+        if flip:
+            sim.crash(victims)
+        else:
+            sim.revive(victims)
+        flip = not flip
+        rec = sim.run_until_decision(max_rounds=10, batch=10)
+        if rec is not None:
+            decided.append(rec)
+            if sim.membership_size == n - len(victims) + len(joiners):
+                break
+    wall = time.perf_counter() - t0
+    final_ok = (
+        sim.membership_size == n - len(victims) + len(joiners)
+        and not sim.active[victims].any()
+        and sim.active[joiners].all()
+    )
+    return {
+        "config": f"{n//1000}k nodes, flip-flop reachability + concurrent join wave",
+        "n": n,
+        "virtual_ms": decided[-1].virtual_time_ms if decided else None,
+        "wall_s": round(wall, 3),
+        "cut_ok": bool(final_ok),
+        "view_changes": len(decided),
+    }
+
+
+def main() -> None:
+    results = [
+        scenario_10_node_cross_plane(),
+        scenario_crash(1000, 1, 100, "1k virtual nodes, single crash-stop fault"),
+        scenario_crash(10_000, 100, 200, "10k virtual nodes, 1% correlated crash burst"),
+        scenario_one_way_loss(50_000, 500, 300),
+        scenario_flip_flop_with_join_wave(100_000, 100_100, 400),
+    ]
+    for result in results:
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
