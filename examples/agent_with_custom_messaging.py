@@ -1,0 +1,49 @@
+"""Agent demonstrating the messaging plugin seam with a custom transport.
+
+Equivalent of the reference's AgentWithNettyMessaging (examples/.../
+AgentWithNettyMessaging.java:58-67): the default agent uses the
+wire-compatible gRPC transport; this one injects the framed-TCP transport via
+set_messaging_client_and_server -- the same seam any user transport plugs
+into (IMessagingClient/IMessagingServer, messaging/base.py).
+
+    python examples/agent_with_custom_messaging.py --listen-address 127.0.0.1:1234
+"""
+
+import argparse
+import logging
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from rapid_tpu import ClusterBuilder, Endpoint, Settings
+from rapid_tpu.messaging.tcp import TcpClientServer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--listen-address", required=True)
+    parser.add_argument("--seed-address")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    listen = Endpoint.from_string(args.listen_address)
+    settings = Settings()
+    transport = TcpClientServer(listen, settings)  # the custom transport
+    builder = (
+        ClusterBuilder(listen)
+        .use_settings(settings)
+        .set_messaging_client_and_server(transport, transport)
+    )
+    cluster = (
+        builder.join(Endpoint.from_string(args.seed_address))
+        if args.seed_address
+        else builder.start()
+    )
+    logging.info("started %s over custom TCP messaging", cluster)
+    while True:
+        time.sleep(1)
+        logging.info("membership size=%d", cluster.get_membership_size())
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,10 @@ def main() -> None:
     parser.add_argument("--listen-address", required=True, help="host:port to listen on")
     parser.add_argument("--seed-address", help="host:port of a seed to join")
     parser.add_argument("--fd-interval-ms", type=int, default=1000)
+    parser.add_argument(
+        "--transport", choices=("tcp", "grpc"), default="tcp",
+        help="tcp = framed-TCP transport; grpc = wire-compatible with JVM Rapid",
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
 
@@ -36,7 +40,12 @@ def main() -> None:
 
     listen = Endpoint.from_string(args.listen_address)
     settings = Settings(failure_detector_interval_ms=args.fd_interval_ms)
-    transport = TcpClientServer(listen, settings)
+    if args.transport == "grpc":
+        from rapid_tpu.messaging.grpc_transport import GrpcClient, GrpcServer
+
+        client, server = GrpcClient(listen, settings), GrpcServer(listen)
+    else:
+        client = server = TcpClientServer(listen, settings)
 
     def on_event(name):
         def callback(configuration_id, changes):
@@ -48,7 +57,7 @@ def main() -> None:
     builder = (
         ClusterBuilder(listen)
         .use_settings(settings)
-        .set_messaging_client_and_server(transport, transport)
+        .set_messaging_client_and_server(client, server)
         .add_subscription(ClusterEvents.VIEW_CHANGE_PROPOSAL, on_event("VIEW_CHANGE_PROPOSAL"))
         .add_subscription(ClusterEvents.VIEW_CHANGE, on_event("VIEW_CHANGE"))
         .add_subscription(ClusterEvents.KICKED, on_event("KICKED"))

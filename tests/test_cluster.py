@@ -186,3 +186,46 @@ def test_memberlist_identical_across_nodes(harness):
     assert len(set(lists)) == 1
     configs = {c.get_current_configuration_id() for c in harness.instances.values()}
     assert len(configs) == 1
+
+
+def test_classic_paxos_fallback_in_full_stack(harness):
+    """PaxosTests-style droppable message types, through the whole stack:
+    with every FastRoundPhase2bMessage dropped network-wide, a crash must
+    still be resolved by the scheduled classic Paxos rounds
+    (FastPaxos.java:105-107,189-195)."""
+    from rapid_tpu.types import FastRoundPhase2bMessage
+
+    harness.create_cluster(6)
+    harness.wait_and_verify_agreement(6)
+    harness.network.add_filter(
+        lambda s, d, m: not isinstance(m, FastRoundPhase2bMessage)
+    )
+    harness.fail_nodes([harness.addr(5)])
+    # needs fallback delay (1s base + Exp(mean N s) jitter) -- virtual time
+    harness.wait_and_verify_agreement(5, timeout_ms=600_000)
+
+
+def test_fast_round_message_delay_still_converges(harness):
+    """Delaying (not dropping) consensus messages by 300ms must not break
+    agreement -- the Delayer interceptor scenario."""
+    from rapid_tpu.types import FastRoundPhase2bMessage
+
+    harness.create_cluster(8)
+    harness.wait_and_verify_agreement(8)
+    harness.network.add_delay(
+        lambda s, d, m: 300 if isinstance(m, FastRoundPhase2bMessage) else 0
+    )
+    harness.fail_nodes([harness.addr(7)])
+    harness.wait_and_verify_agreement(7)
+
+
+def test_hundred_node_parallel_join_and_crash(harness):
+    """Full reference scale (ClusterTest.java:184-191 hundred-node join;
+    :276-315 twelve-node crash) -- seconds of wall clock under virtual time."""
+    harness.create_cluster(100, parallel=True)
+    harness.wait_and_verify_agreement(100)
+    failing = [harness.addr(i) for i in range(88, 100)]
+    harness.fail_nodes(failing)
+    harness.wait_and_verify_agreement(88)
+    for cluster in harness.instances.values():
+        assert not set(cluster.get_memberlist()) & set(failing)
