@@ -43,6 +43,10 @@ class SimConfig:
     fd_threshold: int = 10  # PingPongFailureDetector.FAILURE_THRESHOLD
     fd_interval_ms: int = 1000  # MembershipService.java:77
     batching_window_ms: int = 100  # MembershipService.java:75
+    # Fuse the probe/counter/alert elementwise phase into one Pallas kernel
+    # (sim/pallas_kernels.py). "off" = stock jax; "tpu" = hardware kernel;
+    # "interpret" = Pallas interpreter (CPU-testable).
+    pallas_fd: str = "off"
 
 
 @jax.tree_util.register_dataclass
@@ -198,17 +202,30 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
     else:
         rand_drop = jnp.zeros((c, k), bool)
     probe_ok = target_up & ~inputs.probe_drop & ~rand_drop
-    fail_event = edge_live & observer_up & ~probe_ok
-    fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
 
-    # --- alert generation + scatter (batched broadcast) --------------------
-    new_down = (
-        edge_live
-        & observer_up
-        & (fd_fail >= config.fd_threshold)
-        & ~state.alerted
-    )
-    alerted = state.alerted | new_down
+    if config.pallas_fd != "off":
+        from .pallas_kernels import fd_phase
+
+        fd_fail, alerted, new_down = fd_phase(
+            edge_live,
+            jnp.broadcast_to(observer_up, (c, k)),
+            probe_ok,
+            state.fd_fail,
+            state.alerted,
+            threshold=config.fd_threshold,
+            interpret=config.pallas_fd == "interpret",
+        )
+    else:
+        fail_event = edge_live & observer_up & ~probe_ok
+        fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
+        # --- alert generation --------------------------------------------
+        new_down = (
+            edge_live
+            & observer_up
+            & (fd_fail >= config.fd_threshold)
+            & ~state.alerted
+        )
+        alerted = state.alerted | new_down
     reports = _gather_alerts(state.reports, state.observers, new_down, active)
     reports = reports | inputs.join_reports
     seen_down = state.seen_down | jnp.any(new_down)

@@ -1,0 +1,113 @@
+"""Pallas TPU kernel for the fused failure-detection phase of the round step.
+
+The round step splits into (a) an elementwise chain over the per-edge [C, K]
+state -- probe outcome, cumulative FD counter update, threshold crossing,
+alert latch -- and (b) permutation gathers along the ring adjacency (alert
+routing, flux lookups). The gathers are exactly the access pattern XLA's TPU
+gather lowering is built for and stay in stock jax; the elementwise chain is
+the Pallas fit: one VMEM-resident kernel producing all four outputs per tile,
+with no intermediate HBM round-trips between them.
+
+Layout notes: the [C, K] per-edge arrays are processed in row tiles of
+``block_rows`` x K with K padded to the 128-lane boundary by the caller's
+choice of tile (K=10 << 128, so rows are the parallel axis; int32/bool lanes
+vectorize on the VPU's 8x128 shape).
+
+Validated in interpret mode against the stock-jax formulation
+(tests/test_pallas_kernels.py); enable on hardware via
+``SimConfig`` -> ``use_pallas_fd=True`` (engine.step consults it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fd_phase_kernel(
+    edge_live_ref,  # bool[B, K] edge exists (active obs & active subj)
+    observer_up_ref,  # bool[B, K] observer alive this round
+    probe_ok_ref,  # bool[B, K] probe would succeed (target up & not dropped)
+    fd_fail_ref,  # int32[B, K] cumulative failures (input)
+    alerted_ref,  # bool[B, K] already-alerted latch (input)
+    threshold_ref,  # int32[1, 1] FD threshold (SMEM)
+    fd_fail_out_ref,  # int32[B, K]
+    alerted_out_ref,  # bool[B, K]
+    new_down_out_ref,  # bool[B, K]
+):
+    edge_live = edge_live_ref[:]
+    observer_up = observer_up_ref[:]
+    fail_event = edge_live & observer_up & ~probe_ok_ref[:]
+    fd_fail = fd_fail_ref[:] + fail_event.astype(jnp.int32)
+    new_down = (
+        edge_live
+        & observer_up
+        & (fd_fail >= threshold_ref[0, 0])
+        & ~alerted_ref[:]
+    )
+    fd_fail_out_ref[:] = fd_fail
+    alerted_out_ref[:] = alerted_ref[:] | new_down
+    new_down_out_ref[:] = new_down
+
+
+@functools.partial(jax.jit, static_argnames=("threshold", "block_rows", "interpret"))
+def fd_phase(
+    edge_live: jax.Array,
+    observer_up: jax.Array,
+    probe_ok: jax.Array,
+    fd_fail: jax.Array,
+    alerted: jax.Array,
+    threshold: int,
+    block_rows: int = 1024,
+    interpret: bool = False,
+):
+    """Fused probe/counter/alert phase. Returns (fd_fail, alerted, new_down).
+
+    Semantics (must stay in lockstep with engine.step's stock-jax fallback):
+      fail_event = edge_live & observer_up & ~probe_ok
+      fd_fail   += fail_event                       (cumulative, never reset:
+                                                     PingPongFailureDetector.java:116-118)
+      new_down   = edge_live & observer_up & fd_fail>=threshold & ~alerted
+      alerted   |= new_down
+    """
+    c, k = fd_fail.shape
+    block_rows = min(block_rows, c)
+    if c % block_rows != 0:
+        # fall back to one whole-array block for awkward capacities
+        block_rows = c
+    grid = (c // block_rows,)
+
+    def row_spec():
+        return pl.BlockSpec((block_rows, k), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        _fd_phase_kernel,
+        grid=grid,
+        in_specs=[
+            row_spec(),  # edge_live
+            row_spec(),  # observer_up
+            row_spec(),  # probe_ok
+            row_spec(),  # fd_fail
+            row_spec(),  # alerted
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[row_spec(), row_spec(), row_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct((c, k), jnp.int32),
+            jax.ShapeDtypeStruct((c, k), jnp.bool_),
+            jax.ShapeDtypeStruct((c, k), jnp.bool_),
+        ],
+        interpret=interpret,
+    )(
+        edge_live,
+        observer_up,
+        probe_ok,
+        fd_fail,
+        alerted,
+        jnp.full((1, 1), threshold, jnp.int32),
+    )
+    return tuple(out)
