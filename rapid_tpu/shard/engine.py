@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..sim.engine import RoundInputs, SimConfig, SimState, cut_and_tally
+from ..sim.engine import RoundInputs, SimConfig, SimState, route_and_tally
 
 NODES_AXIS = "nodes"
 
@@ -49,6 +49,7 @@ def state_shardings(mesh: Mesh) -> SimState:
     return SimState(
         active=rep,
         alive=rep,
+        group_of=rep,
         subjects=row,
         observers=rep,  # gathered by destination in the implicit pass
         fd_fail=row,
@@ -58,6 +59,7 @@ def state_shardings(mesh: Mesh) -> SimState:
         announced=rep,
         proposal=rep,
         decided=rep,
+        decided_group=rep,
         decided_round=rep,
         round=rep,
         rng_key=rep,
@@ -67,7 +69,8 @@ def state_shardings(mesh: Mesh) -> SimState:
 def input_shardings(mesh: Mesh) -> RoundInputs:
     row = NamedSharding(mesh, P(NODES_AXIS, None))
     rep = NamedSharding(mesh, P())
-    return RoundInputs(alive=rep, probe_drop=row, drop_prob=rep, join_reports=rep)
+    return RoundInputs(alive=rep, probe_drop=row, drop_prob=rep,
+                       join_reports=rep, deliver=rep)
 
 
 def place_state(state: SimState, mesh: Mesh) -> SimState:
@@ -113,17 +116,17 @@ def _sharded_round(config: SimConfig, state: SimState, inputs: RoundInputs) -> S
     cols = jnp.tile(jnp.arange(k, dtype=jnp.int32), local_rows)
     delta = delta.at[rows, cols].max(new_down.reshape(-1).astype(jnp.int32))
     delta = jax.lax.pmax(delta, NODES_AXIS)
-    reports = state.reports | (delta > 0) | inputs.join_reports
-    seen_down = state.seen_down | jnp.any(delta > 0)
+    down_arrivals = delta > 0  # dst-indexed DOWN alert arrivals [C, K]
 
-    # --- replicated cut detection + tally (identical on every shard) -------
-    reports, announced, proposal, decided, decided_round = cut_and_tally(
-        config, state, reports, seen_down, active, alive
-    )
+    # --- replicated delivery + cut detection + tally (identical per shard) -
+    (reports, seen_down, announced, proposal, decided, decided_group,
+     decided_round) = route_and_tally(config, state, down_arrivals, inputs,
+                                      active, alive)
 
     new_state = SimState(
         active=active,
         alive=inputs.alive,
+        group_of=state.group_of,
         subjects=subj,
         observers=state.observers,
         fd_fail=fd_fail,
@@ -133,6 +136,7 @@ def _sharded_round(config: SimConfig, state: SimState, inputs: RoundInputs) -> S
         announced=announced,
         proposal=proposal,
         decided=decided,
+        decided_group=decided_group,
         decided_round=decided_round,
         round=state.round + 1,
         rng_key=key,

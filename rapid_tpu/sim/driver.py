@@ -71,12 +71,15 @@ class Simulator:
         self.active = np.zeros(capacity, dtype=bool)
         self.active[:n_nodes] = True
         self.alive = self.active.copy()
+        self.group_of = np.zeros(capacity, dtype=np.int32)
         # identifiersSeen is append-only: node slots whose identifier has been
         # used. A rejoin needs a fresh slot (= fresh identifier), exactly as a
         # real rejoining process draws a fresh UUID (Cluster.java:327-331).
         self.identifiers_seen: Set[int] = set(np.flatnonzero(self.active))
         self.seed = seed
-        self.state = initial_state(self.config, self.cluster, self.active, seed=seed)
+        self.state = initial_state(
+            self.config, self.cluster, self.active, seed=seed, group_of=self.group_of
+        )
         self.virtual_ms = 0
         self._billed_rounds = 0  # rounds of this configuration already billed
         self.view_changes: List[ViewChangeRecord] = []
@@ -85,6 +88,7 @@ class Simulator:
         # fault plane
         self._ingress_partitioned: Set[int] = set()
         self._drop_prob = np.zeros(capacity, dtype=np.float32)
+        self._deliver = np.ones((self.config.groups, capacity), dtype=bool)
         self._pending_joiners: Set[int] = set()
         self._join_reports_armed = False
 
@@ -116,6 +120,29 @@ class Simulator:
     def clear_link_faults(self) -> None:
         self._ingress_partitioned.clear()
         self._drop_prob[:] = 0.0
+        self._deliver[:] = True
+
+    # ------------------------------------------------------------------ #
+    # Heterogeneous broadcast delivery (almost-everywhere agreement)
+    # ------------------------------------------------------------------ #
+
+    def set_delivery_groups(self, group_of: np.ndarray) -> None:
+        """Partition nodes into delivery classes (config.groups must cover
+        the assignment). Nodes in the same group share one cut-detector view
+        of the alert stream; the fault plane drops broadcasts per
+        (receiving group, sender)."""
+        group_of = np.asarray(group_of, dtype=np.int32)
+        assert group_of.shape == (self.config.capacity,)
+        assert group_of.max(initial=0) < self.config.groups
+        self.group_of = group_of
+        self.state = dataclasses.replace(
+            self.state, group_of=jnp.asarray(group_of)
+        )
+
+    def drop_broadcasts(self, receiver_group: int, sender_nodes: np.ndarray) -> None:
+        """Group ``receiver_group`` stops hearing broadcasts originating from
+        ``sender_nodes`` (models lossy/partitioned dissemination)."""
+        self._deliver[receiver_group, np.atleast_1d(sender_nodes)] = False
 
     def _probe_drop_mask(self) -> np.ndarray:
         """Map the partitioned-destination set onto the current adjacency."""
@@ -188,13 +215,14 @@ class Simulator:
         """Run device batches until consensus decides a cut, then apply the
         view change. Returns the record, or None if no decision in budget.
 
-        If the fast round stalls (proposal announced but the 3/4 supermajority
-        is unreachable, e.g. too many members crashed to vote) for
+        If the fast round stalls (some group announced a proposal but no
+        identical-proposal pool reaches the 3/4 supermajority -- too many
+        members crashed, blind, or holding diverging proposals) for
         ``classic_fallback_after_rounds`` rounds, the host runs the classic
         Paxos recovery round among the live members (FastPaxos.java:189-195):
-        every live acceptor voted the identical proposal in the fast round, so
-        the coordinator rule picks it, and it decides iff live members form a
-        majority (> N/2, Paxos.java:168,229)."""
+        the coordinator value-pick rule chooses among the groups' fast-round
+        votes (see _classic_round_winner), and the choice decides iff live
+        members form a majority (> N/2, Paxos.java:168,229)."""
         t0 = time.perf_counter()
         rounds_done = 0
         announced_for = 0
@@ -206,6 +234,7 @@ class Simulator:
                 probe_drop=self._probe_drop_mask(),
                 drop_prob=self._drop_prob,
                 join_reports=join_reports,
+                deliver=self._deliver,
             )
             n = min(batch, max_rounds - rounds_done)
             random_loss = bool((self._drop_prob > 0).any())
@@ -219,35 +248,65 @@ class Simulator:
             rounds_done += n
             if decided:
                 return self._apply_view_change(t0)
-            if bool(self.state.announced):
+            if bool(np.asarray(self.state.announced).any()):
                 announced_for += n
                 if (
                     classic_fallback_after_rounds is not None
                     and announced_for >= classic_fallback_after_rounds
-                    and self._classic_round_decides()
                 ):
-                    self.state = dataclasses.replace(
-                        self.state, decided=jnp.asarray(True),
-                        decided_round=self.state.round,
-                    )
-                    record = self._apply_view_change(t0)
-                    record.via_classic_round = True
-                    return record
+                    winner = self._classic_round_winner()
+                    if winner is not None:
+                        self.state = dataclasses.replace(
+                            self.state, decided=jnp.asarray(True),
+                            decided_group=jnp.asarray(winner, jnp.int32),
+                            decided_round=self.state.round,
+                        )
+                        record = self._apply_view_change(t0)
+                        record.via_classic_round = True
+                        return record
         self.virtual_ms += rounds_done * self.config.fd_interval_ms
         self._billed_rounds += rounds_done
         return None
 
-    def _classic_round_decides(self) -> bool:
-        """Classic-round quorum check: live members must form a majority of
-        the current configuration."""
+    def _classic_round_winner(self) -> Optional[int]:
+        """Host-side classic recovery round: the coordinator value-pick rule
+        over the groups' fast-round votes (Paxos.java:269-326), deciding iff
+        live members form a majority (Paxos.java:168,229).
+
+        All fast-round votes are at the same (fast) rank, so the rule reduces
+        to: a single distinct proposed value wins; otherwise a value with
+        more than N/4 votes wins; otherwise any proposed value may be picked.
+        Returns the winning group's index, or None if no decision is possible."""
         n = int(self.active.sum())
-        live = int((self.active & self.alive).sum())
-        return live > n // 2
+        live = self.active & self.alive
+        if int(live.sum()) <= n // 2:
+            return None
+        announced = np.asarray(self.state.announced)
+        if not announced.any():
+            return None
+        proposals = np.asarray(self.state.proposal)
+        group_live = np.bincount(
+            self.group_of[live], minlength=self.config.groups
+        )
+        announced_groups = np.flatnonzero(announced)
+        distinct: dict = {}
+        for g in announced_groups:
+            key = proposals[g].tobytes()
+            distinct.setdefault(key, [0, int(g)])
+            distinct[key][0] += int(group_live[g])
+        if len(distinct) == 1:
+            return next(iter(distinct.values()))[1]
+        for votes, g in distinct.values():
+            if votes > n // 4:
+                return g
+        # any proposed value is safe to pick at this point
+        return next(iter(distinct.values()))[1]
 
     def _apply_view_change(self, t0: float) -> ViewChangeRecord:
         self.metrics.incr("view_changes")
         jax.block_until_ready(self.state.proposal)
-        cut = np.asarray(self.state.proposal)
+        # the winning group's proposal is the decided cut
+        cut = np.asarray(self.state.proposal)[int(self.state.decided_group)]
         decided_round = int(self.state.decided_round)
         removed = np.flatnonzero(cut & self.active)
         added = np.flatnonzero(cut & ~self.active)
@@ -281,6 +340,7 @@ class Simulator:
         self.state = initial_state(
             self.config, self.cluster, self.active,
             seed=self.seed + len(self.view_changes),
+            group_of=self.group_of,
         )
         self.state = dataclasses.replace(
             self.state, alive=jnp.asarray(self.alive & self.active)
@@ -335,10 +395,11 @@ class Simulator:
             alive=self.alive,
             identifiers_seen=np.array(sorted(self.identifiers_seen), dtype=np.int64),
             virtual_ms=np.int64(self.virtual_ms),
+            group_of=self.group_of,
             params=np.array(
                 [self.config.capacity, self.config.k, self.config.h, self.config.l,
                  self.config.fd_threshold, self.config.fd_interval_ms,
-                 self.config.batching_window_ms, self.seed],
+                 self.config.batching_window_ms, self.seed, self.config.groups],
                 dtype=np.int64,
             ),
         )
@@ -348,11 +409,14 @@ class Simulator:
         """Rebuild a simulator from a configuration snapshot; the
         configuration id of the restored instance equals the saved one."""
         with np.load(path) as data:
+            params = [int(x) for x in data["params"]]
             (capacity, k, h, l, fd_threshold, fd_interval_ms,
-             batching_window_ms, seed) = (int(x) for x in data["params"])
+             batching_window_ms, seed) = params[:8]
+            groups = params[8] if len(params) > 8 else 1  # pre-groups snapshots
             config = SimConfig(
                 capacity=capacity, k=k, h=h, l=l, fd_threshold=fd_threshold,
                 fd_interval_ms=fd_interval_ms, batching_window_ms=batching_window_ms,
+                groups=groups,
             )
             sim = Simulator.__new__(Simulator)
             sim.config = config
@@ -369,7 +433,14 @@ class Simulator:
             sim.identifiers_seen = set(int(i) for i in data["identifiers_seen"])
             sim.seed = seed
             sim.virtual_ms = int(data["virtual_ms"])
-        sim.state = initial_state(sim.config, sim.cluster, sim.active, seed=sim.seed)
+            sim.group_of = (
+                data["group_of"].copy()
+                if "group_of" in data
+                else np.zeros(capacity, dtype=np.int32)
+            )
+        sim.state = initial_state(
+            sim.config, sim.cluster, sim.active, seed=sim.seed, group_of=sim.group_of
+        )
         sim.state = dataclasses.replace(
             sim.state, alive=jnp.asarray(sim.alive & sim.active)
         )
@@ -379,6 +450,7 @@ class Simulator:
         sim.tracer = Tracer()
         sim._ingress_partitioned = set()
         sim._drop_prob = np.zeros(sim.config.capacity, dtype=np.float32)
+        sim._deliver = np.ones((sim.config.groups, sim.config.capacity), dtype=bool)
         sim._pending_joiners = set()
         sim._join_reports_armed = False
         return sim

@@ -4,25 +4,35 @@ This is the TPU execution backend for Rapid's steady-state loop
 (SURVEY.md §3.3, MembershipService.java:297-348): each simulated round
 1. evaluates every monitoring edge's probe (PingPongFailureDetector semantics:
    cumulative failure counter, threshold 10 -- PingPongFailureDetector.java:40,69-77),
-2. scatters newly-crossed edges as DOWN alerts along the observer->subject
-   adjacency (alert fan-out, MembershipService.java:602-626),
-3. updates the per-destination H/L watermark report table and applies one
+2. routes newly-crossed edges as DOWN alerts along the observer->subject
+   adjacency (the batched equivalent of the unicast-to-all broadcast,
+   MembershipService.java:602-626),
+3. updates per-destination H/L watermark report tables and applies one
    implicit-invalidation pass (MultiNodeCutDetector.java:76-164),
 4. tallies fast-round votes and decides at the 3/4 supermajority
    (FastPaxos.java:145-150).
 
+**Delivery groups** make almost-everywhere agreement real rather than assumed:
+nodes are partitioned into G delivery classes; the fault plane can drop
+broadcast traffic per (receiving group, sender), so groups can see different
+alert subsets, hold *different* cut-detector states, and propose different
+cuts. Consensus then genuinely has to resolve the divergence: votes are
+tallied by comparing group proposals, and a cut only decides when groups
+agreeing on an identical proposal hold a 3/4 supermajority of live members.
+G=1 reduces to uniform delivery.
+
 All state lives in capacity-padded arrays (static shapes; membership churn is
-an active-mask update + host-side adjacency rebuild). ``run_rounds`` scans R
+an active-mask update + host-side adjacency rebuild). ``run_rounds*`` scans R
 rounds per device dispatch; once ``decided`` latches the remaining rounds are
 masked no-ops, so the host can run large batches without losing the decision
-round. Everything here is elementwise/gather/scatter arithmetic on [C, K]
+round. Everything here is elementwise/gather arithmetic on [C,K] / [G,C,K]
 arrays -- HBM-bandwidth bound, which is exactly what the TPU vector units eat.
 """
 
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Tuple
 
 import jax
@@ -43,6 +53,7 @@ class SimConfig:
     fd_threshold: int = 10  # PingPongFailureDetector.FAILURE_THRESHOLD
     fd_interval_ms: int = 1000  # MembershipService.java:77
     batching_window_ms: int = 100  # MembershipService.java:75
+    groups: int = 1  # delivery classes (heterogeneous broadcast delivery)
     # Fuse the probe/counter/alert elementwise phase into one Pallas kernel
     # (sim/pallas_kernels.py). "off" = stock jax; "tpu" = hardware kernel;
     # "interpret" = Pallas interpreter (CPU-testable).
@@ -56,15 +67,17 @@ class SimState:
 
     active: jax.Array  # bool[C] current membership
     alive: jax.Array  # bool[C] fault-model liveness (crashed => False)
+    group_of: jax.Array  # int32[C] delivery group of each node
     subjects: jax.Array  # int32[C, K] monitored node per ring
     observers: jax.Array  # int32[C, K] monitoring node per ring
     fd_fail: jax.Array  # int32[C, K] cumulative failed probes per edge
     alerted: jax.Array  # bool[C, K] edge already reported DOWN
-    reports: jax.Array  # bool[C, K] cut-detector report table (dst, ring)
-    seen_down: jax.Array  # bool[] any DOWN alert this configuration
-    announced: jax.Array  # bool[] proposal announced (consensus started)
-    proposal: jax.Array  # bool[C] latched proposal mask
+    reports: jax.Array  # bool[G, C, K] per-group report tables (dst, ring)
+    seen_down: jax.Array  # bool[G] group saw a DOWN alert this configuration
+    announced: jax.Array  # bool[G] group announced its proposal
+    proposal: jax.Array  # bool[G, C] latched proposal mask per group
     decided: jax.Array  # bool[] consensus reached
+    decided_group: jax.Array  # int32[] group whose proposal won
     decided_round: jax.Array  # int32[] round at which decision happened
     round: jax.Array  # int32[] rounds elapsed in this configuration
     rng_key: jax.Array
@@ -79,6 +92,7 @@ class RoundInputs:
     probe_drop: jax.Array  # bool[C, K] deterministic probe drops (one-way loss)
     drop_prob: jax.Array  # float32[C] random ingress-loss probability per dst
     join_reports: jax.Array  # bool[C, K] UP-alert reports for joining slots
+    deliver: jax.Array  # bool[G, C] does group g hear broadcasts from node i
 
 
 def initial_state(
@@ -86,95 +100,109 @@ def initial_state(
     cluster: VirtualCluster,
     active: np.ndarray,
     seed: int = 0,
+    group_of: Optional[np.ndarray] = None,
 ) -> SimState:
     subjects, observers = build_adjacency(cluster, active)
-    c, k = config.capacity, config.k
+    c, k, g = config.capacity, config.k, config.groups
+    if group_of is None:
+        group_of = np.zeros(c, dtype=np.int32)
     return SimState(
         active=jnp.asarray(active),
         alive=jnp.asarray(active),
+        group_of=jnp.asarray(group_of, dtype=jnp.int32),
         subjects=jnp.asarray(subjects),
         observers=jnp.asarray(observers),
         fd_fail=jnp.zeros((c, k), jnp.int32),
         alerted=jnp.zeros((c, k), bool),
-        reports=jnp.zeros((c, k), bool),
-        seen_down=jnp.asarray(False),
-        announced=jnp.asarray(False),
-        proposal=jnp.zeros(c, bool),
+        reports=jnp.zeros((g, c, k), bool),
+        seen_down=jnp.zeros(g, bool),
+        announced=jnp.zeros(g, bool),
+        proposal=jnp.zeros((g, c), bool),
         decided=jnp.asarray(False),
+        decided_group=jnp.asarray(0, jnp.int32),
         decided_round=jnp.asarray(0, jnp.int32),
         round=jnp.asarray(0, jnp.int32),
         rng_key=jax.random.PRNGKey(seed),
     )
 
 
-def _gather_alerts(
-    reports: jax.Array, observers: jax.Array, new_alerts: jax.Array,
-    active: jax.Array,
-) -> jax.Array:
-    """OR each observer-edge alert into its (dst, ring) report slot.
-
-    On ring k the subject map (i -> subjects[i,k]) and the observer map
-    (d -> observers[d,k]) are inverse permutations over the active set, so the
-    scatter "alert from observer i lands at (subjects[i,k], k)" is exactly the
-    gather ``reports[d,k] |= new_alerts[observers[d,k], k]`` -- and gathers
-    are far cheaper than scatters on TPU. The gather is masked to active
-    destinations: inactive rows' observers entries are either self-loops or
-    (for pending joiners) their *expected* observers, whose DOWN alerts are
-    about different destinations entirely.
-    """
-    k = reports.shape[1]
-    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
-    return reports | (new_alerts[observers, cols] & active[:, None])
-
-
-def cut_and_tally(
+def route_and_tally(
     config: SimConfig,
     state: SimState,
-    reports: jax.Array,
-    seen_down: jax.Array,
+    down_arrivals: jax.Array,  # bool[C, K] dst-indexed DOWN alert arrivals
+    inputs: RoundInputs,
     active: jax.Array,
     alive: jax.Array,
 ):
-    """The replicated protocol phase, shared by the single-device and sharded
-    steps: H/L watermark cut detection, one implicit-invalidation pass,
-    proposal emission, and the fast-round vote tally.
+    """Alert delivery, per-group cut detection, and the fast-round tally --
+    shared by the single-device and sharded steps.
 
-    Returns (reports, announced, proposal, decided, decided_round).
+    ``down_arrivals[d, k]`` is the (dst, ring)-indexed view of this round's
+    DOWN alerts; the sender of the (d, k) alert is ``observers[d, k]`` (the
+    unique observer of d on ring k). Join UP alerts arrive via
+    ``inputs.join_reports`` with the joiner's expected observer in the same
+    observers row. Each delivery group receives an alert iff its
+    ``deliver[g, sender]`` entry is set.
+
+    Returns (reports, seen_down, announced, proposal, decided, decided_group,
+    decided_round).
     """
-    # --- cut detection: H/L watermarks ------------------------------------
-    counts = reports.sum(axis=1)
+    sender = state.observers  # [C, K]
+    arrivals = down_arrivals | inputs.join_reports  # [C, K]
+    deliver = inputs.deliver[:, sender]  # [G, C, K]
+    reports = state.reports | (arrivals[None, :, :] & deliver)
+    seen_down = state.seen_down | jnp.any(
+        down_arrivals[None, :, :] & deliver, axis=(1, 2)
+    )
+
+    # --- per-group cut detection: H/L watermarks ---------------------------
+    counts = reports.sum(axis=2)  # [G, C]
     in_flux = (counts >= config.l) & (counts < config.h)
     stable = counts >= config.h
 
-    # One implicit-invalidation pass (per-batch call in the reference,
-    # MembershipService.java:327): edges from observers that are themselves
-    # in flux or stable count as implicit reports. Applies to failing members
-    # (DOWN edges, via their successors) AND to joining slots (UP edges, via
-    # their expected observers -- MultiNodeCutDetector.java:146-158); the
-    # driver writes each joiner's expected observers into its observers row.
-    obs_in_flux = (in_flux | stable)[state.observers]  # [C, K]
-    implicit = seen_down & in_flux[:, None] & obs_in_flux & ~reports
+    # One implicit-invalidation pass per round (the per-batch call in the
+    # reference, MembershipService.java:327): edges from observers that are
+    # themselves in flux or stable count as implicit reports
+    # (MultiNodeCutDetector.java:137-164). Covers failing members (their
+    # successors) and joiners (their expected observers, written into the
+    # observers row by the driver).
+    fs = in_flux | stable  # [G, C]
+    obs_fs = fs[:, state.observers]  # [G, C, K]
+    implicit = (
+        seen_down[:, None, None] & in_flux[:, :, None] & obs_fs & ~reports
+    )
     reports = reports | implicit
-    counts = reports.sum(axis=1)
+    counts = reports.sum(axis=2)
     in_flux = (counts >= config.l) & (counts < config.h)
     stable = counts >= config.h
 
-    # --- proposal emission (almost-everywhere agreement) -------------------
-    emit = jnp.any(stable) & ~jnp.any(in_flux) & ~state.announced
+    # --- proposal emission per group ---------------------------------------
+    emit = jnp.any(stable, axis=1) & ~jnp.any(in_flux, axis=1) & ~state.announced
     announced = state.announced | emit
-    proposal = jnp.where(emit, stable, state.proposal)
+    proposal = jnp.where(emit[:, None], stable, state.proposal)
 
-    # --- fast-round vote tally --------------------------------------------
-    # Under uniform alert delivery every live member proposes the same cut, so
-    # the tally is the live-member count; quorum is N - floor((N-1)/4)
-    # (FastPaxos.java:145-150).
+    # --- fast-round vote tally across groups -------------------------------
+    # Every live member votes its group's proposal once announced; identical
+    # proposals pool their votes; decision at N - floor((N-1)/4) identical
+    # votes (FastPaxos.java:145-150).
+    live = active & alive
+    g = config.groups
+    group_live = jnp.zeros(g, jnp.int32).at[state.group_of].add(
+        live.astype(jnp.int32)
+    )
+    eq = jnp.all(proposal[:, None, :] == proposal[None, :, :], axis=2)  # [G, G]
+    votes_for = jnp.sum(
+        jnp.where(eq & announced[None, :], group_live[None, :], 0), axis=1
+    )  # [G]
     n = active.sum()
-    voters = (active & alive).sum()
     quorum = n - (n - 1) // 4
-    decide_now = announced & ~state.decided & (voters >= quorum)
+    qualifies = announced & (votes_for >= quorum)
+    decide_now = jnp.any(qualifies) & ~state.decided
+    winner = jnp.argmax(jnp.where(qualifies, votes_for, -1)).astype(jnp.int32)
     decided = state.decided | decide_now
+    decided_group = jnp.where(decide_now, winner, state.decided_group)
     decided_round = jnp.where(decide_now, state.round + 1, state.decided_round)
-    return reports, announced, proposal, decided, decided_round
+    return reports, seen_down, announced, proposal, decided, decided_group, decided_round
 
 
 def step(config: SimConfig, state: SimState, inputs: RoundInputs,
@@ -218,7 +246,6 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
     else:
         fail_event = edge_live & observer_up & ~probe_ok
         fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
-        # --- alert generation --------------------------------------------
         new_down = (
             edge_live
             & observer_up
@@ -226,17 +253,24 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
             & ~state.alerted
         )
         alerted = state.alerted | new_down
-    reports = _gather_alerts(state.reports, state.observers, new_down, active)
-    reports = reports | inputs.join_reports
-    seen_down = state.seen_down | jnp.any(new_down)
 
-    reports, announced, proposal, decided, decided_round = cut_and_tally(
-        config, state, reports, seen_down, active, alive
-    )
+    # --- alert routing (dst-indexed): on ring k the subject and observer
+    # maps are inverse permutations over the active set, so the scatter
+    # "alert from observer i lands at (subjects[i,k], k)" is exactly the
+    # gather ``down_arrivals[d,k] = new_down[observers[d,k], k]`` -- and
+    # gathers are far cheaper than scatters on TPU. Masked to active
+    # destinations (joiner rows hold *expected* observers).
+    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+    down_arrivals = new_down[state.observers, cols] & active[:, None]
+
+    (reports, seen_down, announced, proposal, decided, decided_group,
+     decided_round) = route_and_tally(config, state, down_arrivals, inputs,
+                                      active, alive)
 
     new_state = SimState(
         active=active,
         alive=inputs.alive,
+        group_of=state.group_of,
         subjects=state.subjects,
         observers=state.observers,
         fd_fail=fd_fail,
@@ -246,6 +280,7 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
         announced=announced,
         proposal=proposal,
         decided=decided,
+        decided_group=decided_group,
         decided_round=decided_round,
         round=state.round + 1,
         rng_key=key,
@@ -290,12 +325,14 @@ def const_inputs(
     probe_drop: Optional[np.ndarray] = None,
     drop_prob: Optional[np.ndarray] = None,
     join_reports: Optional[np.ndarray] = None,
+    deliver: Optional[np.ndarray] = None,
 ) -> RoundInputs:
     """A single-round fault plane (for run_rounds_const)."""
-    c, k = config.capacity, config.k
+    c, k, g = config.capacity, config.k, config.groups
     return RoundInputs(
         alive=jnp.asarray(alive),
         probe_drop=jnp.zeros((c, k), bool) if probe_drop is None else jnp.asarray(probe_drop),
         drop_prob=jnp.zeros(c, jnp.float32) if drop_prob is None else jnp.asarray(drop_prob),
         join_reports=jnp.zeros((c, k), bool) if join_reports is None else jnp.asarray(join_reports),
+        deliver=jnp.ones((g, c), bool) if deliver is None else jnp.asarray(deliver),
     )

@@ -1,0 +1,143 @@
+"""Heterogeneous alert delivery: almost-everywhere agreement made real.
+
+With delivery groups, different node classes can miss different broadcast
+subsets, hold diverging cut-detector states, and propose different cuts --
+the scenario Rapid's H/L filter + 3/4 supermajority exist to survive
+(paper §4-5). These tests pin down the consensus semantics under divergence.
+"""
+
+import numpy as np
+import pytest
+
+from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.sim.engine import SimConfig
+
+
+def make(n, groups, group_split, seed=0, capacity=None):
+    """Simulator with the first ``group_split`` nodes in group 0, rest group 1."""
+    config = SimConfig(capacity=capacity or n, groups=groups)
+    sim = Simulator(n, capacity=capacity, config=config, seed=seed)
+    group_of = np.zeros(config.capacity, dtype=np.int32)
+    group_of[group_split:] = 1
+    sim.set_delivery_groups(group_of)
+    return sim
+
+
+def test_single_group_matches_default():
+    """G=1 with full delivery behaves exactly like the ungrouped engine."""
+    a = Simulator(30, seed=3)
+    b = make(30, groups=2, group_split=30, seed=3)  # group 1 empty
+    for sim in (a, b):
+        sim.crash(np.array([5, 6]))
+    ra = a.run_until_decision(max_rounds=40)
+    rb = b.run_until_decision(max_rounds=40)
+    assert set(ra.cut) == set(rb.cut) == {5, 6}
+    assert ra.configuration_id == rb.configuration_id
+    assert ra.virtual_time_ms == rb.virtual_time_ms
+
+
+def test_small_blind_minority_does_not_block_decision():
+    """A minority group that misses every alert never announces; the seeing
+    supermajority still reaches the 3/4 quorum -- almost-everywhere agreement
+    (paper §4: the cut commits without unanimity)."""
+    n = 40
+    sim = make(n, groups=2, group_split=36, seed=4)  # 4 blind nodes
+    victim = np.array([10])
+    sim.crash(victim)
+    # group 1 hears nothing from anyone
+    sim.drop_broadcasts(1, np.arange(n))
+    rec = sim.run_until_decision(max_rounds=40, classic_fallback_after_rounds=None)
+    assert rec is not None, "36/40 identical votes meet quorum 40-9=31"
+    assert list(rec.cut) == [10]
+
+
+def test_large_blind_minority_blocks_fast_path_then_classic_recovers():
+    """If more than F = floor((N-1)/4) members never announce, the fast round
+    cannot decide; the classic recovery round among the live majority picks
+    the announced proposal."""
+    n = 40
+    sim = make(n, groups=2, group_split=28, seed=5)  # 12 blind > F=9
+    victim = np.array([10])
+    sim.crash(victim)
+    sim.drop_broadcasts(1, np.arange(n))
+    # no fast decision possible: 27 live announced votes < quorum 31
+    rec_stalled = sim.run_until_decision(
+        max_rounds=24, classic_fallback_after_rounds=None
+    )
+    assert rec_stalled is None
+    rec = sim.run_until_decision(max_rounds=24, classic_fallback_after_rounds=4)
+    assert rec is not None and rec.via_classic_round
+    assert list(rec.cut) == [10]
+
+
+def test_in_flux_group_blocks_fast_path_until_classic_round():
+    """A group that misses broadcasts from 3 of the victim's 10 observers
+    collects only 7 reports -- inside the [L=4, H=9) flux band -- so it never
+    announces. With 10 of 40 members stuck (> F = 9), no identical-proposal
+    pool reaches the quorum of 31: the fast path genuinely blocks under
+    diverging views, and the classic recovery round picks the announced
+    proposal."""
+    n = 40
+    sim = make(n, groups=2, group_split=30, seed=6)
+    victim = 10
+    sim.crash(np.array([victim]))
+    # group 1 (10 nodes) misses broadcasts from 3 observers of the victim
+    observers = np.asarray(sim.state.observers)[victim][:3]
+    sim.drop_broadcasts(1, observers)
+    rec = sim.run_until_decision(max_rounds=40, classic_fallback_after_rounds=None)
+    assert rec is None  # group 0's 29-30 live votes < quorum 31
+    # and the classic round resolves it
+    rec = sim.run_until_decision(max_rounds=10, classic_fallback_after_rounds=2)
+    assert rec is not None and rec.via_classic_round
+    assert list(rec.cut) == [victim]
+
+
+def test_two_groups_identical_views_pool_votes():
+    """Groups with identical proposals pool their votes: 2 groups seeing
+    everything decide on the fast path immediately."""
+    n = 40
+    sim = make(n, groups=2, group_split=20, seed=7)
+    sim.crash(np.array([3, 4]))
+    rec = sim.run_until_decision(max_rounds=40, classic_fallback_after_rounds=None)
+    assert rec is not None and not rec.via_classic_round
+    assert set(rec.cut) == {3, 4}
+
+
+def test_grouped_sharded_matches_single_device():
+    """The sharded engine agrees with the single-device engine under
+    heterogeneous delivery."""
+    import jax
+
+    from rapid_tpu.shard.engine import (
+        make_mesh,
+        make_sharded_run,
+        place_inputs,
+        place_state,
+    )
+    from rapid_tpu.sim.engine import const_inputs, initial_state, run_rounds_const
+    from rapid_tpu.sim.topology import VirtualCluster
+
+    c = 64
+    cfg = SimConfig(capacity=c, groups=2)
+    vc = VirtualCluster.synthesize(c, cfg.k, seed=8)
+    active = np.ones(c, dtype=bool)
+    # blind minority of 8 < F = floor(63/4) = 15, so the fast path decides
+    group_of = np.zeros(c, dtype=np.int32)
+    group_of[56:] = 1
+    state = initial_state(cfg, vc, active, seed=8, group_of=group_of)
+    alive = active.copy()
+    alive[[5]] = False
+    deliver = np.ones((2, c), dtype=bool)
+    deliver[1, :] = False  # group 1 fully blind
+    inputs = const_inputs(cfg, alive, deliver=deliver)
+
+    single = run_rounds_const(cfg, state, inputs, 14)
+    mesh = make_mesh(8)
+    run = make_sharded_run(cfg, mesh, rounds=14)
+    sharded = run(place_state(state, mesh), place_inputs(inputs, mesh))
+
+    assert bool(single.decided) == bool(sharded.decided) == True  # noqa: E712
+    np.testing.assert_array_equal(
+        np.asarray(single.proposal), np.asarray(sharded.proposal)
+    )
+    assert int(single.decided_group) == int(sharded.decided_group)

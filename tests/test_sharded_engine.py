@@ -58,9 +58,9 @@ def test_sharded_state_is_actually_sharded(mesh):
     shards = placed.fd_fail.addressable_shards
     assert len(shards) == 8
     assert shards[0].data.shape == (64 // 8, cfg.k)
-    # replicated arrays present fully on every device
+    # replicated arrays present fully on every device ([G, C, K] report table)
     rep_shards = placed.reports.addressable_shards
-    assert all(s.data.shape == (64, cfg.k) for s in rep_shards)
+    assert all(s.data.shape == (cfg.groups, 64, cfg.k) for s in rep_shards)
 
 
 def test_sharded_no_fault_no_decision(mesh):
