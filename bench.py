@@ -40,6 +40,7 @@ def main() -> None:
     warm_wall = warm.wall_time_s
 
     sim2 = Simulator(N_NODES, seed=5678)
+    sim2.ready()  # drain construction from the device queue
     victims2 = rng.choice(N_NODES, size=int(N_NODES * FAIL_FRACTION), replace=False)
     sim2.crash(victims2)
     t0 = time.perf_counter()

@@ -28,15 +28,16 @@ import numpy as np
 
 from ..observability import Metrics, Tracer
 from .engine import (
+    RoundInputs,
     SimConfig,
     SimState,
-    const_inputs,
-    initial_state,
+    device_initial_state,
     run_rounds_const,
+    run_until_decided_const,
 )
 from .topology import (
     VirtualCluster,
-    configuration_id_vectorized,
+    config_fold,
     ring_order,
 )
 
@@ -77,9 +78,8 @@ class Simulator:
         # real rejoining process draws a fresh UUID (Cluster.java:327-331).
         self.identifiers_seen: Set[int] = set(np.flatnonzero(self.active))
         self.seed = seed
-        self.state = initial_state(
-            self.config, self.cluster, self.active, seed=seed, group_of=self.group_of
-        )
+        self._init_device_caches()
+        self.state = self._fresh_state(seed)
         self.virtual_ms = 0
         self._billed_rounds = 0  # rounds of this configuration already billed
         self.view_changes: List[ViewChangeRecord] = []
@@ -92,6 +92,37 @@ class Simulator:
         self._pending_joiners: Set[int] = set()
         self._join_reports_armed = False
 
+    def _init_device_caches(self) -> None:
+        """Device-resident constants allocated once per simulator: the signed
+        ring keys (so adjacency rebuilds never re-upload them) and the
+        all-clear fault-plane arrays (so quiet rounds transfer nothing but
+        the [C] liveness mask)."""
+        c, k, g = self.config.capacity, self.config.k, self.config.groups
+        self._ring_rank_dev = jnp.asarray(self.cluster.ring_rank())
+        self._zero_ck = jnp.zeros((c, k), bool)
+        self._zero_drop_prob = jnp.zeros(c, jnp.float32)
+        self._ones_deliver = jnp.ones((g, c), bool)
+        self._alive_dev: Optional[jax.Array] = None
+        self._probe_drop_dev: Optional[jax.Array] = None
+        self._subjects_host: Optional[np.ndarray] = None
+        self._ring_nodes: Optional[List[np.ndarray]] = None
+        self._ids_sorted: Optional[np.ndarray] = None
+
+    def _fresh_state(self, seed: int) -> SimState:
+        """Fresh-configuration state, built on device (engine.device_initial_state)."""
+        self._subjects_host = None
+        self._ring_nodes = None
+        self._alive_dev = None
+        self._probe_drop_dev = None  # partition set maps onto new adjacency
+        return device_initial_state(
+            self.config,
+            self._ring_rank_dev,
+            jnp.asarray(self.active),
+            jnp.asarray(self.alive & self.active),
+            jnp.asarray(self.group_of),
+            jax.random.PRNGKey(seed),
+        )
+
     # ------------------------------------------------------------------ #
     # Fault injection (BASELINE.json configs)
     # ------------------------------------------------------------------ #
@@ -99,18 +130,21 @@ class Simulator:
     def crash(self, node_ids: np.ndarray) -> None:
         """Crash-stop burst: nodes stop responding to probes and stop voting."""
         self.alive[np.atleast_1d(node_ids)] = False
+        self._alive_dev = None
 
     def revive(self, node_ids: np.ndarray) -> None:
         """Flip-flop support: nodes become reachable again (cumulative FD
         counters are deliberately NOT reset -- PingPongFailureDetector.java:116-118)."""
         node_ids = np.atleast_1d(node_ids)
         self.alive[node_ids] = self.active[node_ids]
+        self._alive_dev = None
 
     def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
         """Asymmetric failure: probes TO these nodes are lost, their own
         traffic still flows (paper §7, iptables INPUT partitions). Persists
         across view changes until lifted."""
         self._ingress_partitioned.update(int(i) for i in np.atleast_1d(node_ids))
+        self._probe_drop_dev = None
 
     def ingress_loss(self, node_ids: np.ndarray, probability: float) -> None:
         """Lossy ingress (e.g. 80% loss): probes to these nodes fail with
@@ -121,6 +155,7 @@ class Simulator:
         self._ingress_partitioned.clear()
         self._drop_prob[:] = 0.0
         self._deliver[:] = True
+        self._probe_drop_dev = None
 
     # ------------------------------------------------------------------ #
     # Heterogeneous broadcast delivery (almost-everywhere agreement)
@@ -149,8 +184,38 @@ class Simulator:
         mask = np.zeros(self.config.capacity, dtype=bool)
         if self._ingress_partitioned:
             mask[list(self._ingress_partitioned)] = True
-        subjects = np.asarray(self.state.subjects)
-        return mask[subjects]
+        if self._subjects_host is None:
+            self._subjects_host = np.asarray(self.state.subjects)
+        return mask[self._subjects_host]
+
+    def _const_inputs(self, join_reports: Optional[np.ndarray]) -> RoundInputs:
+        """This dispatch's fault plane, reusing the device-resident all-clear
+        arrays whenever a fault class is inactive."""
+        if self._alive_dev is None:
+            self._alive_dev = jnp.asarray(self.alive)
+        if self._ingress_partitioned and self._probe_drop_dev is None:
+            self._probe_drop_dev = jnp.asarray(self._probe_drop_mask())
+        return RoundInputs(
+            alive=self._alive_dev,
+            probe_drop=(
+                self._probe_drop_dev
+                if self._ingress_partitioned
+                else self._zero_ck
+            ),
+            drop_prob=(
+                jnp.asarray(self._drop_prob)
+                if (self._drop_prob > 0).any()
+                else self._zero_drop_prob
+            ),
+            join_reports=(
+                self._zero_ck if join_reports is None else jnp.asarray(join_reports)
+            ),
+            deliver=(
+                self._ones_deliver
+                if self._deliver.all()
+                else jnp.asarray(self._deliver)
+            ),
+        )
 
     # ------------------------------------------------------------------ #
     # Joins
@@ -191,14 +256,16 @@ class Simulator:
         k = self.config.k
         ids = np.zeros(k, dtype=np.int32)
         alive = np.zeros(k, dtype=bool)
-        active_idx = np.flatnonzero(self.active)
+        if self._ring_nodes is None:
+            full_order = self.cluster.full_ring_order()
+            self._ring_nodes = [
+                full_order[ring][self.active[full_order[ring]]] for ring in range(k)
+            ]
+        signed = self.cluster.ring_hashes.view(np.int64)
         for ring in range(k):
-            hashes = self.cluster.ring_hashes[ring, active_idx].view(np.int64)
-            me = np.int64(self.cluster.ring_hashes[ring, node].view(np.int64))
-            order = np.argsort(hashes, kind="stable")
-            ring_nodes = active_idx[order]
-            sorted_hashes = hashes[order]
-            pos = np.searchsorted(sorted_hashes, me)
+            ring_nodes = self._ring_nodes[ring]
+            me = signed[ring, node]
+            pos = np.searchsorted(signed[ring, ring_nodes], me)
             pred = ring_nodes[pos - 1] if pos > 0 else ring_nodes[-1]
             ids[ring] = pred
             alive[ring] = self.alive[pred]
@@ -228,27 +295,35 @@ class Simulator:
         announced_for = 0
         while rounds_done < max_rounds:
             join_reports = self._arm_pending_joins()
-            inputs = const_inputs(
-                self.config,
-                self.alive,
-                probe_drop=self._probe_drop_mask(),
-                drop_prob=self._drop_prob,
-                join_reports=join_reports,
-                deliver=self._deliver,
-            )
+            inputs = self._const_inputs(join_reports)
             n = min(batch, max_rounds - rounds_done)
             random_loss = bool((self._drop_prob > 0).any())
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
-                self.state = run_rounds_const(
-                    self.config, self.state, inputs, n, random_loss
+                if random_loss:
+                    # per-round RNG: the general scan path
+                    self.state = run_rounds_const(
+                        self.config, self.state, inputs, n, True
+                    )
+                else:
+                    # deterministic constant plane: one early-exiting dispatch
+                    self.state = run_until_decided_const(
+                        self.config, self.state, inputs, jnp.int32(n),
+                        bool(self._deliver.all()),
+                    )
+                # one host<->device round trip syncs the batch and fetches
+                # both control bits
+                decided, announced_any = (
+                    bool(v)
+                    for v in jax.device_get(
+                        (self.state.decided, jnp.any(self.state.announced))
+                    )
                 )
-                decided = bool(self.state.decided)  # syncs the device batch
             self.metrics.incr("rounds", n)
             self.metrics.incr("device_dispatches")
             rounds_done += n
             if decided:
                 return self._apply_view_change(t0)
-            if bool(np.asarray(self.state.announced).any()):
+            if announced_any:
                 announced_for += n
                 if (
                     classic_fallback_after_rounds is not None
@@ -304,16 +379,21 @@ class Simulator:
 
     def _apply_view_change(self, t0: float) -> ViewChangeRecord:
         self.metrics.incr("view_changes")
-        jax.block_until_ready(self.state.proposal)
+        # one transfer for everything the host needs from the decided state
+        proposal_np, decided_group, decided_round = jax.device_get(
+            (self.state.proposal, self.state.decided_group, self.state.decided_round)
+        )
         # the winning group's proposal is the decided cut
-        cut = np.asarray(self.state.proposal)[int(self.state.decided_group)]
-        decided_round = int(self.state.decided_round)
+        cut = proposal_np[int(decided_group)]
+        decided_round = int(decided_round)
         removed = np.flatnonzero(cut & self.active)
         added = np.flatnonzero(cut & ~self.active)
         self.active[removed] = False
         self.active[added] = True
         self.alive[added] = True
         self.identifiers_seen.update(int(i) for i in added)
+        if len(added):
+            self._ids_sorted = None
         self._pending_joiners.difference_update(int(i) for i in added)
         self._ingress_partitioned.difference_update(int(i) for i in removed)
         self._join_reports_armed = False  # still-pending joiners re-attempt
@@ -337,33 +417,40 @@ class Simulator:
         self.view_changes.append(record)
         # new configuration: rebuild adjacency, reset per-config state;
         # crashes persist across configurations
-        self.state = initial_state(
-            self.config, self.cluster, self.active,
-            seed=self.seed + len(self.view_changes),
-            group_of=self.group_of,
-        )
-        self.state = dataclasses.replace(
-            self.state, alive=jnp.asarray(self.alive & self.active)
-        )
+        self.state = self._fresh_state(self.seed + len(self.view_changes))
         return record
 
     # ------------------------------------------------------------------ #
 
     def configuration_id(self) -> int:
-        """Bit-exact configuration identity of the current membership."""
-        ids = np.array(sorted(self.identifiers_seen), dtype=np.int64)
-        # NodeId ordering is (high, low) signed lexicographic
-        high = self.cluster.id_high[ids]
-        low = self.cluster.id_low[ids]
-        order = np.lexsort((low, high))
+        """Bit-exact configuration identity of the current membership.
+
+        Per-node element hashes are immutable and cached on the cluster
+        (VirtualCluster.node_hashes); only the fold over the current ordering
+        runs per view change."""
+        high_h, low_h, host_h, port_h = self.cluster.node_hashes()
+        ids = self._sorted_identifiers()
         order0 = ring_order(self.cluster, self.active, 0)
-        return configuration_id_vectorized(
-            high[order],
-            low[order],
-            self.cluster.hostnames[order0],
-            self.cluster.host_lengths[order0],
-            self.cluster.ports[order0],
-        )
+        return config_fold(high_h[ids], low_h[ids], host_h[order0], port_h[order0])
+
+    def _sorted_identifiers(self) -> np.ndarray:
+        """identifiersSeen in NodeId (high, low) signed-lexicographic order,
+        cached until a new identifier is admitted (the set is append-only)."""
+        if self._ids_sorted is None:
+            ids = np.fromiter(
+                self.identifiers_seen, dtype=np.int64,
+                count=len(self.identifiers_seen),
+            )
+            order = np.lexsort((self.cluster.id_low[ids], self.cluster.id_high[ids]))
+            self._ids_sorted = ids[order]
+        return self._ids_sorted
+
+    def ready(self) -> "Simulator":
+        """Block until construction/rebuild work has drained from the device
+        queue -- separates setup cost from measured protocol time."""
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.state))
+        jax.block_until_ready((self._zero_ck, self._ones_deliver))
+        return self
 
     @property
     def membership_size(self) -> int:
@@ -438,12 +525,8 @@ class Simulator:
                 if "group_of" in data
                 else np.zeros(capacity, dtype=np.int32)
             )
-        sim.state = initial_state(
-            sim.config, sim.cluster, sim.active, seed=sim.seed, group_of=sim.group_of
-        )
-        sim.state = dataclasses.replace(
-            sim.state, alive=jnp.asarray(sim.alive & sim.active)
-        )
+        sim._init_device_caches()
+        sim.state = sim._fresh_state(sim.seed)
         sim._billed_rounds = 0
         sim.view_changes = []
         sim.metrics = Metrics()

@@ -31,6 +31,7 @@ arrays -- HBM-bandwidth bound, which is exactly what the TPU vector units eat.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from dataclasses import dataclass
 from typing import Optional, Tuple
@@ -133,6 +134,9 @@ def route_and_tally(
     inputs: RoundInputs,
     active: jax.Array,
     alive: jax.Array,
+    *,
+    uniform_delivery: bool = False,
+    gate_implicit: bool = False,
 ):
     """Alert delivery, per-group cut detection, and the fast-round tally --
     shared by the single-device and sharded steps.
@@ -144,16 +148,27 @@ def route_and_tally(
     observers row. Each delivery group receives an alert iff its
     ``deliver[g, sender]`` entry is set.
 
+    ``uniform_delivery`` (static) elides the [G, C, K] deliver gather when the
+    fault plane delivers every broadcast to every group (the common case).
+    ``gate_implicit`` (static) wraps the implicit-invalidation pass in a
+    ``lax.cond`` so its [G, C, K] gather only runs in rounds where some group
+    both saw a DOWN alert and has a node in flux -- it is the identity
+    otherwise, so gating is exact.
+
     Returns (reports, seen_down, announced, proposal, decided, decided_group,
     decided_round).
     """
     sender = state.observers  # [C, K]
     arrivals = down_arrivals | inputs.join_reports  # [C, K]
-    deliver = inputs.deliver[:, sender]  # [G, C, K]
-    reports = state.reports | (arrivals[None, :, :] & deliver)
-    seen_down = state.seen_down | jnp.any(
-        down_arrivals[None, :, :] & deliver, axis=(1, 2)
-    )
+    if uniform_delivery:
+        reports = state.reports | arrivals[None, :, :]
+        seen_down = state.seen_down | jnp.any(down_arrivals)
+    else:
+        deliver = inputs.deliver[:, sender]  # [G, C, K]
+        reports = state.reports | (arrivals[None, :, :] & deliver)
+        seen_down = state.seen_down | jnp.any(
+            down_arrivals[None, :, :] & deliver, axis=(1, 2)
+        )
 
     # --- per-group cut detection: H/L watermarks ---------------------------
     counts = reports.sum(axis=2)  # [G, C]
@@ -166,12 +181,23 @@ def route_and_tally(
     # (MultiNodeCutDetector.java:137-164). Covers failing members (their
     # successors) and joiners (their expected observers, written into the
     # observers row by the driver).
-    fs = in_flux | stable  # [G, C]
-    obs_fs = fs[:, state.observers]  # [G, C, K]
-    implicit = (
-        seen_down[:, None, None] & in_flux[:, :, None] & obs_fs & ~reports
-    )
-    reports = reports | implicit
+    def _implicit_pass(reports: jax.Array) -> jax.Array:
+        fs = in_flux | stable  # [G, C]
+        obs_fs = fs[:, state.observers]  # [G, C, K]
+        implicit = (
+            seen_down[:, None, None] & in_flux[:, :, None] & obs_fs & ~reports
+        )
+        return reports | implicit
+
+    if gate_implicit:
+        reports = jax.lax.cond(
+            jnp.any(seen_down[:, None] & in_flux),
+            _implicit_pass,
+            lambda r: r,
+            reports,
+        )
+    else:
+        reports = _implicit_pass(reports)
     counts = reports.sum(axis=2)
     in_flux = (counts >= config.l) & (counts < config.h)
     stable = counts >= config.h
@@ -317,6 +343,142 @@ def run_rounds_const(
 
     final, _ = jax.lax.scan(body, state, None, length=rounds)
     return final
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run_until_decided_const(
+    config: SimConfig,
+    state: SimState,
+    inputs: RoundInputs,
+    max_rounds: jax.Array,
+    uniform_delivery: bool = True,
+) -> SimState:
+    """Run up to ``max_rounds`` rounds of a *constant, deterministic* fault
+    plane in ONE device dispatch, exiting as soon as consensus decides.
+
+    With the fault plane fixed for the whole dispatch and no random ingress
+    loss, the probe phase is closed-form: each monitoring edge either fails
+    every round or never, so the round at which its cumulative counter crosses
+    the threshold (PingPongFailureDetector.java:69-77) is computable up front.
+    The while-loop body is then pure elementwise arithmetic -- no per-round
+    gathers -- and rounds after the decision are never executed at all,
+    unlike the scan path's masked no-ops. Produces bit-identical state to
+    scanning ``step`` with ``random_loss=False`` over the same inputs, with
+    one exception: ``rng_key`` is not advanced (this path draws no random
+    numbers, whereas the scan path splits the key every round).
+    """
+    c, k = config.capacity, config.k
+    active = state.active
+    alive = inputs.alive & active
+    subj = state.subjects
+    edge_live = active[:, None] & active[subj]
+    observer_up = alive[:, None]
+    target_up = alive[subj]
+    probe_ok = target_up & ~inputs.probe_drop
+    fail_event = edge_live & observer_up & ~probe_ok  # constant per round
+
+    # Round (1-based within this dispatch) at which each observer-indexed edge
+    # crosses the cumulative threshold; never fires here otherwise. An edge
+    # already at/over threshold but unalerted fires on the next failed probe.
+    never = jnp.int32(0x7FFFFFFF)
+    rem = jnp.maximum(config.fd_threshold - state.fd_fail, 1)
+    fire = jnp.where(fail_event & ~state.alerted, rem, never)
+    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+    # dst-indexed arrival round (see the gather-not-scatter note in ``step``)
+    fire_dst = jnp.where(active[:, None], fire[state.observers, cols], never)
+
+    state = dataclasses.replace(
+        state, alive=jnp.where(state.decided, state.alive, inputs.alive)
+    )
+
+    def cond(carry):
+        st, r = carry
+        return (r < max_rounds) & ~st.decided
+
+    def body(carry):
+        st, r = carry
+        r = r + 1
+        down_arrivals = fire_dst == r
+        (reports, seen_down, announced, proposal, decided, decided_group,
+         decided_round) = route_and_tally(
+            config, st, down_arrivals, inputs, active, alive,
+            uniform_delivery=uniform_delivery, gate_implicit=True,
+        )
+        st = dataclasses.replace(
+            st, reports=reports, seen_down=seen_down, announced=announced,
+            proposal=proposal, decided=decided, decided_group=decided_group,
+            decided_round=decided_round, round=st.round + 1,
+        )
+        return st, r
+
+    final, r_exec = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0))
+    )
+    # Reconstruct the per-edge FD state the executed rounds produced.
+    fd_fail = state.fd_fail + r_exec * fail_event.astype(jnp.int32)
+    alerted = state.alerted | (fire <= r_exec)
+    return dataclasses.replace(final, fd_fail=fd_fail, alerted=alerted)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def device_initial_state(
+    config: SimConfig,
+    ring_rank: jax.Array,  # int32[K, C] rank of each node in the full ring order
+    active: jax.Array,  # bool[C]
+    alive: jax.Array,  # bool[C]
+    group_of: jax.Array,  # int32[C]
+    rng_key: jax.Array,
+) -> SimState:
+    """Fresh-configuration state built entirely on device.
+
+    The adjacency rebuild (MembershipView ringAdd/ringDelete at a view change)
+    is a masked sort of resident per-ring *ranks* (each node's position in the
+    full-capacity ring order, host-computed once from the signed xxHash keys):
+    inactive entries sort to the end, the first n slots are the active
+    membership in ring order, and predecessor/successor are index arithmetic
+    mod n. Ranks are distinct int32, so the order is exactly the host
+    ``build_adjacency`` order without needing 64-bit keys on device or moving
+    the [C, K] adjacency over PCIe at every view change.
+    """
+    c, k = config.capacity, config.k
+    top = jnp.int32(0x7FFFFFFF)
+    keys = jnp.where(active[None, :], ring_rank, top)
+    order = jnp.argsort(keys, axis=1, stable=True).astype(jnp.int32)  # [K, C]
+    n = active.sum().astype(jnp.int32)
+    n1 = jnp.maximum(n, 1)
+    p = jnp.arange(c, dtype=jnp.int32)[None, :]
+    pred_idx = jnp.where(p < n, (p - 1) % n1, p)
+    succ_idx = jnp.where(p < n, (p + 1) % n1, p)
+    preds = jnp.take_along_axis(order, pred_idx, axis=1)
+    succs = jnp.take_along_axis(order, succ_idx, axis=1)
+
+    base = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[:, None], (c, k))
+    ring_ids = jnp.broadcast_to(
+        jnp.arange(k, dtype=jnp.int32)[:, None], (k, c)
+    ).reshape(-1)
+    nodes_flat = order.reshape(-1)
+    subjects = base.at[nodes_flat, ring_ids].set(preds.reshape(-1))
+    observers = base.at[nodes_flat, ring_ids].set(succs.reshape(-1))
+
+    g = config.groups
+    return SimState(
+        active=active,
+        alive=alive,
+        group_of=group_of,
+        subjects=subjects,
+        observers=observers,
+        fd_fail=jnp.zeros((c, k), jnp.int32),
+        alerted=jnp.zeros((c, k), bool),
+        reports=jnp.zeros((g, c, k), bool),
+        seen_down=jnp.zeros(g, bool),
+        announced=jnp.zeros(g, bool),
+        proposal=jnp.zeros((g, c), bool),
+        decided=jnp.asarray(False),
+        decided_group=jnp.asarray(0, jnp.int32),
+        decided_round=jnp.asarray(0, jnp.int32),
+        round=jnp.asarray(0, jnp.int32),
+        rng_key=rng_key,
+    )
 
 
 def const_inputs(

@@ -23,6 +23,23 @@ from ..hashing import endpoint_hash_batch, pack_hostnames, xxh64_batch
 _U64 = np.uint64
 
 
+def _int64_le_bytes(values: np.ndarray) -> np.ndarray:
+    """[N] int64 -> [N, 8] uint8 little-endian rows (hashLong input layout)."""
+    return (
+        values.astype(np.int64).view(np.uint64)[:, None]
+        .view(np.uint8).reshape(-1, 8)
+    )
+
+
+def _port_le_bytes(ports: np.ndarray) -> np.ndarray:
+    """[N] ports -> [N, 4] uint8 little-endian rows (hashInt input layout)."""
+    out = np.zeros((len(ports), 4), dtype=np.uint8)
+    p = ports.astype(np.uint32)
+    for i in range(4):
+        out[:, i] = ((p >> np.uint32(8 * i)) & np.uint32(0xFF)).astype(np.uint8)
+    return out
+
+
 @dataclass
 class VirtualCluster:
     """Identity of up to ``capacity`` virtual nodes; row index == node id."""
@@ -34,10 +51,70 @@ class VirtualCluster:
     id_low: np.ndarray  # [C] int64
     # per-ring endpoint hashes, computed once: [K, C] uint64
     ring_hashes: np.ndarray
+    # lazy caches (identities are immutable, so these never invalidate)
+    _full_order: Optional[np.ndarray] = None  # [K, C] stable argsort per ring
+    _ring_rank: Optional[np.ndarray] = None  # [K, C] inverse of _full_order
+    _node_hashes: Optional[Tuple[np.ndarray, ...]] = None  # config-id inputs
 
     @property
     def capacity(self) -> int:
         return len(self.ports)
+
+    def full_ring_order(self) -> np.ndarray:
+        """Stable argsort of every ring over the full capacity, cached.
+
+        The ring order of any active subset is the stable filter of this
+        order (a subsequence of a sorted sequence is sorted; stable ties
+        resolve by node id in both), so adjacency rebuilds at view changes
+        are O(C) masking instead of O(C log C) sorting.
+        """
+        if self._full_order is None:
+            signed = self.ring_hashes.view(np.int64)
+            self._full_order = np.argsort(
+                signed, axis=1, kind="stable"
+            ).astype(np.int32)
+        return self._full_order
+
+    def ring_rank(self) -> np.ndarray:
+        """Each node's position in the full-capacity ring order, per ring
+        ([K, C] int32, the inverse permutation of full_ring_order). Ranks are
+        distinct and order-equivalent to the signed hashes, so devices can
+        rebuild adjacency by sorting int32 ranks instead of 64-bit keys."""
+        if self._ring_rank is None:
+            order = self.full_ring_order()
+            k, c = order.shape
+            rank = np.empty((k, c), dtype=np.int32)
+            cols = np.arange(c, dtype=np.int32)
+            for ring in range(k):
+                rank[ring, order[ring]] = cols
+            self._ring_rank = rank
+        return self._ring_rank
+
+    def node_hashes(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Per-node xxHash64 inputs to the configuration-id fold, cached:
+        (id_high_h, id_low_h, host_h, port_h), each uint64[C]. The chained
+        fold (MembershipView.java:535-547) hashes each element independently
+        before folding, so per-node hashes are membership-invariant."""
+        if self._node_hashes is None:
+            from .. import native
+
+            n = self.capacity
+            eight = np.full(n, 8, dtype=np.int64)
+            high_bytes = _int64_le_bytes(self.id_high)
+            low_bytes = _int64_le_bytes(self.id_low)
+            port_bytes = _port_le_bytes(self.ports)
+
+            def h(data, lengths):
+                out = native.xxh64_batch(data, lengths, 0)
+                return out if out is not None else xxh64_batch(data, lengths, 0)
+
+            self._node_hashes = (
+                h(high_bytes, eight),
+                h(low_bytes, eight),
+                h(self.hostnames, self.host_lengths),
+                h(port_bytes, np.full(n, 4, dtype=np.int64)),
+            )
+        return self._node_hashes
 
     @staticmethod
     def synthesize(capacity: int, k: int, seed: int = 0) -> "VirtualCluster":
@@ -79,24 +156,19 @@ def build_adjacency(
     subjects[i, k] is the ring-k predecessor of node i (the node i monitors,
     MembershipView.java:309-323); observers[i, k] the ring-k successor
     (MembershipView.java:235-258). Inactive rows are set to the node itself.
+
+    Rebuilds filter the cached full-capacity ring order (O(C·K) masking)
+    rather than re-sorting per configuration.
     """
-    from .. import native
-
-    native_result = native.build_adjacency(cluster.ring_hashes, active)
-    if native_result is not None:
-        return native_result
-
+    full_order = cluster.full_ring_order()
     k_rings, capacity = cluster.ring_hashes.shape
     subjects = np.tile(np.arange(capacity, dtype=np.int32)[:, None], (1, k_rings))
     observers = subjects.copy()
-    active_idx = np.flatnonzero(active)
-    n = len(active_idx)
-    if n <= 1:
+    if int(active.sum()) <= 1:
         return subjects, observers
-    signed = cluster.ring_hashes[:, active_idx].view(np.int64)
     for ring in range(k_rings):
-        order = np.argsort(signed[ring], kind="stable")  # ring order, signed-hash domain
-        ring_nodes = active_idx[order]
+        fo = full_order[ring]
+        ring_nodes = fo[active[fo]]
         preds = np.roll(ring_nodes, 1)
         succs = np.roll(ring_nodes, -1)
         subjects[ring_nodes, ring] = preds
@@ -106,9 +178,8 @@ def build_adjacency(
 
 def ring_order(cluster: VirtualCluster, active: np.ndarray, ring: int = 0) -> np.ndarray:
     """Active node ids in ring-``ring`` order (the reference's getRing)."""
-    active_idx = np.flatnonzero(active)
-    signed = cluster.ring_hashes[ring, active_idx].view(np.int64)
-    return active_idx[np.argsort(signed, kind="stable")]
+    fo = cluster.full_ring_order()[ring]
+    return fo[active[fo]]
 
 
 def configuration_id_vectorized(
@@ -128,27 +199,35 @@ def configuration_id_vectorized(
     """
     with np.errstate(over="ignore"):
         id_high_h = xxh64_batch(
-            id_high.astype(np.int64).view(np.uint64)[:, None].view(np.uint8).reshape(-1, 8),
-            np.full(len(id_high), 8, dtype=np.int64),
-            0,
+            _int64_le_bytes(id_high), np.full(len(id_high), 8, dtype=np.int64), 0
         )
         id_low_h = xxh64_batch(
-            id_low.astype(np.int64).view(np.uint64)[:, None].view(np.uint8).reshape(-1, 8),
-            np.full(len(id_low), 8, dtype=np.int64),
-            0,
+            _int64_le_bytes(id_low), np.full(len(id_low), 8, dtype=np.int64), 0
         )
         host_h = xxh64_batch(hostnames, host_lengths, 0)
-        port_bytes = np.zeros((len(ports), 4), dtype=np.uint8)
-        p = ports.astype(np.uint32)
-        for i in range(4):
-            port_bytes[:, i] = ((p >> np.uint32(8 * i)) & np.uint32(0xFF)).astype(np.uint8)
+        port_bytes = _port_le_bytes(ports)
         port_h = xxh64_batch(port_bytes, np.full(len(ports), 4, dtype=np.int64), 0)
 
+    return config_fold(id_high_h, id_low_h, host_h, port_h)
+
+
+def config_fold(
+    id_high_h: np.ndarray,
+    id_low_h: np.ndarray,
+    host_h: np.ndarray,
+    port_h: np.ndarray,
+) -> int:
+    """Fold already-hashed elements into the chained configuration identity.
+
+    Inputs are the per-element xxHash64 values, identifiers ordered by NodeId,
+    endpoints in ring-0 order (e.g. gathered from VirtualCluster.node_hashes).
+    """
+    with np.errstate(over="ignore"):
         # interleave: id_high_0, id_low_0, id_high_1, ... then host_0, port_0, ...
-        ids = np.empty(2 * len(id_high), dtype=_U64)
+        ids = np.empty(2 * len(id_high_h), dtype=_U64)
         ids[0::2] = id_high_h
         ids[1::2] = id_low_h
-        eps = np.empty(2 * len(ports), dtype=_U64)
+        eps = np.empty(2 * len(port_h), dtype=_U64)
         eps[0::2] = host_h
         eps[1::2] = port_h
         xs = np.concatenate([ids, eps])

@@ -1,0 +1,161 @@
+"""Equivalence of the single-dispatch fast path with the reference scan path.
+
+run_until_decided_const is an optimization (closed-form FD + early-exiting
+while_loop, engine.py); these tests pin its contract: for any constant,
+deterministic fault plane it must produce *bit-identical* SimState to scanning
+``step`` the same number of rounds. device_initial_state likewise must equal
+the host adjacency build (MembershipView semantics) exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.sim.engine import (
+    SimConfig,
+    const_inputs,
+    device_initial_state,
+    initial_state,
+    run_rounds_const,
+    run_until_decided_const,
+)
+from rapid_tpu.sim.topology import VirtualCluster, build_adjacency
+
+
+def _assert_states_equal(a, b):
+    for name in a.__dataclass_fields__:
+        av, bv = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        if name == "rng_key":
+            continue  # scan path consumes RNG per round; fast path does not
+        np.testing.assert_array_equal(av, bv, err_msg=f"field {name} diverged")
+
+
+def _run_both(config, state, inputs, rounds):
+    scan = run_rounds_const(config, state, inputs, rounds, False)
+    uniform = bool(np.asarray(inputs.deliver).all())
+    fast = run_until_decided_const(config, state, inputs, jnp.int32(rounds), uniform)
+    return scan, fast
+
+
+def _equalize_rounds(config, fast, inputs, total_rounds):
+    """The fast path stops at the decision round; replay the scan's masked
+    no-op tail on it so terminal states are comparable."""
+    done = int(fast.round)
+    if done < total_rounds:
+        fast = run_rounds_const(config, fast, inputs, total_rounds - done, False)
+    return fast
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_crash_burst_matches_scan_path(seed):
+    rng = np.random.default_rng(seed)
+    config = SimConfig(capacity=64, k=6, h=5, l=2, fd_threshold=4)
+    sim = Simulator(64, config=config, seed=seed)
+    victims = rng.choice(64, size=3, replace=False)
+    sim.crash(victims)
+    inputs = const_inputs(config, sim.alive)
+    scan, fast = _run_both(config, sim.state, inputs, 12)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 12))
+
+
+def test_one_way_partition_matches_scan_path():
+    config = SimConfig(capacity=32, k=5, h=4, l=2, fd_threshold=3)
+    sim = Simulator(32, config=config, seed=7)
+    sim.one_way_ingress_partition(np.array([4, 9]))
+    inputs = const_inputs(config, sim.alive, probe_drop=sim._probe_drop_mask())
+    scan, fast = _run_both(config, sim.state, inputs, 10)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 10))
+
+
+def test_delivery_groups_matches_scan_path():
+    config = SimConfig(capacity=32, k=5, h=4, l=2, fd_threshold=3, groups=4)
+    sim = Simulator(32, config=config, seed=3)
+    sim.set_delivery_groups(np.arange(32, dtype=np.int32) % 4)
+    sim.crash(np.array([11]))
+    sim.drop_broadcasts(2, np.array([5, 6, 7]))
+    inputs = const_inputs(
+        config, sim.alive, deliver=sim._deliver,
+    )
+    scan, fast = _run_both(config, sim.state, inputs, 10)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 10))
+
+
+def test_join_reports_match_scan_path():
+    config = SimConfig(capacity=16, k=4, h=3, l=2, fd_threshold=3)
+    sim = Simulator(12, capacity=16, config=config, seed=5)
+    sim.request_joins(np.array([12, 13]))
+    join_reports = sim._arm_pending_joins()
+    inputs = const_inputs(config, sim.alive, join_reports=join_reports)
+    scan, fast = _run_both(config, sim.state, inputs, 8)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 8))
+
+
+def test_multi_dispatch_with_revive_between():
+    """Plane changes between dispatches (flip-flop): the fast path must resume
+    from reconstructed fd_fail/alerted identically to the scan path."""
+    config = SimConfig(capacity=24, k=5, h=4, l=2, fd_threshold=6)
+    sim = Simulator(24, config=config, seed=9)
+    victims = np.array([3, 17])
+
+    state_a = state_b = sim.state
+    for crash in (True, False, True):
+        (sim.crash if crash else sim.revive)(victims)
+        inputs = const_inputs(config, sim.alive)
+        state_a = run_rounds_const(config, state_a, inputs, 3, False)
+        state_b = run_until_decided_const(config, state_b, inputs, jnp.int32(3), True)
+        if int(state_b.round) < int(state_a.round):
+            state_b = run_rounds_const(
+                config, state_b, inputs,
+                int(state_a.round) - int(state_b.round), False,
+            )
+        _assert_states_equal(state_a, state_b)
+
+
+def test_decision_state_identical_at_decision_round():
+    """Up to and including the decision round, the two paths agree exactly
+    (cut, winning group, decided_round)."""
+    config = SimConfig(capacity=48, k=6, h=5, l=2, fd_threshold=4)
+    sim = Simulator(48, config=config, seed=11)
+    sim.crash(np.array([5, 6]))
+    inputs = const_inputs(config, sim.alive)
+    fast = run_until_decided_const(config, sim.state, inputs, jnp.int32(16), True)
+    assert bool(fast.decided)
+    scan = run_rounds_const(config, sim.state, inputs, int(fast.round), False)
+    _assert_states_equal(scan, fast)
+
+
+def test_device_initial_state_matches_host_adjacency():
+    cluster = VirtualCluster.synthesize(50, k=7, seed=2)
+    rng = np.random.default_rng(0)
+    active = rng.random(50) < 0.7
+    host_subjects, host_observers = build_adjacency(cluster, active)
+    st = device_initial_state(
+        SimConfig(capacity=50, k=7),
+        jnp.asarray(cluster.ring_rank()),
+        jnp.asarray(active),
+        jnp.asarray(active),
+        jnp.zeros(50, jnp.int32),
+        jax.random.PRNGKey(0),
+    )
+    np.testing.assert_array_equal(np.asarray(st.subjects), host_subjects)
+    np.testing.assert_array_equal(np.asarray(st.observers), host_observers)
+
+
+@pytest.mark.parametrize("n_active", [0, 1, 2])
+def test_device_initial_state_tiny_membership(n_active):
+    cluster = VirtualCluster.synthesize(8, k=3, seed=4)
+    active = np.zeros(8, dtype=bool)
+    active[:n_active] = True
+    host_subjects, host_observers = build_adjacency(cluster, active)
+    st = device_initial_state(
+        SimConfig(capacity=8, k=3),
+        jnp.asarray(cluster.ring_rank()),
+        jnp.asarray(active),
+        jnp.asarray(active),
+        jnp.zeros(8, jnp.int32),
+        jax.random.PRNGKey(0),
+    )
+    np.testing.assert_array_equal(np.asarray(st.subjects), host_subjects)
+    np.testing.assert_array_equal(np.asarray(st.observers), host_observers)

@@ -35,9 +35,24 @@ def test_native_ring_hashes_match_numpy():
     assert np.array_equal(out, ref)
 
 
-def test_native_adjacency_matches_membership_view():
-    """End to end through VirtualCluster (which now prefers the native path):
-    adjacency must still match the object-model MembershipView."""
+def test_native_adjacency_matches_numpy_filter_path():
+    """The C++ sort-based adjacency builder must agree with the cached-order
+    numpy filter path that topology.build_adjacency uses by default."""
+    from rapid_tpu.sim.topology import VirtualCluster, build_adjacency
+
+    vc = VirtualCluster.synthesize(300, 10, seed=6)
+    rng = np.random.default_rng(1)
+    active = rng.random(300) < 0.8
+    np_subjects, np_observers = build_adjacency(vc, active)
+    nat = native.build_adjacency(vc.ring_hashes, active)
+    assert nat is not None
+    assert np.array_equal(nat[0], np_subjects)
+    assert np.array_equal(nat[1], np_observers)
+
+
+def test_adjacency_matches_membership_view():
+    """End to end through VirtualCluster: adjacency must match the
+    object-model MembershipView."""
     from rapid_tpu.membership import MembershipView
     from rapid_tpu.sim.topology import VirtualCluster, build_adjacency
     from rapid_tpu.types import Endpoint, NodeId
