@@ -78,9 +78,17 @@ class Simulator:
         # real rejoining process draws a fresh UUID (Cluster.java:327-331).
         self.identifiers_seen: Set[int] = set(np.flatnonzero(self.active))
         self.seed = seed
-        self._init_device_caches()
-        self.state = self._fresh_state(seed)
         self.virtual_ms = 0
+        self._init_runtime_state()
+
+    def _init_runtime_state(self) -> None:
+        """Everything past identity/membership: device caches, fresh device
+        state, metrics, the all-clear fault plane, and the hash pre-warms.
+        Shared by __init__ and from_configuration so restored simulators can
+        never silently diverge from freshly-constructed ones."""
+        capacity = self.config.capacity
+        self._init_device_caches()
+        self.state = self._fresh_state(self.seed)
         self._billed_rounds = 0  # rounds of this configuration already billed
         self.view_changes: List[ViewChangeRecord] = []
         self.metrics = Metrics()
@@ -91,6 +99,10 @@ class Simulator:
         self._deliver = np.ones((self.config.groups, capacity), dtype=bool)
         self._pending_joiners: Set[int] = set()
         self._join_reports_armed = False
+        # membership-invariant per-node hashes: construction cost, not
+        # protocol time (they feed every configuration_id fold)
+        self.cluster.node_hashes()
+        self._sorted_identifiers()
 
     def _init_device_caches(self) -> None:
         """Device-resident constants allocated once per simulator: the signed
@@ -310,40 +322,46 @@ class Simulator:
                         self.config, self.state, inputs, jnp.int32(n),
                         bool(self._deliver.all()),
                     )
-                # one host<->device round trip syncs the batch and fetches
-                # both control bits
-                decided, announced_any = (
-                    bool(v)
-                    for v in jax.device_get(
-                        (self.state.decided, jnp.any(self.state.announced))
-                    )
+                # ONE host<->device round trip syncs the batch and fetches
+                # everything a decision (or the classic fallback) needs, so
+                # neither pays a second transfer latency
+                (decided, announced_np, proposal_np, decided_group,
+                 decided_round, round_np) = jax.device_get(
+                    (self.state.decided, self.state.announced,
+                     self.state.proposal, self.state.decided_group,
+                     self.state.decided_round, self.state.round)
                 )
+                announced_any = announced_np.any()
             self.metrics.incr("rounds", n)
             self.metrics.incr("device_dispatches")
             rounds_done += n
             if decided:
-                return self._apply_view_change(t0)
+                return self._apply_view_change(
+                    t0, (proposal_np, decided_group, decided_round)
+                )
             if announced_any:
                 announced_for += n
                 if (
                     classic_fallback_after_rounds is not None
                     and announced_for >= classic_fallback_after_rounds
                 ):
-                    winner = self._classic_round_winner()
+                    winner = self._classic_round_winner(announced_np, proposal_np)
                     if winner is not None:
-                        self.state = dataclasses.replace(
-                            self.state, decided=jnp.asarray(True),
-                            decided_group=jnp.asarray(winner, jnp.int32),
-                            decided_round=self.state.round,
+                        # no need to write the decision back to the device:
+                        # _apply_view_change consumes the fetched arrays and
+                        # replaces the device state wholesale
+                        record = self._apply_view_change(
+                            t0, (proposal_np, winner, round_np)
                         )
-                        record = self._apply_view_change(t0)
                         record.via_classic_round = True
                         return record
         self.virtual_ms += rounds_done * self.config.fd_interval_ms
         self._billed_rounds += rounds_done
         return None
 
-    def _classic_round_winner(self) -> Optional[int]:
+    def _classic_round_winner(
+        self, announced: np.ndarray, proposals: np.ndarray
+    ) -> Optional[int]:
         """Host-side classic recovery round: the coordinator value-pick rule
         over the groups' fast-round votes (Paxos.java:269-326), deciding iff
         live members form a majority (Paxos.java:168,229).
@@ -356,10 +374,8 @@ class Simulator:
         live = self.active & self.alive
         if int(live.sum()) <= n // 2:
             return None
-        announced = np.asarray(self.state.announced)
         if not announced.any():
             return None
-        proposals = np.asarray(self.state.proposal)
         group_live = np.bincount(
             self.group_of[live], minlength=self.config.groups
         )
@@ -377,12 +393,13 @@ class Simulator:
         # any proposed value is safe to pick at this point
         return next(iter(distinct.values()))[1]
 
-    def _apply_view_change(self, t0: float) -> ViewChangeRecord:
+    def _apply_view_change(
+        self,
+        t0: float,
+        fetched: Tuple[np.ndarray, int, int],  # (proposal[G,C], group, round)
+    ) -> ViewChangeRecord:
         self.metrics.incr("view_changes")
-        # one transfer for everything the host needs from the decided state
-        proposal_np, decided_group, decided_round = jax.device_get(
-            (self.state.proposal, self.state.decided_group, self.state.decided_round)
-        )
+        proposal_np, decided_group, decided_round = fetched
         # the winning group's proposal is the decided cut
         cut = proposal_np[int(decided_group)]
         decided_round = int(decided_round)
@@ -525,15 +542,5 @@ class Simulator:
                 if "group_of" in data
                 else np.zeros(capacity, dtype=np.int32)
             )
-        sim._init_device_caches()
-        sim.state = sim._fresh_state(sim.seed)
-        sim._billed_rounds = 0
-        sim.view_changes = []
-        sim.metrics = Metrics()
-        sim.tracer = Tracer()
-        sim._ingress_partitioned = set()
-        sim._drop_prob = np.zeros(sim.config.capacity, dtype=np.float32)
-        sim._deliver = np.ones((sim.config.groups, sim.config.capacity), dtype=bool)
-        sim._pending_joiners = set()
-        sim._join_reports_armed = False
+        sim._init_runtime_state()
         return sim
