@@ -142,14 +142,16 @@ class Simulator:
     def crash(self, node_ids: np.ndarray) -> None:
         """Crash-stop burst: nodes stop responding to probes and stop voting."""
         self.alive[np.atleast_1d(node_ids)] = False
-        self._alive_dev = None
+        # enqueue the liveness transfer now (async) so the decision loop's
+        # dispatch never waits on a host->device round trip for it
+        self._alive_dev = jnp.asarray(self.alive)
 
     def revive(self, node_ids: np.ndarray) -> None:
         """Flip-flop support: nodes become reachable again (cumulative FD
         counters are deliberately NOT reset -- PingPongFailureDetector.java:116-118)."""
         node_ids = np.atleast_1d(node_ids)
         self.alive[node_ids] = self.active[node_ids]
-        self._alive_dev = None
+        self._alive_dev = jnp.asarray(self.alive)
 
     def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
         """Asymmetric failure: probes TO these nodes are lost, their own

@@ -391,6 +391,33 @@ def run_until_decided_const(
         state, alive=jnp.where(state.decided, state.alive, inputs.alive)
     )
 
+    # Fast-forward over provably-inert rounds: from a *fresh* configuration
+    # (no reports, nothing announced, no join traffic) a round with no alert
+    # arrivals is a strict no-op -- counts stay zero, the implicit pass and
+    # the tally cannot fire -- so execution can start at the first arrival
+    # round. Skipped rounds still count toward the budget, the round counter,
+    # and the closed-form FD reconstruction below, so the result (including
+    # decided_round and virtual-time billing) is bit-identical to sequential
+    # execution. Saves ~threshold-1 loop iterations per decision dispatch.
+    fresh = (
+        ~state.decided
+        & ~jnp.any(state.reports)
+        & ~jnp.any(state.announced)
+        & ~jnp.any(state.seen_down)
+        & ~jnp.any(inputs.join_reports)
+    )
+    first_arrival = jnp.min(fire_dst)  # == `never` when no edge will fire
+    start = jnp.where(
+        fresh,
+        jnp.clip(
+            jnp.minimum(first_arrival - 1, max_rounds.astype(jnp.int32)),
+            0,
+            None,
+        ),
+        0,
+    )
+    state = dataclasses.replace(state, round=state.round + start)
+
     def cond(carry):
         st, r = carry
         return (r < max_rounds) & ~st.decided
@@ -412,7 +439,7 @@ def run_until_decided_const(
         return st, r
 
     final, r_exec = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0))
+        cond, body, (state, start)
     )
     # Reconstruct the per-edge FD state the executed rounds produced.
     fd_fail = state.fd_fail + r_exec * fail_event.astype(jnp.int32)

@@ -14,8 +14,16 @@ choice of tile (K=10 << 128, so rows are the parallel axis; int32/bool lanes
 vectorize on the VPU's 8x128 shape).
 
 Validated in interpret mode against the stock-jax formulation
-(tests/test_pallas_kernels.py); enable on hardware via
-``SimConfig`` -> ``use_pallas_fd=True`` (engine.step consults it).
+(tests/test_pallas_kernels.py) and bit-identical on real TPU hardware
+(v5 lite, tests/test_pallas_kernels.py::test_hardware_kernel_matches_stock,
+opt-in via RAPID_TPU_PALLAS_HW=1). Measured on hardware the stock-XLA fusion
+of this elementwise chain is FASTER (1.6ms vs 2.4ms per call at [100k, 10]):
+K=10 occupies 10 of 128 VPU lanes per row tile, so the hand-written kernel
+wastes lane parallelism that XLA's layout assignment recovers by reshaping.
+The kernel therefore stays flag-gated (``SimConfig.pallas_fd``) as an
+exemplar of the Pallas seam rather than the default path; it would win only
+for K padded near the lane width or when fused with neighbor phases Pallas
+can keep in VMEM.
 """
 
 from __future__ import annotations

@@ -157,6 +157,15 @@ def main() -> None:
         scenario_one_way_loss(50_000, 500, 300),
         scenario_flip_flop_with_join_wave(100_000, 100_100, 400),
     ]
+    if "--scale-1m" in sys.argv:
+        # headroom demo at 10x the north-star scale (~3 min of extra jit
+        # compile for the 1M shapes; protocol wall time is ~1.3s)
+        results.append(
+            scenario_crash(
+                1_000_000, 10_000, 500,
+                "1M virtual nodes, 1% correlated crash burst (10x north star)",
+            )
+        )
     for result in results:
         print(json.dumps(result))
 

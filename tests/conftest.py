@@ -11,8 +11,14 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+if os.environ.get("RAPID_TPU_PALLAS_HW"):
+    # opt-in hardware runs (test_pallas_kernels.py::test_hardware_*) keep the
+    # real accelerator visible
+    import jax  # noqa: E402
+else:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-jax.config.update("jax_platforms", "cpu")
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
