@@ -70,7 +70,7 @@ def input_shardings(mesh: Mesh) -> RoundInputs:
     row = NamedSharding(mesh, P(NODES_AXIS, None))
     rep = NamedSharding(mesh, P())
     return RoundInputs(alive=rep, probe_drop=row, drop_prob=rep,
-                       join_reports=rep, deliver=rep)
+                       join_reports=rep, down_reports=rep, deliver=rep)
 
 
 def place_state(state: SimState, mesh: Mesh) -> SimState:
@@ -116,7 +116,9 @@ def _sharded_round(config: SimConfig, state: SimState, inputs: RoundInputs) -> S
     cols = jnp.tile(jnp.arange(k, dtype=jnp.int32), local_rows)
     delta = delta.at[rows, cols].max(new_down.reshape(-1).astype(jnp.int32))
     delta = jax.lax.pmax(delta, NODES_AXIS)
-    down_arrivals = delta > 0  # dst-indexed DOWN alert arrivals [C, K]
+    # dst-indexed DOWN alert arrivals [C, K]; down_reports are proactive
+    # leave notifications (already dst-indexed, replicated)
+    down_arrivals = (delta > 0) | (inputs.down_reports & active[:, None])
 
     # --- replicated delivery + cut detection + tally (identical per shard) -
     (reports, seen_down, announced, proposal, decided, decided_group,

@@ -99,6 +99,8 @@ class Simulator:
         self._deliver = np.ones((self.config.groups, capacity), dtype=bool)
         self._pending_joiners: Set[int] = set()
         self._join_reports_armed = False
+        self._pending_leavers: Set[int] = set()
+        self._down_reports_dev: Optional[jax.Array] = None
         # membership-invariant per-node hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
@@ -117,15 +119,18 @@ class Simulator:
         self._alive_dev: Optional[jax.Array] = None
         self._probe_drop_dev: Optional[jax.Array] = None
         self._subjects_host: Optional[np.ndarray] = None
+        self._observers_host: Optional[np.ndarray] = None
         self._ring_nodes: Optional[List[np.ndarray]] = None
         self._ids_sorted: Optional[np.ndarray] = None
 
     def _fresh_state(self, seed: int) -> SimState:
         """Fresh-configuration state, built on device (engine.device_initial_state)."""
         self._subjects_host = None
+        self._observers_host = None
         self._ring_nodes = None
         self._alive_dev = None
         self._probe_drop_dev = None  # partition set maps onto new adjacency
+        self._down_reports_dev = None  # leave alerts map onto new adjacency
         return device_initial_state(
             self.config,
             self._ring_rank_dev,
@@ -152,6 +157,22 @@ class Simulator:
         node_ids = np.atleast_1d(node_ids)
         self.alive[node_ids] = self.active[node_ids]
         self._alive_dev = jnp.asarray(self.alive)
+
+    def leave(self, node_ids: np.ndarray) -> None:
+        """Graceful leave: each leaver proactively notifies its K observers,
+        which broadcast DOWN alerts immediately -- leave is just an eagerly
+        triggered edge failure (MembershipService.java:366-371,534-554), so
+        the cut decides in ~1 round instead of waiting out the FD threshold.
+        Leavers keep responding to probes until the view change removes them
+        (a leaving process shuts down only after its notification round)."""
+        for node in np.atleast_1d(node_ids):
+            node = int(node)
+            assert self.active[node], f"node {node} is not a member"
+            # a crashed process cannot send a leave notification; its removal
+            # must go through failure detection
+            assert self.alive[node], f"node {node} is crashed, cannot leave"
+            self._pending_leavers.add(node)
+        self._down_reports_dev = None
 
     def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
         """Asymmetric failure: probes TO these nodes are lost, their own
@@ -202,6 +223,21 @@ class Simulator:
             self._subjects_host = np.asarray(self.state.subjects)
         return mask[self._subjects_host]
 
+    def _down_reports(self) -> jax.Array:
+        """dst-indexed proactive DOWN reports for the pending leavers: ring-k
+        report for a leaver arrives iff its ring-k observer is alive to
+        broadcast (the leaver's notification is consumed by that observer,
+        MembershipService.java:366-371)."""
+        if self._down_reports_dev is None:
+            mask = np.zeros((self.config.capacity, self.config.k), dtype=bool)
+            if self._observers_host is None:
+                self._observers_host = np.asarray(self.state.observers)
+            leavers = sorted(self._pending_leavers)
+            obs = self._observers_host[leavers]  # [L, K]
+            mask[leavers] = self.alive[obs] & self.active[obs]
+            self._down_reports_dev = jnp.asarray(mask)
+        return self._down_reports_dev
+
     def _const_inputs(self, join_reports: Optional[np.ndarray]) -> RoundInputs:
         """This dispatch's fault plane, reusing the device-resident all-clear
         arrays whenever a fault class is inactive."""
@@ -223,6 +259,9 @@ class Simulator:
             ),
             join_reports=(
                 self._zero_ck if join_reports is None else jnp.asarray(join_reports)
+            ),
+            down_reports=(
+                self._down_reports() if self._pending_leavers else self._zero_ck
             ),
             deliver=(
                 self._ones_deliver
@@ -416,6 +455,11 @@ class Simulator:
         self._pending_joiners.difference_update(int(i) for i in added)
         self._ingress_partitioned.difference_update(int(i) for i in removed)
         self._join_reports_armed = False  # still-pending joiners re-attempt
+        # removed leavers shut down for good; still-pending leavers re-notify
+        # their observers in the new configuration
+        left = self._pending_leavers.intersection(int(i) for i in removed)
+        self._pending_leavers.difference_update(left)
+        self.alive[list(left)] = False
 
         # protocol-time: only the rounds of this configuration not yet billed,
         # plus the batching window before the deciding broadcast

@@ -93,6 +93,7 @@ class RoundInputs:
     probe_drop: jax.Array  # bool[C, K] deterministic probe drops (one-way loss)
     drop_prob: jax.Array  # float32[C] random ingress-loss probability per dst
     join_reports: jax.Array  # bool[C, K] UP-alert reports for joining slots
+    down_reports: jax.Array  # bool[C, K] proactive DOWN reports (graceful leave)
     deliver: jax.Array  # bool[G, C] does group g hear broadcasts from node i
 
 
@@ -285,9 +286,14 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
     # "alert from observer i lands at (subjects[i,k], k)" is exactly the
     # gather ``down_arrivals[d,k] = new_down[observers[d,k], k]`` -- and
     # gathers are far cheaper than scatters on TPU. Masked to active
-    # destinations (joiner rows hold *expected* observers).
+    # destinations (joiner rows hold *expected* observers). ``down_reports``
+    # are proactive DOWN alerts -- a graceful leave is just an eagerly
+    # triggered edge failure (MembershipService.java:366-371) that skips the
+    # FD threshold wait.
     cols = jnp.arange(k, dtype=jnp.int32)[None, :]
-    down_arrivals = new_down[state.observers, cols] & active[:, None]
+    down_arrivals = (
+        new_down[state.observers, cols] | inputs.down_reports
+    ) & active[:, None]
 
     (reports, seen_down, announced, proposal, decided, decided_group,
      decided_round) = route_and_tally(config, state, down_arrivals, inputs,
@@ -384,8 +390,14 @@ def run_until_decided_const(
     rem = jnp.maximum(config.fd_threshold - state.fd_fail, 1)
     fire = jnp.where(fail_event & ~state.alerted, rem, never)
     cols = jnp.arange(k, dtype=jnp.int32)[None, :]
-    # dst-indexed arrival round (see the gather-not-scatter note in ``step``)
+    # dst-indexed arrival round (see the gather-not-scatter note in ``step``).
+    # Proactive DOWN reports (graceful leave) arrive in the first round; the
+    # scan path re-delivers them every round, but reports latch with OR so
+    # first-round delivery is bit-identical.
     fire_dst = jnp.where(active[:, None], fire[state.observers, cols], never)
+    fire_dst = jnp.where(
+        inputs.down_reports & active[:, None], jnp.int32(1), fire_dst
+    )
 
     state = dataclasses.replace(
         state, alive=jnp.where(state.decided, state.alive, inputs.alive)
@@ -515,6 +527,7 @@ def const_inputs(
     drop_prob: Optional[np.ndarray] = None,
     join_reports: Optional[np.ndarray] = None,
     deliver: Optional[np.ndarray] = None,
+    down_reports: Optional[np.ndarray] = None,
 ) -> RoundInputs:
     """A single-round fault plane (for run_rounds_const)."""
     c, k, g = config.capacity, config.k, config.groups
@@ -523,5 +536,6 @@ def const_inputs(
         probe_drop=jnp.zeros((c, k), bool) if probe_drop is None else jnp.asarray(probe_drop),
         drop_prob=jnp.zeros(c, jnp.float32) if drop_prob is None else jnp.asarray(drop_prob),
         join_reports=jnp.zeros((c, k), bool) if join_reports is None else jnp.asarray(join_reports),
+        down_reports=jnp.zeros((c, k), bool) if down_reports is None else jnp.asarray(down_reports),
         deliver=jnp.ones((g, c), bool) if deliver is None else jnp.asarray(deliver),
     )

@@ -159,3 +159,28 @@ def test_device_initial_state_tiny_membership(n_active):
     )
     np.testing.assert_array_equal(np.asarray(st.subjects), host_subjects)
     np.testing.assert_array_equal(np.asarray(st.observers), host_observers)
+
+
+def test_leave_reports_match_scan_path():
+    config = SimConfig(capacity=32, k=5, h=4, l=2, fd_threshold=6)
+    sim = Simulator(32, config=config, seed=13)
+    sim.leave(np.array([3, 28]))
+    inputs = const_inputs(
+        config, sim.alive, down_reports=np.asarray(sim._down_reports())
+    )
+    scan, fast = _run_both(config, sim.state, inputs, 8)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 8))
+
+
+def test_leave_and_crash_combined_match_scan_path():
+    """A leave racing a crash burst: proactive reports and FD-threshold
+    alerts in the same dispatch."""
+    config = SimConfig(capacity=32, k=5, h=4, l=2, fd_threshold=4)
+    sim = Simulator(32, config=config, seed=14)
+    sim.crash(np.array([10, 11]))
+    sim.leave(np.array([20]))
+    inputs = const_inputs(
+        config, sim.alive, down_reports=np.asarray(sim._down_reports())
+    )
+    scan, fast = _run_both(config, sim.state, inputs, 10)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 10))

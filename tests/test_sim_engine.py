@@ -287,3 +287,54 @@ def test_deterministic_under_seed():
     a, b = run(), run()
     assert a, "no view changes decided"
     assert a == b
+
+
+def test_graceful_leave_decides_without_fd_wait():
+    """Leave is a proactive DOWN alert (MembershipService.java:366-371): the
+    cut decides in ~1 round instead of waiting out the 10-round FD threshold."""
+    sim = Simulator(32, seed=21)
+    sim.leave(np.array([4, 19]))
+    rec = sim.run_until_decision(max_rounds=8)
+    assert rec is not None
+    assert sorted(rec.cut) == [4, 19]
+    assert rec.membership_size == 30
+    # 1 round + batching window, vs 10*1000+100 for a crash
+    assert rec.virtual_time_ms == 1 * 1000 + 100
+
+
+def test_graceful_leave_parity_with_object_model():
+    """The post-leave configuration id equals the object model's after
+    ring_delete of the same nodes."""
+    sim = Simulator(20, seed=22)
+    sim.leave(np.array([7]))
+    rec = sim.run_until_decision(max_rounds=8)
+    assert rec is not None and list(rec.cut) == [7]
+    view = view_of(sim.cluster, [i for i in range(20)])
+    eps = endpoints_of(sim.cluster)
+    view.ring_delete(eps[7])
+    assert view.get_current_configuration_id() == rec.configuration_id
+
+
+def test_leave_with_dead_observers_uses_remaining_rings():
+    """A leaver whose some observers are crashed still converges: the live
+    observers' proactive reports put it past L, and implicit detection plus
+    the crashed nodes' own cut handle the rest."""
+    sim = Simulator(24, seed=23)
+    # crash two nodes first and let that view change settle
+    sim.crash(np.array([1, 2]))
+    rec = sim.run_until_decision(max_rounds=16)
+    assert rec is not None and sorted(rec.cut) == [1, 2]
+    # now a graceful leave in the 22-node configuration
+    sim.leave(np.array([9]))
+    rec2 = sim.run_until_decision(max_rounds=8)
+    assert rec2 is not None and list(rec2.cut) == [9]
+    assert rec2.membership_size == 21
+
+
+def test_crashed_node_cannot_leave():
+    """A crashed process cannot send a leave notification; its removal must
+    go through failure detection (no leave-latency shortcut for dead nodes)."""
+    sim = Simulator(16, seed=24)
+    sim.crash(np.array([6]))
+    with pytest.raises(AssertionError):
+        sim.leave(np.array([6]))
