@@ -37,6 +37,10 @@ class InProcessNetwork:
         self._servers: Dict[Endpoint, "InProcessServer"] = {}
         self._filters: List[LinkFilter] = []
         self._delays: List[LinkDelay] = []
+        # fallback handlers for endpoints not backed by a per-node server --
+        # e.g. a TpuSimMessaging swarm hosting thousands of virtual nodes
+        # behind one handler (owns(ep) -> bool, handle(dst, msg) -> Promise)
+        self._handlers: List[object] = []
 
     # -- fault injection -----------------------------------------------------
 
@@ -61,6 +65,14 @@ class InProcessNetwork:
         if self._servers.get(server.address) is server:
             del self._servers[server.address]
 
+    def attach_handler(self, handler) -> None:
+        """Attach a multi-endpoint fallback handler (e.g. a simulation swarm)."""
+        self._handlers.append(handler)
+
+    def is_listening(self, address: Endpoint) -> bool:
+        """Is a per-node server currently registered at this address?"""
+        return address in self._servers
+
     # -- delivery ------------------------------------------------------------
 
     def deliver(self, src: Endpoint, dst: Endpoint, msg: RapidMessage,
@@ -78,12 +90,19 @@ class InProcessNetwork:
         def attempt() -> None:
             server = self._servers.get(dst)
             if server is None:
+                for handler in self._handlers:
+                    if handler.owns(dst):
+                        server = handler
+                        break
+            if server is None:
                 _fail(out, ConnectionError(f"no server listening at {dst}"))
                 return
             try:
-                server.handle(msg).add_callback(
-                    lambda p: _copy(p, out)
-                )
+                if server in self._handlers:
+                    promise = server.handle(dst, msg)
+                else:
+                    promise = server.handle(msg)
+                promise.add_callback(lambda p: _copy(p, out))
             except Exception as e:  # noqa: BLE001
                 _fail(out, e)
 

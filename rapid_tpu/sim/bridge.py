@@ -1,0 +1,429 @@
+"""TpuSimMessaging: real protocol-plane nodes against TPU-hosted virtual peers.
+
+This is the bridge the reference's plugin seams exist for: the untouched
+``Cluster``/``MembershipService`` stack (rapid_tpu.cluster, the analogue of
+the untouched Java API) runs against a swarm of N *virtual* nodes whose rings,
+failure detection, cut detection, and fast-round vote tallies live as device
+arrays in the TPU simulator (rapid_tpu.sim). The bridge crosses exactly the
+two seams the reference defines -- messaging (IMessagingClient/Server,
+IMessagingClient.java:25-48) and edge failure detection -- and nothing else:
+real nodes join through the standard two-phase protocol (Cluster.java:303-474),
+probe their virtual subjects, broadcast alerts, receive fast-round votes, and
+apply view changes through their own untouched consensus path.
+
+How each protocol interaction crosses the bridge:
+
+- **Join** (real node -> swarm): phase 1 seats the joiner's identity in a
+  spare virtual slot (so ring order and configuration identity include it
+  bit-exactly); phase 2 parks the per-observer responses and registers the
+  join with the simulator; when the simulated cut decides, the parked
+  responses complete with the full configuration -- the same
+  park-until-view-change-commits flow as MembershipService.java:229-286.
+- **Probes** (real node -> virtual subject): answered from the simulator's
+  liveness plane; a crashed virtual node fails the probe promise, driving the
+  real node's own PingPong counters.
+- **Alerts** (real node -> all): DOWN alerts about virtual nodes are injected
+  into the simulated report tables (Simulator.inject_down_report), so a real
+  observer's evidence counts toward the swarm's H/L watermarks.
+- **Decisions** (swarm -> real members): when the simulator decides a cut,
+  every real member of the pre-decision configuration receives (a) one
+  batched alert carrying the joiner UUIDs/metadata the view change will need
+  and (b) fast-round votes (FastRoundPhase2bMessage) from live virtual
+  members; the real node's own FastPaxos then reaches the 3/4 supermajority
+  and applies the view change itself -- including firing KICKED if it was cut.
+- **Leave** (real node -> observers): converted to the simulator's proactive
+  leave, deciding in ~1 round.
+- **Real-node liveness** (swarm side): a real node is sensed alive while its
+  server is registered on the network; when it disappears (crash or
+  shutdown), the swarm marks its slot dead and the *simulated* failure
+  detectors remove it through the normal 10-round threshold cut.
+
+Fidelity note: the device-side vote tally counts every live member's slot --
+including real nodes' -- as voting with its delivery group's proposal. Real
+nodes' actual votes are received and acknowledged but do not change the
+simulated tally; with uniform delivery both tallies agree (all members see
+the same alert stream), which is the regime this bridge runs in.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.futures import Promise
+from ..service import address_comparator_key
+from ..types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    ConsensusResponse,
+    EdgeStatus,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    LeaveMessage,
+    NodeId,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    RapidMessage,
+    Response,
+)
+from .driver import Simulator, ViewChangeRecord
+from .engine import SimConfig
+from .topology import ring_order
+
+LOG = logging.getLogger(__name__)
+
+_CONSENSUS_TYPES = (
+    FastRoundPhase2bMessage,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+)
+
+
+def _failed(exc: BaseException) -> Promise:
+    p: Promise = Promise()
+    p.set_exception(exc)
+    return p
+
+
+class TpuSimMessaging:
+    """A multi-endpoint handler on an InProcessNetwork hosting N virtual
+    nodes in the TPU simulator (the BASELINE.json north star's plugin)."""
+
+    def __init__(
+        self,
+        network,
+        n_virtual: int,
+        capacity: Optional[int] = None,
+        config: Optional[SimConfig] = None,
+        seed: int = 0,
+    ) -> None:
+        capacity = capacity if capacity is not None else n_virtual + 16
+        self.sim = Simulator(n_virtual, capacity=capacity, config=config, seed=seed)
+        self.network = network
+        network.attach_handler(self)
+        self._slot_of: Dict[Endpoint, int] = {}
+        for slot in range(n_virtual):
+            self._slot_of[self._endpoint(slot)] = slot
+        self._free_slots: Deque[int] = deque(range(n_virtual, capacity))
+        self._real: Dict[Endpoint, int] = {}
+        # joiner endpoint -> [(observer endpoint, parked promise)]
+        self._parked: Dict[Endpoint, List[Tuple[Endpoint, Promise]]] = {}
+        self._metadata: Dict[Endpoint, tuple] = {}
+
+    # ------------------------------------------------------------------ #
+    # identity helpers
+    # ------------------------------------------------------------------ #
+
+    def _endpoint(self, slot: int) -> Endpoint:
+        host, port = self.sim.endpoint_of(slot)
+        return Endpoint(host, port)
+
+    def _node_id(self, slot: int) -> NodeId:
+        return NodeId(
+            int(self.sim.cluster.id_high[slot]), int(self.sim.cluster.id_low[slot])
+        )
+
+    def endpoint(self, slot: int) -> Endpoint:
+        """A virtual node's address (e.g. a join seed for real nodes)."""
+        return self._endpoint(slot)
+
+    def virtual_members(self) -> List[Endpoint]:
+        return [
+            self._endpoint(s)
+            for s in self.sim.members()
+            if self._endpoint(s) not in self._real
+        ]
+
+    # ------------------------------------------------------------------ #
+    # network handler interface
+    # ------------------------------------------------------------------ #
+
+    def owns(self, address: Endpoint) -> bool:
+        return address in self._slot_of and address not in self._real
+
+    def handle(self, dst: Endpoint, msg: RapidMessage) -> Promise:
+        slot = self._slot_of[dst]
+        if isinstance(msg, ProbeMessage):
+            if self.sim.active[slot] and self.sim.alive[slot]:
+                return Promise.completed(ProbeResponse())
+            return _failed(ConnectionError(f"virtual node {dst} is down"))
+        if isinstance(msg, PreJoinMessage):
+            return Promise.completed(self._handle_pre_join(dst, msg))
+        if isinstance(msg, JoinMessage):
+            return self._handle_join(dst, msg)
+        if isinstance(msg, BatchedAlertMessage):
+            self._absorb_alerts(msg)
+            return Promise.completed(Response())
+        if isinstance(msg, _CONSENSUS_TYPES):
+            # real members' votes are acknowledged; see the fidelity note
+            return Promise.completed(ConsensusResponse())
+        if isinstance(msg, LeaveMessage):
+            sender_slot = self._slot_of.get(msg.sender)
+            if (
+                sender_slot is not None
+                and self.sim.active[sender_slot]
+                and self.sim.alive[sender_slot]
+                and sender_slot not in self.sim.pending_leavers
+            ):
+                self.sim.leave(np.array([sender_slot]))
+            return Promise.completed(Response())
+        return _failed(TypeError(f"unexpected message {type(msg).__name__}"))
+
+    # ------------------------------------------------------------------ #
+    # join protocol (swarm side)
+    # ------------------------------------------------------------------ #
+
+    def _handle_pre_join(self, dst: Endpoint, msg: PreJoinMessage) -> JoinResponse:
+        """Phase-1 gatekeeping at a virtual seed (MembershipService.java:200-221)."""
+        slot = self._slot_of.get(msg.sender)
+        if slot is not None and self.sim.active[slot]:
+            status = JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+        elif self.sim.is_identifier_seen(msg.node_id.high, msg.node_id.low):
+            return JoinResponse(
+                sender=dst,
+                status_code=JoinStatusCode.UUID_ALREADY_IN_RING,
+                configuration_id=self.sim.configuration_id(),
+            )
+        else:
+            status = JoinStatusCode.SAFE_TO_JOIN
+            if slot is None:
+                if not self._free_slots:
+                    return JoinResponse(
+                        sender=dst,
+                        status_code=JoinStatusCode.MEMBERSHIP_REJECTED,
+                        configuration_id=self.sim.configuration_id(),
+                    )
+                slot = self._free_slots.popleft()
+                self._slot_of[msg.sender] = slot
+                self._real[msg.sender] = slot
+            # a retry -- or a rejoin after removal -- re-seats the same slot
+            # with the fresh UUID; the identifier history is value-based, so
+            # the slot's past identities stay in the configuration-id fold.
+            # While a phase-2 join is pending the identity is already seated
+            # (the client retries phase 1 with the same UUID, Cluster.java:313-344).
+            if slot not in self.sim.pending_joiners:
+                self.sim.assign_identity(
+                    slot,
+                    msg.sender.hostname,
+                    msg.sender.port,
+                    msg.node_id.high,
+                    msg.node_id.low,
+                )
+        # expected observers = ring predecessors, for present members too
+        # (MembershipView.java:293-304; service._handle_pre_join returns them
+        # for HOSTNAME_ALREADY_IN_RING as well)
+        observer_slots, _ = self.sim.expected_observers(slot)
+        return JoinResponse(
+            sender=dst,
+            status_code=status,
+            configuration_id=self.sim.configuration_id(),
+            endpoints=tuple(self._endpoint(int(s)) for s in observer_slots),
+        )
+
+    def _handle_join(self, dst: Endpoint, msg: JoinMessage) -> Promise:
+        """Phase-2 at a virtual observer: park until the simulated view change
+        commits (MembershipService.java:229-286)."""
+        slot = self._slot_of.get(msg.sender)
+        current = self.sim.configuration_id()
+        if slot is None:
+            return Promise.completed(
+                JoinResponse(
+                    sender=dst,
+                    status_code=JoinStatusCode.CONFIG_CHANGED,
+                    configuration_id=current,
+                )
+            )
+        if msg.configuration_id != current:
+            if self.sim.active[slot]:
+                # the cut already admitted this joiner; stream the config
+                return Promise.completed(self._full_config_response(dst))
+            return Promise.completed(
+                JoinResponse(
+                    sender=dst,
+                    status_code=JoinStatusCode.CONFIG_CHANGED,
+                    configuration_id=current,
+                )
+            )
+        parked: Promise = Promise()
+        self._parked.setdefault(msg.sender, []).append((dst, parked))
+        if msg.metadata:
+            self._metadata[msg.sender] = msg.metadata
+        if slot not in self.sim.pending_joiners:
+            self.sim.request_joins(np.array([slot]))
+        return parked
+
+    def _full_config_response(self, sender: Endpoint) -> JoinResponse:
+        sim = self.sim
+        order0 = ring_order(sim.cluster, sim.active, 0)
+        endpoints = tuple(self._endpoint(int(s)) for s in order0)
+        identifiers = tuple(
+            NodeId(int(h), int(l)) for h, l in sim.sorted_identifiers()
+        )
+        metadata = tuple(
+            (ep, md)
+            for ep, md in self._metadata.items()
+            if sim.active[self._slot_of[ep]]
+        )
+        return JoinResponse(
+            sender=sender,
+            status_code=JoinStatusCode.SAFE_TO_JOIN,
+            configuration_id=sim.configuration_id(),
+            endpoints=endpoints,
+            identifiers=identifiers,
+            metadata=metadata,
+        )
+
+    # ------------------------------------------------------------------ #
+    # alerts from real members
+    # ------------------------------------------------------------------ #
+
+    def _absorb_alerts(self, batch: BatchedAlertMessage) -> None:
+        """A real member's broadcast: DOWN evidence joins the simulated report
+        tables; UP metadata is stashed for the joiner's admission."""
+        current = self.sim.configuration_id()
+        for alert in batch.messages:
+            if alert.configuration_id != current:
+                continue
+            slot = self._slot_of.get(alert.edge_dst)
+            if slot is None:
+                continue
+            if alert.edge_status == EdgeStatus.DOWN and self.sim.active[slot]:
+                self.sim.inject_down_report(slot, alert.ring_numbers)
+            elif alert.edge_status == EdgeStatus.UP and alert.metadata:
+                self._metadata[alert.edge_dst] = alert.metadata
+
+    # ------------------------------------------------------------------ #
+    # the pump: device rounds + decision delivery
+    # ------------------------------------------------------------------ #
+
+    def pump(
+        self, max_rounds: int = 32, batch: int = 8
+    ) -> Optional[ViewChangeRecord]:
+        """Sense real-node liveness, run simulated rounds until a decision,
+        then make that decision real: alerts + votes to every real member of
+        the pre-decision configuration, full configurations to admitted
+        joiners."""
+        self._sense_real_liveness()
+        sim = self.sim
+        config_before = sim.configuration_id()
+        n_before = sim.membership_size
+        members_before = [
+            ep
+            for ep, slot in self._real.items()
+            if sim.active[slot] and self.network.is_listening(ep)
+        ]
+        # fast-round votes are cast by the pre-decision configuration's live
+        # members; the cut-set members that are *leaving* voted too
+        voters = [
+            ep
+            for ep in (
+                self._endpoint(int(s))
+                for s in np.flatnonzero(sim.active & sim.alive)
+            )
+            if ep not in self._real
+        ]
+        rec = sim.run_until_decision(max_rounds=max_rounds, batch=batch)
+        if rec is None:
+            return None
+        cut_eps = sorted(
+            (self._endpoint(int(s)) for s in rec.cut), key=address_comparator_key
+        )
+        added = {int(s) for s in rec.added}
+        if members_before and not voters:
+            LOG.warning(
+                "no live virtual voters; real members cannot learn this decision"
+            )
+        if members_before and voters:
+            alerts = tuple(
+                AlertMessage(
+                    edge_src=voters[0],
+                    edge_dst=ep,
+                    edge_status=(
+                        EdgeStatus.UP
+                        if self._slot_of[ep] in added
+                        else EdgeStatus.DOWN
+                    ),
+                    configuration_id=config_before,
+                    ring_numbers=(0,),
+                    node_id=(
+                        self._node_id(self._slot_of[ep])
+                        if self._slot_of[ep] in added
+                        else None
+                    ),
+                    metadata=self._metadata.get(ep, ()),
+                )
+                for ep in cut_eps
+            )
+            quorum = n_before - (n_before - 1) // 4
+            if len(voters) < quorum:
+                LOG.warning(
+                    "only %d live virtual voters for quorum %d; real members "
+                    "will need the classic fallback",
+                    len(voters),
+                    quorum,
+                )
+            for member in members_before:
+                self._deliver(
+                    voters[0], member, BatchedAlertMessage(voters[0], alerts)
+                )
+                for voter in voters[:quorum]:
+                    self._deliver(
+                        voter,
+                        member,
+                        FastRoundPhase2bMessage(
+                            sender=voter,
+                            configuration_id=config_before,
+                            endpoints=tuple(cut_eps),
+                        ),
+                    )
+        # unblock admitted joiners (respondToJoiners, MembershipService.java:708-733)
+        for joiner in list(self._parked):
+            slot = self._slot_of.get(joiner)
+            if slot is not None and sim.active[slot]:
+                for observer_ep, parked in self._parked.pop(joiner):
+                    parked.set_result(self._full_config_response(observer_ep))
+        # recycle removed real nodes' slots: the identifier history is
+        # value-based, so a slot can be re-seated for a future joiner
+        for slot in (int(s) for s in rec.removed):
+            ep = self._endpoint(slot)
+            if self._real.get(ep) == slot:
+                del self._real[ep]
+                del self._slot_of[ep]
+                self._metadata.pop(ep, None)
+                self._free_slots.append(slot)
+        return rec
+
+    def _deliver(self, src: Endpoint, dst: Endpoint, msg: RapidMessage) -> None:
+        self.network.deliver(src, dst, msg, timeout_ms=1000)
+
+    def _sense_real_liveness(self) -> None:
+        """A real node is alive while its server listens on the network; when
+        it disappears, its slot dies and the simulated FDs take over. A node
+        that dies *before* admission has its pending join withdrawn and its
+        spare slot reclaimed."""
+        for ep, slot in list(self._real.items()):
+            if self.network.is_listening(ep):
+                continue
+            if self.sim.active[slot]:
+                if self.sim.alive[slot]:
+                    self.sim.crash(np.array([slot]))
+            else:
+                self.sim.cancel_join(slot)
+                del self._real[ep]
+                del self._slot_of[ep]
+                self._metadata.pop(ep, None)
+                self._parked.pop(ep, None)  # the dead joiner can't hear replies
+                self._free_slots.append(slot)

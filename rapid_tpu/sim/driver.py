@@ -73,10 +73,19 @@ class Simulator:
         self.active[:n_nodes] = True
         self.alive = self.active.copy()
         self.group_of = np.zeros(capacity, dtype=np.int32)
-        # identifiersSeen is append-only: node slots whose identifier has been
-        # used. A rejoin needs a fresh slot (= fresh identifier), exactly as a
-        # real rejoining process draws a fresh UUID (Cluster.java:327-331).
-        self.identifiers_seen: Set[int] = set(np.flatnonzero(self.active))
+        # identifiersSeen is an append-only *value* history of every NodeId
+        # ever admitted (MembershipView.java:51,155): stored by (high, low)
+        # value, not by slot, so slots can be re-seated with fresh identities
+        # (a rejoining process draws a fresh UUID, Cluster.java:327-331)
+        # without corrupting the configuration-id fold over the history.
+        slots = np.flatnonzero(self.active)
+        self._seen_ids = np.stack(
+            [self.cluster.id_high[slots], self.cluster.id_low[slots]], axis=1
+        )  # [M, 2] int64, admission order
+        self._seen_set: Set[Tuple[int, int]] = {
+            (int(h), int(l)) for h, l in self._seen_ids
+        }
+        self._seen_hashes: Optional[np.ndarray] = None  # [M, 2] uint64
         self.seed = seed
         self.virtual_ms = 0
         self._init_runtime_state()
@@ -101,10 +110,14 @@ class Simulator:
         self._join_reports_armed = False
         self._pending_leavers: Set[int] = set()
         self._down_reports_dev: Optional[jax.Array] = None
-        # membership-invariant per-node hashes: construction cost, not
+        self._injected_down = np.zeros(
+            (self.config.capacity, self.config.k), dtype=bool
+        )
+        # membership-invariant element hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
         self._sorted_identifiers()
+        self._seen_id_hashes()
 
     def _init_device_caches(self) -> None:
         """Device-resident constants allocated once per simulator: the signed
@@ -113,6 +126,7 @@ class Simulator:
         the [C] liveness mask)."""
         c, k, g = self.config.capacity, self.config.k, self.config.groups
         self._ring_rank_dev = jnp.asarray(self.cluster.ring_rank())
+        self._ring_rank_dirty = False
         self._zero_ck = jnp.zeros((c, k), bool)
         self._zero_drop_prob = jnp.zeros(c, jnp.float32)
         self._ones_deliver = jnp.ones((g, c), bool)
@@ -125,6 +139,10 @@ class Simulator:
 
     def _fresh_state(self, seed: int) -> SimState:
         """Fresh-configuration state, built on device (engine.device_initial_state)."""
+        if self._ring_rank_dirty:
+            # identities assigned since the last rebuild (joiner seating)
+            self._ring_rank_dev = jnp.asarray(self.cluster.ring_rank())
+            self._ring_rank_dirty = False
         self._subjects_host = None
         self._observers_host = None
         self._ring_nodes = None
@@ -173,6 +191,54 @@ class Simulator:
             assert self.alive[node], f"node {node} is crashed, cannot leave"
             self._pending_leavers.add(node)
         self._down_reports_dev = None
+
+    def inject_down_report(self, dst: int, rings) -> None:
+        """Externally sourced DOWN reports for ``dst`` on the given rings --
+        how alerts broadcast by *real* processes (bridged via TpuSimMessaging)
+        enter the simulated cut detector's report table. One-shot per
+        configuration, like any other alert."""
+        self._injected_down[dst, list(rings)] = True
+        self._down_reports_dev = None
+
+    def assign_identity(
+        self, slot: int, hostname: bytes, port: int, id_high: int, id_low: int
+    ) -> None:
+        """Seat a process identity in an inactive slot ahead of its join; see
+        VirtualCluster.assign_identity. Re-seating a slot whose previous
+        identity was admitted in some past configuration is legal -- the
+        identifier history is stored by value -- but identifier *reuse* is
+        not, exactly as the reference rejects seen UUIDs
+        (MembershipView.java:101-116)."""
+        assert not self.active[slot] and slot not in self._pending_joiners
+        assert (id_high, id_low) not in self._seen_set, "identifier reuse"
+        self.cluster.assign_identity(slot, hostname, port, id_high, id_low)
+        # the device rank table is only consumed at the next configuration
+        # rebuild (_fresh_state); defer the argsort + upload until then so a
+        # burst of seatings pays it once, off the message-handling path
+        self._ring_rank_dirty = True
+        self._ring_nodes = None
+
+    def is_identifier_seen(self, id_high: int, id_low: int) -> bool:
+        return (id_high, id_low) in self._seen_set
+
+    @property
+    def identifiers_seen(self) -> Set[Tuple[int, int]]:
+        """The append-only identifier history, as (high, low) values."""
+        return set(self._seen_set)
+
+    @property
+    def pending_joiners(self) -> Set[int]:
+        return set(self._pending_joiners)
+
+    @property
+    def pending_leavers(self) -> Set[int]:
+        return set(self._pending_leavers)
+
+    def endpoint_of(self, slot: int) -> Tuple[bytes, int]:
+        host = bytes(
+            self.cluster.hostnames[slot, : self.cluster.host_lengths[slot]]
+        )
+        return host, int(self.cluster.ports[slot])
 
     def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
         """Asymmetric failure: probes TO these nodes are lost, their own
@@ -223,18 +289,23 @@ class Simulator:
             self._subjects_host = np.asarray(self.state.subjects)
         return mask[self._subjects_host]
 
+    def _has_down_reports(self) -> bool:
+        return bool(self._pending_leavers) or bool(self._injected_down.any())
+
     def _down_reports(self) -> jax.Array:
-        """dst-indexed proactive DOWN reports for the pending leavers: ring-k
-        report for a leaver arrives iff its ring-k observer is alive to
-        broadcast (the leaver's notification is consumed by that observer,
-        MembershipService.java:366-371)."""
+        """dst-indexed proactive DOWN reports: pending leavers (ring-k report
+        for a leaver arrives iff its ring-k observer is alive to broadcast --
+        the leaver's notification is consumed by that observer,
+        MembershipService.java:366-371) plus externally injected reports from
+        bridged real processes."""
         if self._down_reports_dev is None:
-            mask = np.zeros((self.config.capacity, self.config.k), dtype=bool)
-            if self._observers_host is None:
-                self._observers_host = np.asarray(self.state.observers)
-            leavers = sorted(self._pending_leavers)
-            obs = self._observers_host[leavers]  # [L, K]
-            mask[leavers] = self.alive[obs] & self.active[obs]
+            mask = self._injected_down.copy()
+            if self._pending_leavers:
+                if self._observers_host is None:
+                    self._observers_host = np.asarray(self.state.observers)
+                leavers = sorted(self._pending_leavers)
+                obs = self._observers_host[leavers]  # [L, K]
+                mask[leavers] |= self.alive[obs] & self.active[obs]
             self._down_reports_dev = jnp.asarray(mask)
         return self._down_reports_dev
 
@@ -261,7 +332,7 @@ class Simulator:
                 self._zero_ck if join_reports is None else jnp.asarray(join_reports)
             ),
             down_reports=(
-                self._down_reports() if self._pending_leavers else self._zero_ck
+                self._down_reports() if self._has_down_reports() else self._zero_ck
             ),
             deliver=(
                 self._ones_deliver
@@ -282,8 +353,15 @@ class Simulator:
         for node in np.atleast_1d(node_ids):
             node = int(node)
             assert not self.active[node], f"node {node} already a member"
-            assert node not in self.identifiers_seen, f"identifier reuse at {node}"
+            nid = (int(self.cluster.id_high[node]), int(self.cluster.id_low[node]))
+            assert nid not in self._seen_set, f"identifier reuse at {node}"
             self._pending_joiners.add(node)
+        self._join_reports_armed = False
+
+    def cancel_join(self, slot: int) -> None:
+        """Withdraw a pending join (the joiner gave up or died before
+        admission); its UP reports stop being armed from the next dispatch."""
+        self._pending_joiners.discard(slot)
         self._join_reports_armed = False
 
     def _arm_pending_joins(self) -> Optional[np.ndarray]:
@@ -303,9 +381,14 @@ class Simulator:
         self.state = dataclasses.replace(self.state, observers=jnp.asarray(observers))
         return join_reports
 
+    def expected_observers(self, node: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Public alias of _expected_observers (used by the messaging bridge)."""
+        return self._expected_observers(node)
+
     def _expected_observers(self, node: int) -> Tuple[np.ndarray, np.ndarray]:
-        """The joiner's would-be ring predecessors (MembershipView.java:293-304)
-        and whether each is alive to vouch."""
+        """The node's ring predecessors (MembershipView.java:293-304 for
+        joiners; equally the expected-observer set of a present member) and
+        whether each is alive to vouch."""
         k = self.config.k
         ids = np.zeros(k, dtype=np.int32)
         alive = np.zeros(k, dtype=bool)
@@ -449,8 +532,20 @@ class Simulator:
         self.active[removed] = False
         self.active[added] = True
         self.alive[added] = True
-        self.identifiers_seen.update(int(i) for i in added)
         if len(added):
+            new_ids = np.stack(
+                [self.cluster.id_high[added], self.cluster.id_low[added]], axis=1
+            )
+            self._seen_ids = np.concatenate([self._seen_ids, new_ids])
+            self._seen_set.update((int(h), int(l)) for h, l in new_ids)
+            if self._seen_hashes is not None:
+                high_h, low_h, _, _ = self.cluster.node_hashes()
+                self._seen_hashes = np.concatenate(
+                    [
+                        self._seen_hashes,
+                        np.stack([high_h[added], low_h[added]], axis=1),
+                    ]
+                )
             self._ids_sorted = None
         self._pending_joiners.difference_update(int(i) for i in added)
         self._ingress_partitioned.difference_update(int(i) for i in removed)
@@ -460,6 +555,7 @@ class Simulator:
         left = self._pending_leavers.intersection(int(i) for i in removed)
         self._pending_leavers.difference_update(left)
         self.alive[list(left)] = False
+        self._injected_down[:] = False  # alerts are per-configuration
 
         # protocol-time: only the rounds of this configuration not yet billed,
         # plus the batching window before the deciding broadcast
@@ -488,25 +584,54 @@ class Simulator:
     def configuration_id(self) -> int:
         """Bit-exact configuration identity of the current membership.
 
-        Per-node element hashes are immutable and cached on the cluster
-        (VirtualCluster.node_hashes); only the fold over the current ordering
-        runs per view change."""
-        high_h, low_h, host_h, port_h = self.cluster.node_hashes()
-        ids = self._sorted_identifiers()
+        Element hashes are cached (endpoint hashes on the cluster, identifier
+        hashes on the append-only history); only the fold over the current
+        ordering runs per view change."""
+        _, _, host_h, port_h = self.cluster.node_hashes()
+        order = self._sorted_identifiers()
+        seen_h = self._seen_id_hashes()
         order0 = ring_order(self.cluster, self.active, 0)
-        return config_fold(high_h[ids], low_h[ids], host_h[order0], port_h[order0])
+        return config_fold(
+            seen_h[order, 0], seen_h[order, 1], host_h[order0], port_h[order0]
+        )
+
+    def sorted_identifiers(self) -> np.ndarray:
+        """The identifier history as [M, 2] (high, low) values in NodeId
+        (signed-lexicographic) order."""
+        return self._seen_ids[self._sorted_identifiers()]
 
     def _sorted_identifiers(self) -> np.ndarray:
-        """identifiersSeen in NodeId (high, low) signed-lexicographic order,
-        cached until a new identifier is admitted (the set is append-only)."""
+        """Indices into the seen-identifier history in NodeId (high, low)
+        signed-lexicographic order, cached until a new identifier is admitted
+        (the history is append-only)."""
         if self._ids_sorted is None:
-            ids = np.fromiter(
-                self.identifiers_seen, dtype=np.int64,
-                count=len(self.identifiers_seen),
+            self._ids_sorted = np.lexsort(
+                (self._seen_ids[:, 1], self._seen_ids[:, 0])
             )
-            order = np.lexsort((self.cluster.id_low[ids], self.cluster.id_high[ids]))
-            self._ids_sorted = ids[order]
         return self._ids_sorted
+
+    def _seen_id_hashes(self) -> np.ndarray:
+        """xxHash64 of each seen identifier's high/low values ([M, 2] uint64),
+        computed from the values themselves (slot-independent) and maintained
+        incrementally at admissions."""
+        if self._seen_hashes is None or len(self._seen_hashes) != len(self._seen_ids):
+            from ..hashing import xxh64_batch
+            from .topology import _int64_le_bytes
+
+            m = len(self._seen_ids)
+            eight = np.full(m, 8, dtype=np.int64)
+            self._seen_hashes = np.stack(
+                [
+                    xxh64_batch(
+                        _int64_le_bytes(self._seen_ids[:, 0]), eight, 0
+                    ),
+                    xxh64_batch(
+                        _int64_le_bytes(self._seen_ids[:, 1]), eight, 0
+                    ),
+                ],
+                axis=1,
+            )
+        return self._seen_hashes
 
     def ready(self) -> "Simulator":
         """Block until construction/rebuild work has drained from the device
@@ -543,7 +668,7 @@ class Simulator:
             ring_hashes=self.cluster.ring_hashes,
             active=self.active,
             alive=self.alive,
-            identifiers_seen=np.array(sorted(self.identifiers_seen), dtype=np.int64),
+            identifiers_seen=self._seen_ids,  # [M, 2] (high, low) values
             virtual_ms=np.int64(self.virtual_ms),
             group_of=self.group_of,
             params=np.array(
@@ -580,7 +705,17 @@ class Simulator:
             )
             sim.active = data["active"].copy()
             sim.alive = data["alive"].copy()
-            sim.identifiers_seen = set(int(i) for i in data["identifiers_seen"])
+            seen = data["identifiers_seen"]
+            if seen.ndim == 1:
+                # pre-value-history snapshots stored slot indices
+                slots = seen.astype(np.int64)
+                seen = np.stack(
+                    [sim.cluster.id_high[slots], sim.cluster.id_low[slots]],
+                    axis=1,
+                )
+            sim._seen_ids = seen.copy()
+            sim._seen_set = {(int(h), int(l)) for h, l in sim._seen_ids}
+            sim._seen_hashes = None
             sim.seed = seed
             sim.virtual_ms = int(data["virtual_ms"])
             sim.group_of = (

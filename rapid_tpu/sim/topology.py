@@ -116,6 +116,44 @@ class VirtualCluster:
             )
         return self._node_hashes
 
+    def assign_identity(
+        self, slot: int, hostname: bytes, port: int, id_high: int, id_low: int
+    ) -> None:
+        """Replace a slot's identity (endpoint + NodeId) -- used by the
+        messaging bridge to seat a *real* process in a spare virtual slot so
+        it participates in ring construction and configuration identity
+        exactly like a synthesized node. Only the slot's column of the ring
+        hashes and element hashes is recomputed; order caches rebuild lazily."""
+        from ..hashing import endpoint_hash, xxh64
+
+        if len(hostname) > self.hostnames.shape[1]:
+            grown = np.zeros(
+                (self.capacity, len(hostname)), dtype=np.uint8
+            )
+            grown[:, : self.hostnames.shape[1]] = self.hostnames
+            self.hostnames = grown
+        self.hostnames[slot, :] = 0
+        self.hostnames[slot, : len(hostname)] = np.frombuffer(hostname, np.uint8)
+        self.host_lengths[slot] = len(hostname)
+        self.ports[slot] = port
+        self.id_high[slot] = id_high
+        self.id_low[slot] = id_low
+        for ring in range(self.ring_hashes.shape[0]):
+            self.ring_hashes[ring, slot] = np.uint64(
+                endpoint_hash(hostname, port, ring)
+            )
+        if self._node_hashes is not None:
+            high_h, low_h, host_h, port_h = self._node_hashes
+            high_h[slot] = np.uint64(xxh64(_int64_le_bytes(
+                np.array([id_high], dtype=np.int64))[0].tobytes()))
+            low_h[slot] = np.uint64(xxh64(_int64_le_bytes(
+                np.array([id_low], dtype=np.int64))[0].tobytes()))
+            host_h[slot] = np.uint64(xxh64(hostname))
+            port_h[slot] = np.uint64(xxh64(_port_le_bytes(
+                np.array([port], dtype=np.int64))[0].tobytes()))
+        self._full_order = None
+        self._ring_rank = None
+
     @staticmethod
     def synthesize(capacity: int, k: int, seed: int = 0) -> "VirtualCluster":
         """Synthetic but *realistic* identities: distinct host:port strings and

@@ -1,0 +1,265 @@
+"""TpuSimMessaging bridge: real protocol-plane nodes against TPU-hosted
+virtual peers (the BASELINE.json north-star plugin).
+
+A real node built on the untouched ClusterBuilder/Cluster API joins a swarm
+of simulated virtual nodes through the standard two-phase protocol, observes
+simulated crash cuts through its own FastPaxos, leaves gracefully, and is
+itself detected and removed by the simulated failure detectors when it dies.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from rapid_tpu import ClusterBuilder, Endpoint, Settings
+from rapid_tpu.events import ClusterEvents
+from rapid_tpu.messaging.inprocess import (
+    InProcessClient,
+    InProcessNetwork,
+    InProcessServer,
+)
+from rapid_tpu.runtime.scheduler import VirtualScheduler
+from rapid_tpu.sim.bridge import TpuSimMessaging
+
+
+class BridgeHarness:
+    def __init__(self, n_virtual: int = 24, capacity: int = 32, seed: int = 5):
+        self.scheduler = VirtualScheduler()
+        self.network = InProcessNetwork(self.scheduler)
+        self.swarm = TpuSimMessaging(
+            self.network, n_virtual=n_virtual, capacity=capacity, seed=seed
+        )
+        self.settings = Settings()
+        self.rng = random.Random(seed)
+
+    def join_real_node(self, name: str, port: int = 9000, metadata=None):
+        ep = Endpoint.from_parts(name, port)
+        server = InProcessServer(ep, self.network)
+        builder = (
+            ClusterBuilder(ep)
+            .set_messaging_client_and_server(
+                InProcessClient(ep, self.network, self.settings), server
+            )
+            .use_scheduler(self.scheduler)
+            .use_settings(self.settings)
+            .use_rng(random.Random(self.rng.getrandbits(64)))
+        )
+        if metadata:
+            builder.set_metadata(metadata)
+        promise = builder.join_async(self.swarm.endpoint(0))
+        self.scheduler.run_for(50)  # deliver join phases; park at observers
+        rec = self.swarm.pump()
+        assert rec is not None, "join did not decide"
+        assert self.scheduler.run_until(promise.done, timeout_ms=10_000)
+        return promise.result(0), rec
+
+
+def test_real_node_joins_virtual_swarm():
+    h = BridgeHarness(n_virtual=24, seed=5)
+    cluster, rec = h.join_real_node("real-1")
+    assert len(rec.added) == 1 and len(rec.removed) == 0
+    assert cluster.get_membership_size() == 25
+    assert h.swarm.sim.membership_size == 25
+    # bit-exact configuration identity across the bridge
+    assert cluster.get_current_configuration_id() == h.swarm.sim.configuration_id()
+    assert cluster.listen_address in cluster.get_memberlist()
+
+
+def test_real_node_observes_simulated_crash_cut():
+    h = BridgeHarness(n_virtual=24, seed=6)
+    cluster, _ = h.join_real_node("real-1")
+    events = []
+    cluster.register_subscription(
+        ClusterEvents.VIEW_CHANGE, lambda cid, changes: events.append(changes)
+    )
+    victims = np.array([3, 11, 17])
+    h.swarm.sim.crash(victims)
+    rec = h.swarm.pump(max_rounds=16)
+    assert rec is not None and sorted(rec.cut) == [3, 11, 17]
+    # votes are in flight on the virtual network; let the real node tally them
+    h.scheduler.run_for(200)
+    assert cluster.get_membership_size() == 22
+    assert cluster.get_current_configuration_id() == h.swarm.sim.configuration_id()
+    assert len(events) == 1 and len(events[0]) == 3
+    crashed_eps = {h.swarm.endpoint(int(v)) for v in victims}
+    assert {c.endpoint for c in events[0]} == crashed_eps
+
+
+def test_real_node_leaves_gracefully():
+    h = BridgeHarness(n_virtual=16, seed=7)
+    cluster, join_rec = h.join_real_node("real-1")
+    done = cluster.leave_gracefully_async()
+    h.scheduler.run_for(50)  # LeaveMessages reach the virtual observers
+    rec = h.swarm.pump(max_rounds=8)
+    assert rec is not None
+    assert [h.swarm._endpoint(int(s)) for s in rec.cut] == [cluster.listen_address]
+    # leave decided in ~1 round, not the 10-round FD threshold
+    assert rec.virtual_time_ms - join_rec.virtual_time_ms == 1 * 1000 + 100
+    assert h.swarm.sim.membership_size == 16
+    assert h.scheduler.run_until(done.done, timeout_ms=30_000)
+
+
+def test_dead_real_node_removed_by_simulated_fd():
+    h = BridgeHarness(n_virtual=16, seed=8)
+    cluster, _ = h.join_real_node("real-1")
+    assert h.swarm.sim.membership_size == 17
+    cluster.shutdown()  # server unregisters: the swarm senses the death
+    rec = h.swarm.pump(max_rounds=32, batch=16)
+    assert rec is not None
+    assert [h.swarm._endpoint(int(s)) for s in rec.cut] == [cluster.listen_address]
+    assert h.swarm.sim.membership_size == 16
+
+
+def test_two_real_nodes_share_one_swarm():
+    h = BridgeHarness(n_virtual=24, capacity=32, seed=9)
+    cluster1, _ = h.join_real_node("real-1", 9000)
+    cluster2, _ = h.join_real_node("real-2", 9001)
+    # the first node observes the second's admission through votes
+    h.scheduler.run_for(200)
+    assert cluster1.get_membership_size() == 26
+    assert cluster2.listen_address in cluster1.get_memberlist()
+    assert (
+        cluster1.get_current_configuration_id()
+        == cluster2.get_current_configuration_id()
+        == h.swarm.sim.configuration_id()
+    )
+    # a crash cut reaches both real members
+    h.swarm.sim.crash(np.array([5]))
+    rec = h.swarm.pump(max_rounds=16)
+    assert rec is not None
+    h.scheduler.run_for(200)
+    assert cluster1.get_membership_size() == 25
+    assert cluster2.get_membership_size() == 25
+
+
+def test_join_metadata_travels_through_bridge():
+    h = BridgeHarness(n_virtual=16, seed=10)
+    cluster, _ = h.join_real_node("real-1", metadata={"zone": b"us-east-1"})
+    md = cluster.get_cluster_metadata()
+    assert md.get(cluster.listen_address) == (("zone", b"us-east-1"),)
+
+
+def test_uuid_reuse_rejected_across_bridge():
+    h = BridgeHarness(n_virtual=16, seed=11)
+    cluster, _ = h.join_real_node("real-1")
+    from rapid_tpu.types import JoinStatusCode, NodeId, PreJoinMessage
+
+    # replay a pre-join with an identifier the swarm has already seen
+    high, low = (int(x) for x in h.swarm.sim.sorted_identifiers()[0])
+    resp = h.swarm._handle_pre_join(
+        h.swarm.endpoint(0),
+        PreJoinMessage(
+            sender=Endpoint.from_parts("real-2", 9002), node_id=NodeId(high, low)
+        ),
+    )
+    assert resp.status_code == JoinStatusCode.UUID_ALREADY_IN_RING
+
+
+def test_real_node_rejoins_after_leave():
+    """A removed real node can rejoin with a fresh UUID: its slot is
+    recycled and the identifier history keeps every past identity."""
+    h = BridgeHarness(n_virtual=16, seed=13)
+    cluster, _ = h.join_real_node("real-1")
+    ids_before = len(h.swarm.sim.identifiers_seen)
+    done = cluster.leave_gracefully_async()
+    h.scheduler.run_for(50)
+    assert h.swarm.pump(max_rounds=8) is not None
+    assert h.scheduler.run_until(done.done, timeout_ms=30_000)
+    assert h.swarm.sim.membership_size == 16
+
+    cluster2, rec = h.join_real_node("real-1")  # same endpoint, fresh UUID
+    assert cluster2.get_membership_size() == 17
+    assert cluster2.get_current_configuration_id() == h.swarm.sim.configuration_id()
+    # both the departed and the rejoined identity are in the history
+    assert len(h.swarm.sim.identifiers_seen) == ids_before + 1
+
+
+def test_rejoin_after_crash_detection():
+    """A real node that dies is cut by the simulated FDs and can come back."""
+    h = BridgeHarness(n_virtual=16, seed=14)
+    cluster, _ = h.join_real_node("real-1")
+    cluster.shutdown()
+    rec = h.swarm.pump(max_rounds=32, batch=16)
+    assert rec is not None and h.swarm.sim.membership_size == 16
+    cluster2, _ = h.join_real_node("real-1")
+    assert cluster2.get_membership_size() == 17
+    assert cluster2.get_current_configuration_id() == h.swarm.sim.configuration_id()
+
+
+def test_real_node_down_alert_injected_into_swarm():
+    """A real observer's DOWN alert about a virtual subject enters the
+    simulated report tables."""
+    h = BridgeHarness(n_virtual=16, seed=12)
+    cluster, _ = h.join_real_node("real-1")
+    subjects = cluster._membership_service._view.get_subjects_of(
+        cluster.listen_address
+    )
+    target = subjects[0]
+    slot = h.swarm._slot_of[target]
+    from rapid_tpu.types import AlertMessage, BatchedAlertMessage, EdgeStatus
+
+    batch = BatchedAlertMessage(
+        sender=cluster.listen_address,
+        messages=(
+            AlertMessage(
+                edge_src=cluster.listen_address,
+                edge_dst=target,
+                edge_status=EdgeStatus.DOWN,
+                configuration_id=h.swarm.sim.configuration_id(),
+                ring_numbers=(0,),
+            ),
+        ),
+    )
+    h.swarm._absorb_alerts(batch)
+    assert h.swarm.sim._injected_down[slot, 0]
+
+
+def test_prejoin_retry_while_join_pending_is_safe():
+    """A phase-1 retry (same UUID) while the phase-2 join is parked must
+    answer SAFE_TO_JOIN again, not crash on the already-seated identity."""
+    h = BridgeHarness(n_virtual=16, seed=15)
+    from rapid_tpu.types import JoinMessage, JoinStatusCode, NodeId, PreJoinMessage
+
+    ep = Endpoint.from_parts("real-retry", 9100)
+    nid = NodeId.random(random.Random(99))
+    seed_ep = h.swarm.endpoint(0)
+    first = h.swarm._handle_pre_join(seed_ep, PreJoinMessage(ep, nid))
+    assert first.status_code == JoinStatusCode.SAFE_TO_JOIN
+    h.swarm._handle_join(
+        first.endpoints[0],
+        JoinMessage(ep, nid, (0,), first.configuration_id),
+    )
+    assert h.swarm._slot_of[ep] in h.swarm.sim.pending_joiners
+    retry = h.swarm._handle_pre_join(seed_ep, PreJoinMessage(ep, nid))
+    assert retry.status_code == JoinStatusCode.SAFE_TO_JOIN
+    assert retry.endpoints == first.endpoints
+
+
+def test_joiner_death_before_admission_reclaims_slot():
+    """A joiner that dies between pre-join and admission is withdrawn and its
+    spare slot returns to the free list."""
+    h = BridgeHarness(n_virtual=16, capacity=20, seed=16)
+    free_before = len(h.swarm._free_slots)
+    ep = Endpoint.from_parts("doomed", 9200)
+    server = InProcessServer(ep, h.network)
+    settings = Settings()
+    builder = (
+        ClusterBuilder(ep)
+        .set_messaging_client_and_server(
+            InProcessClient(ep, h.network, settings), server
+        )
+        .use_scheduler(h.scheduler)
+        .use_settings(settings)
+        .use_rng(random.Random(3))
+    )
+    builder.join_async(h.swarm.endpoint(0))
+    h.scheduler.run_for(50)  # join parked, slot consumed
+    assert len(h.swarm._free_slots) == free_before - 1
+    assert h.swarm.sim.pending_joiners
+    server.shutdown()  # the joiner dies before any decision
+    rec = h.swarm.pump(max_rounds=8)
+    assert rec is None  # nothing to decide: the join was withdrawn
+    assert not h.swarm.sim.pending_joiners
+    assert len(h.swarm._free_slots) == free_before
+    assert h.swarm.sim.membership_size == 16
