@@ -29,7 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..sim.engine import RoundInputs, SimConfig, SimState, route_and_tally
+from ..sim.engine import (
+    RoundInputs,
+    SimConfig,
+    SimState,
+    route_and_tally,
+    windowed_fd_phase,
+)
 
 NODES_AXIS = "nodes"
 
@@ -53,6 +59,8 @@ def state_shardings(mesh: Mesh) -> SimState:
         subjects=row,
         observers=rep,  # gathered by destination in the implicit pass
         fd_fail=row,
+        fd_hist=row,
+        fd_seen=row,
         alerted=row,
         reports=rep,
         seen_down=rep,
@@ -104,10 +112,17 @@ def _sharded_round(config: SimConfig, state: SimState, inputs: RoundInputs) -> S
     target_up = alive[subj]
     rand_drop = jax.random.uniform(probe_key, (local_rows, k)) < inputs.drop_prob[subj]
     probe_ok = target_up & ~inputs.probe_drop & ~rand_drop
-    fail_event = edge_live & observer_up & ~probe_ok
-    fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
+    probed = edge_live & observer_up
+    fail_event = probed & ~probe_ok
+    fd_fail, fd_hist, fd_seen = state.fd_fail, state.fd_hist, state.fd_seen
 
-    new_down = edge_live & observer_up & (fd_fail >= config.fd_threshold) & ~state.alerted
+    if config.fd_policy == "windowed":
+        fd_hist, fd_seen, new_down = windowed_fd_phase(
+            config, state, probed, fail_event
+        )
+    else:
+        fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
+        new_down = probed & (fd_fail >= config.fd_threshold) & ~state.alerted
     alerted = state.alerted | new_down
 
     # --- alert fan-out: local scatter + psum(OR) over ICI ------------------
@@ -132,6 +147,8 @@ def _sharded_round(config: SimConfig, state: SimState, inputs: RoundInputs) -> S
         subjects=subj,
         observers=state.observers,
         fd_fail=fd_fail,
+        fd_hist=fd_hist,
+        fd_seen=fd_seen,
         alerted=alerted,
         reports=reports,
         seen_down=seen_down,

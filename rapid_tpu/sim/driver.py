@@ -434,11 +434,14 @@ class Simulator:
             inputs = self._const_inputs(join_reports)
             n = min(batch, max_rounds - rounds_done)
             random_loss = bool((self._drop_prob > 0).any())
+            # the windowed FD policy's sliding history has no closed form;
+            # it runs on the general scan path
+            use_scan = random_loss or self.config.fd_policy == "windowed"
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
-                if random_loss:
-                    # per-round RNG: the general scan path
+                if use_scan:
+                    # per-round (possibly RNG-consuming) scan path
                     self.state = run_rounds_const(
-                        self.config, self.state, inputs, n, True
+                        self.config, self.state, inputs, n, random_loss
                     )
                 else:
                     # deterministic constant plane: one early-exiting dispatch

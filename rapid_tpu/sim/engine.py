@@ -55,6 +55,15 @@ class SimConfig:
     fd_interval_ms: int = 1000  # MembershipService.java:77
     batching_window_ms: int = 100  # MembershipService.java:75
     groups: int = 1  # delivery classes (heterogeneous broadcast delivery)
+    # Failure-detection policy. "cumulative" = the reference code's
+    # never-reset counter (PingPongFailureDetector.java:116-118, the parity
+    # default); "windowed" = the paper's policy (atc-2018 paper section 6):
+    # an edge is faulty when >= fd_window_threshold of its last fd_window
+    # probes failed, so recovered edges shed old evidence. Windowed runs on
+    # the scan path (no closed-form fast path).
+    fd_policy: str = "cumulative"
+    fd_window: int = 10
+    fd_window_threshold: float = 0.4
     # Fuse the probe/counter/alert elementwise phase into one Pallas kernel
     # (sim/pallas_kernels.py). "off" = stock jax; "tpu" = hardware kernel;
     # "interpret" = Pallas interpreter (CPU-testable).
@@ -72,6 +81,8 @@ class SimState:
     subjects: jax.Array  # int32[C, K] monitored node per ring
     observers: jax.Array  # int32[C, K] monitoring node per ring
     fd_fail: jax.Array  # int32[C, K] cumulative failed probes per edge
+    fd_hist: jax.Array  # uint16[C, K] last-W probe outcomes (windowed policy)
+    fd_seen: jax.Array  # int32[C, K] probes recorded, saturating at W
     alerted: jax.Array  # bool[C, K] edge already reported DOWN
     reports: jax.Array  # bool[G, C, K] per-group report tables (dst, ring)
     seen_down: jax.Array  # bool[G] group saw a DOWN alert this configuration
@@ -115,6 +126,8 @@ def initial_state(
         subjects=jnp.asarray(subjects),
         observers=jnp.asarray(observers),
         fd_fail=jnp.zeros((c, k), jnp.int32),
+        fd_hist=jnp.zeros((c, k), jnp.uint16),
+        fd_seen=jnp.zeros((c, k), jnp.int32),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         seen_down=jnp.zeros(g, bool),
@@ -232,6 +245,32 @@ def route_and_tally(
     return reports, seen_down, announced, proposal, decided, decided_group, decided_round
 
 
+def windowed_fd_phase(
+    config: SimConfig,
+    state: SimState,
+    probed: jax.Array,  # bool[., K] a probe was recorded on this edge
+    fail_event: jax.Array,  # bool[., K] the recorded probe failed
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The paper's FD policy (atc-2018 paper section 6): an edge is faulty
+    when >= fd_window_threshold of its last fd_window recorded probes failed,
+    once a full window has been recorded (the object-model
+    WindowedPingPongFailureDetector requires a full window too). Shared by
+    the single-device and sharded steps; the cumulative fd_fail counter is
+    not touched (windowed detection never reads it).
+
+    Returns (fd_hist, fd_seen, new_down)."""
+    assert config.fd_window <= 16, "window bitmask is uint16"
+    w = config.fd_window
+    t = int(np.ceil(config.fd_window_threshold * w))
+    mask = jnp.uint16((1 << w) - 1)
+    shifted = ((state.fd_hist << 1) | fail_event.astype(jnp.uint16)) & mask
+    fd_hist = jnp.where(probed, shifted, state.fd_hist)
+    fd_seen = jnp.where(probed, jnp.minimum(state.fd_seen + 1, w), state.fd_seen)
+    failed = jax.lax.population_count(fd_hist) >= t
+    new_down = probed & (fd_seen >= w) & failed & ~state.alerted
+    return fd_hist, fd_seen, new_down
+
+
 def step(config: SimConfig, state: SimState, inputs: RoundInputs,
          random_loss: bool = True) -> SimState:
     """One protocol round. Pure; jit/scan-friendly.
@@ -258,7 +297,15 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
         rand_drop = jnp.zeros((c, k), bool)
     probe_ok = target_up & ~inputs.probe_drop & ~rand_drop
 
-    if config.pallas_fd != "off":
+    fd_fail, fd_hist, fd_seen = state.fd_fail, state.fd_hist, state.fd_seen
+    if config.fd_policy == "windowed":
+        assert config.pallas_fd == "off", "windowed policy is stock-jax only"
+        probed = edge_live & observer_up
+        fd_hist, fd_seen, new_down = windowed_fd_phase(
+            config, state, probed, probed & ~probe_ok
+        )
+        alerted = state.alerted | new_down
+    elif config.pallas_fd != "off":
         from .pallas_kernels import fd_phase
 
         fd_fail, alerted, new_down = fd_phase(
@@ -306,6 +353,8 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
         subjects=state.subjects,
         observers=state.observers,
         fd_fail=fd_fail,
+        fd_hist=fd_hist,
+        fd_seen=fd_seen,
         alerted=alerted,
         reports=reports,
         seen_down=seen_down,
@@ -372,7 +421,12 @@ def run_until_decided_const(
     scanning ``step`` with ``random_loss=False`` over the same inputs, with
     one exception: ``rng_key`` is not advanced (this path draws no random
     numbers, whereas the scan path splits the key every round).
+
+    Cumulative FD policy only: the windowed policy's sliding history has no
+    closed form over carried-over state, so the driver routes it to the scan
+    path.
     """
+    assert config.fd_policy == "cumulative"
     c, k = config.capacity, config.k
     active = state.active
     alive = inputs.alive & active
@@ -507,6 +561,8 @@ def device_initial_state(
         subjects=subjects,
         observers=observers,
         fd_fail=jnp.zeros((c, k), jnp.int32),
+        fd_hist=jnp.zeros((c, k), jnp.uint16),
+        fd_seen=jnp.zeros((c, k), jnp.int32),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         seen_down=jnp.zeros(g, bool),

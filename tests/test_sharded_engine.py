@@ -83,3 +83,30 @@ def test_sharded_uneven_capacity_rejected(mesh):
     run = make_sharded_run(cfg, mesh, rounds=2)
     with pytest.raises(Exception):
         run(place_state(state, mesh), place_inputs(inputs, mesh))
+
+
+def test_sharded_windowed_fd_matches_single_device(mesh):
+    """The windowed FD policy produces identical cuts, rounds, and per-edge
+    window state on the mesh and on a single device."""
+    cfg = SimConfig(capacity=64, fd_policy="windowed")
+    vc = VirtualCluster.synthesize(64, cfg.k, seed=23)
+    active = np.ones(64, dtype=bool)
+    state = initial_state(cfg, vc, active, seed=23)
+    alive = active.copy()
+    alive[[9, 50]] = False
+    inputs = const_inputs(cfg, alive)
+
+    run = make_sharded_run(cfg, mesh, rounds=12)
+    sharded_out = run(place_state(state, mesh), place_inputs(inputs, mesh))
+    single_out = run_rounds_const(cfg, state, inputs, 12, False)
+
+    assert bool(sharded_out.decided) and bool(single_out.decided)
+    cut_sharded = set(np.flatnonzero(np.asarray(sharded_out.proposal)))
+    cut_single = set(np.flatnonzero(np.asarray(single_out.proposal)))
+    assert cut_sharded == cut_single == {9, 50}
+    np.testing.assert_array_equal(
+        np.asarray(sharded_out.fd_hist), np.asarray(single_out.fd_hist)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded_out.fd_seen), np.asarray(single_out.fd_seen)
+    )

@@ -338,3 +338,40 @@ def test_crashed_node_cannot_leave():
     sim.crash(np.array([6]))
     with pytest.raises(AssertionError):
         sim.leave(np.array([6]))
+
+
+def test_windowed_fd_stays_stable_under_flip_flop():
+    """The paper's windowed policy (40% of last 10): a 3-rounds-down /
+    7-rounds-up flip-flop never accumulates 4 failures in any window, so the
+    node is never cut -- while the reference code's cumulative counter
+    eventually crosses its threshold and cuts it. This is the stability
+    trade-off the two policies encode (paper section 6)."""
+    victims = np.array([5])
+
+    def run(policy):
+        config = SimConfig(capacity=24, fd_policy=policy)
+        sim = Simulator(24, config=config, seed=31)
+        decided = None
+        for _ in range(6):  # 6 cycles of 3 down + 7 up = 60 rounds
+            sim.crash(victims)
+            decided = decided or sim.run_until_decision(max_rounds=3, batch=3)
+            sim.revive(victims)
+            decided = decided or sim.run_until_decision(max_rounds=7, batch=7)
+            if decided:
+                break
+        return decided
+
+    assert run("windowed") is None  # windowed sheds the stale evidence
+    cumulative = run("cumulative")  # never-reset counter crosses 10 eventually
+    assert cumulative is not None and list(cumulative.cut) == [5]
+
+
+def test_windowed_fd_cuts_sustained_crash():
+    """A sustained crash is cut by the windowed policy once the window fills
+    (W=10 probes, all failed), with the same cut set as cumulative."""
+    config = SimConfig(capacity=32, fd_policy="windowed")
+    sim = Simulator(32, config=config, seed=32)
+    sim.crash(np.array([7, 19]))
+    rec = sim.run_until_decision(max_rounds=20, batch=10)
+    assert rec is not None and sorted(rec.cut) == [7, 19]
+    assert rec.virtual_time_ms == 10 * 1000 + 100  # window fills at round 10
