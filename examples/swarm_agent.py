@@ -70,7 +70,8 @@ def main() -> None:
     promise = builder.join_async(swarm.endpoint(0))
     scheduler.run_for(50)
     record = swarm.pump()
-    assert record is not None and scheduler.run_until(promise.done, 10_000)
+    joined = scheduler.run_until(promise.done, 10_000)
+    assert record is not None and joined
     cluster = promise.result(0)
     print(
         f"joined: {cluster.get_membership_size()} members, "
@@ -85,7 +86,8 @@ def main() -> None:
     print(f"crashing {n_crash} virtual nodes ...")
     swarm.sim.crash(victims)
     record = swarm.pump(max_rounds=16, batch=16)
-    assert record is not None and set(record.cut) == set(victims)
+    if record is None or set(record.cut) != set(victims):
+        raise RuntimeError(f"unexpected cut: {record}")
     scheduler.run_for(500)  # the real node tallies the swarm's votes
     print(
         f"cut decided in {record.virtual_time_ms} virtual ms; real node now "

@@ -17,6 +17,13 @@ small [C] masks are replicated. One round then is:
 
 The same step runs on an N-chip TPU mesh (ICI collectives) or a forced
 multi-device CPU mesh for validation.
+
+**Multi-host**: pass ``make_mesh(shape=(hosts, chips_per_host))`` to get a 2D
+``("dcn", "ici")`` mesh. Per-edge state row-shards over *both* axes and the
+single ``pmax`` reduction names both, which XLA decomposes into an intra-host
+reduction riding ICI followed by a cross-host exchange on DCN -- the
+hierarchy the scaling playbook prescribes, and the TPU-native equivalent of
+the reference's one-transport-fits-all gRPC fan-out (SURVEY.md §5.8).
 """
 
 from __future__ import annotations
@@ -40,8 +47,23 @@ from ..sim.engine import (
 NODES_AXIS = "nodes"
 
 
-def make_mesh(n_devices: int | None = None) -> Mesh:
+def make_mesh(
+    n_devices: int | None = None,
+    shape: Tuple[int, ...] | None = None,
+    axis_names: Tuple[str, ...] | None = None,
+) -> Mesh:
+    """A 1D ``("nodes",)`` mesh by default; pass ``shape=(hosts, chips)`` for
+    a 2D ``("dcn", "ici")`` multi-host layout (names overridable)."""
     devices = jax.devices()
+    if shape is not None:
+        assert n_devices is None, "pass either n_devices or shape, not both"
+        total = int(np.prod(shape))
+        assert total <= len(devices), (
+            f"mesh shape {shape} needs {total} devices, have {len(devices)}"
+        )
+        names = axis_names if axis_names is not None else ("dcn", "ici")
+        assert len(names) == len(shape)
+        return Mesh(np.array(devices[:total]).reshape(shape), names)
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (NODES_AXIS,))
@@ -49,8 +71,8 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 
 def state_shardings(mesh: Mesh) -> SimState:
     """The sharding pytree for SimState: per-edge arrays row-sharded by
-    observer, everything else replicated."""
-    row = NamedSharding(mesh, P(NODES_AXIS, None))
+    observer over every mesh axis, everything else replicated."""
+    row = NamedSharding(mesh, P(mesh.axis_names, None))
     rep = NamedSharding(mesh, P())
     return SimState(
         active=rep,
@@ -75,7 +97,7 @@ def state_shardings(mesh: Mesh) -> SimState:
 
 
 def input_shardings(mesh: Mesh) -> RoundInputs:
-    row = NamedSharding(mesh, P(NODES_AXIS, None))
+    row = NamedSharding(mesh, P(mesh.axis_names, None))
     rep = NamedSharding(mesh, P())
     return RoundInputs(alive=rep, probe_drop=row, drop_prob=rep,
                        join_reports=rep, down_reports=rep, deliver=rep)
@@ -89,13 +111,22 @@ def place_inputs(inputs: RoundInputs, mesh: Mesh) -> RoundInputs:
     return jax.tree_util.tree_map(jax.device_put, inputs, input_shardings(mesh))
 
 
-def _sharded_round(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimState:
+def _sharded_round(
+    config: SimConfig,
+    axes: Tuple[str, ...],
+    axis_sizes: Tuple[int, ...],
+    state: SimState,
+    inputs: RoundInputs,
+) -> SimState:
     """Body run inside shard_map: arrays arrive as per-shard blocks."""
     c, k = config.capacity, config.k
     halt = state.decided
 
-    # distinct randomness per shard
-    shard = jax.lax.axis_index(NODES_AXIS)
+    # linearized shard index over every mesh axis (row-major, matching the
+    # row sharding's block order); distinct randomness per shard
+    shard = jnp.int32(0)
+    for name, size in zip(axes, axis_sizes):
+        shard = shard * size + jax.lax.axis_index(name)
     key, probe_key = jax.random.split(state.rng_key)
     probe_key = jax.random.fold_in(probe_key, shard)
 
@@ -130,7 +161,9 @@ def _sharded_round(config: SimConfig, state: SimState, inputs: RoundInputs) -> S
     rows = subj.reshape(-1)
     cols = jnp.tile(jnp.arange(k, dtype=jnp.int32), local_rows)
     delta = delta.at[rows, cols].max(new_down.reshape(-1).astype(jnp.int32))
-    delta = jax.lax.pmax(delta, NODES_AXIS)
+    # on a ("dcn", "ici") mesh XLA splits this into an ICI reduction per host
+    # followed by the cross-host DCN exchange
+    delta = jax.lax.pmax(delta, axes)
     # dst-indexed DOWN alert arrivals [C, K]; down_reports are proactive
     # leave notifications (already dst-indexed, replicated)
     down_arrivals = (delta > 0) | (inputs.down_reports & active[:, None])
@@ -169,9 +202,11 @@ def make_sharded_run(config: SimConfig, mesh: Mesh, rounds: int):
     """Build the jitted multi-device round loop: scan of shard_map'd rounds."""
     state_specs = jax.tree_util.tree_map(lambda s: s.spec, state_shardings(mesh))
     input_specs = jax.tree_util.tree_map(lambda s: s.spec, input_shardings(mesh))
+    axes = tuple(mesh.axis_names)
+    axis_sizes = tuple(mesh.shape[name] for name in axes)
 
     body = jax.shard_map(
-        functools.partial(_sharded_round, config),
+        functools.partial(_sharded_round, config, axes, axis_sizes),
         mesh=mesh,
         in_specs=(state_specs, input_specs),
         out_specs=state_specs,

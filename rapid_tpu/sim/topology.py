@@ -249,6 +249,25 @@ def configuration_id_vectorized(
     return config_fold(id_high_h, id_low_h, host_h, port_h)
 
 
+_POWER_LADDER = np.ones(1, dtype=_U64)  # [37^0, 37^1, ...], grown on demand
+
+
+def _powers_of_37(m: int) -> np.ndarray:
+    """[37^0 .. 37^m] mod 2^64, served from a module-level ladder cache (the
+    fold runs on every view change; the ladder only depends on length)."""
+    global _POWER_LADDER
+    if len(_POWER_LADDER) <= m:
+        n = len(_POWER_LADDER)
+        grown = np.empty(m + 1, dtype=_U64)
+        grown[:n] = _POWER_LADDER
+        with np.errstate(over="ignore"):
+            grown[n:] = _POWER_LADDER[n - 1] * np.cumprod(
+                np.full(m + 1 - n, 37, dtype=_U64)
+            )
+        _POWER_LADDER = grown
+    return _POWER_LADDER[: m + 1]
+
+
 def config_fold(
     id_high_h: np.ndarray,
     id_low_h: np.ndarray,
@@ -270,10 +289,7 @@ def config_fold(
         eps[1::2] = port_h
         xs = np.concatenate([ids, eps])
         m = len(xs)
-        # pw[t] = 37^t mod 2^64 (uint64 cumprod wraps modulo 2^64)
-        pw = np.ones(m + 1, dtype=_U64)
-        if m:
-            pw[1:] = np.cumprod(np.full(m, 37, dtype=_U64))
+        pw = _powers_of_37(m)
         powers = pw[:m][::-1]  # [37^(m-1), ..., 37^0]
         # h = 1*37^m + sum x_j * 37^(m-1-j)
         total = pw[m] + (xs * powers).sum(dtype=_U64)

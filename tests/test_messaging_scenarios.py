@@ -1,0 +1,160 @@
+"""MessagingTest.java analogues: join-phase handling against large (1000-node)
+views and broadcaster fan-out at scale (MessagingTest.java:151-182,397-421).
+"""
+
+import random
+
+import pytest
+
+from rapid_tpu.cut_detector import MultiNodeCutDetector
+from rapid_tpu.membership import MembershipView
+from rapid_tpu.messaging.inprocess import (
+    InProcessClient,
+    InProcessNetwork,
+    InProcessServer,
+)
+from rapid_tpu.messaging.unicast import UnicastToAllBroadcaster
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.runtime.resources import SharedResources
+from rapid_tpu.runtime.scheduler import VirtualScheduler
+from rapid_tpu.service import MembershipService
+from rapid_tpu.settings import Settings
+from rapid_tpu.types import (
+    Endpoint,
+    JoinResponse,
+    JoinStatusCode,
+    NodeId,
+    PreJoinMessage,
+    ProbeMessage,
+    Response,
+)
+
+K, H, L = 10, 9, 4
+
+
+def ep(i: int) -> Endpoint:
+    return Endpoint.from_parts("127.0.0.1", 2000 + i)
+
+
+def large_view(n: int, seed: int = 1) -> MembershipView:
+    rng = random.Random(seed)
+    view = MembershipView(K)
+    for i in range(n):
+        view.ring_add(ep(i), NodeId.random(rng))
+    return view
+
+
+@pytest.fixture
+def service_on_large_view():
+    scheduler = VirtualScheduler()
+    network = InProcessNetwork(scheduler)
+    view = large_view(1000)
+    addr = ep(0)
+    resources = SharedResources(scheduler, name="large-view")
+    service = MembershipService(
+        addr,
+        MultiNodeCutDetector(K, H, L),
+        view,
+        resources,
+        Settings(),
+        InProcessClient(addr, network),
+        StaticFailureDetectorFactory(set()),
+        rng=random.Random(0),
+    )
+    yield scheduler, view, service
+    service.shutdown()
+    resources.shutdown()
+
+
+def test_join_phase1_against_1000_node_view(service_on_large_view):
+    """MessagingTest.java:151-182: a pre-join against a 1000-node view answers
+    SAFE_TO_JOIN with the correct configuration id and the joiner's K
+    expected observers."""
+    scheduler, view, service = service_on_large_view
+    joiner = Endpoint.from_parts("127.0.0.1", 9999)
+    promise = service.handle_message(
+        PreJoinMessage(sender=joiner, node_id=NodeId.random(random.Random(42)))
+    )
+    scheduler.run_for(10)
+    response = promise.result(0)
+    assert isinstance(response, JoinResponse)
+    assert response.status_code == JoinStatusCode.SAFE_TO_JOIN
+    assert response.configuration_id == view.get_current_configuration_id()
+    assert len(response.endpoints) == K
+    assert list(response.endpoints) == view.get_expected_observers_of(joiner)
+
+
+def test_join_phase1_rejects_present_hostname(service_on_large_view):
+    """A pre-join from an endpoint already in the 1000-node ring answers
+    HOSTNAME_ALREADY_IN_RING (with observers, for the retry path)."""
+    scheduler, view, service = service_on_large_view
+    promise = service.handle_message(
+        PreJoinMessage(sender=ep(500), node_id=NodeId.random(random.Random(43)))
+    )
+    scheduler.run_for(10)
+    response = promise.result(0)
+    assert response.status_code == JoinStatusCode.HOSTNAME_ALREADY_IN_RING
+    assert len(response.endpoints) == K
+
+
+def test_join_phase1_rejects_seen_identifier(service_on_large_view):
+    """UUID reuse across the seam answers UUID_ALREADY_IN_RING
+    (MembershipView.java:101-116)."""
+    scheduler, view, service = service_on_large_view
+    # rebuild one of the admitted identifiers
+    reused = view.get_configuration().node_ids[17]
+    promise = service.handle_message(
+        PreJoinMessage(sender=Endpoint.from_parts("10.9.9.9", 1), node_id=reused)
+    )
+    scheduler.run_for(10)
+    assert promise.result(0).status_code == JoinStatusCode.UUID_ALREADY_IN_RING
+
+
+def test_broadcaster_fanout_100_members():
+    """MessagingTest.java:397-421: unicast-to-all reaches every one of 100
+    registered members exactly once, in a per-configuration shuffled order."""
+    scheduler = VirtualScheduler()
+    network = InProcessNetwork(scheduler)
+    received = {ep(i): 0 for i in range(100)}
+
+    class CountingServer(InProcessServer):
+        def handle(self, msg):
+            received[self.address] += 1
+            from rapid_tpu.runtime.futures import Promise
+
+            return Promise.completed(Response())
+
+    for i in range(100):
+        CountingServer(ep(i), network).start()
+
+    sender = InProcessClient(ep(0), network)
+    caster = UnicastToAllBroadcaster(sender, rng=random.Random(1))
+    caster.set_membership([ep(i) for i in range(100)])
+    promises = caster.broadcast(ProbeMessage(sender=ep(0)))
+    assert len(promises) == 100
+    scheduler.run_for(10)
+    assert all(count == 1 for count in received.values())
+
+    # shuffled per configuration: two broadcasters with different rngs send
+    # in different orders over the same membership
+    order_a, order_b = [], []
+    ca = UnicastToAllBroadcaster(_RecordingClient(order_a), rng=random.Random(2))
+    cb = UnicastToAllBroadcaster(_RecordingClient(order_b), rng=random.Random(3))
+    members = [ep(i) for i in range(100)]
+    ca.set_membership(members)
+    cb.set_membership(members)
+    ca.broadcast(ProbeMessage(sender=ep(0)))
+    cb.broadcast(ProbeMessage(sender=ep(0)))
+    assert sorted(order_a) == sorted(order_b) == sorted(members)
+    assert order_a != order_b
+
+
+class _RecordingClient:
+    def __init__(self, log):
+        self._log = log
+
+    def send_message_best_effort(self, remote, msg):
+        from rapid_tpu.runtime.futures import Promise
+
+        self._log.append(remote)
+        return Promise.completed(Response())

@@ -110,3 +110,27 @@ def test_sharded_windowed_fd_matches_single_device(mesh):
     np.testing.assert_array_equal(
         np.asarray(sharded_out.fd_seen), np.asarray(single_out.fd_seen)
     )
+
+
+def test_2d_dcn_ici_mesh_matches_single_device():
+    """A (hosts, chips) 2D mesh -- per-edge state row-sharded over both axes,
+    alert reduction over ("dcn", "ici") -- produces the same decision as a
+    single device (the multi-host layout, validated on 2x4 CPU devices)."""
+    mesh2d = make_mesh(shape=(2, 4))
+    assert mesh2d.axis_names == ("dcn", "ici")
+    cfg, vc, active, state = build(c=64, seed=29)
+    alive = active.copy()
+    alive[[7, 33]] = False
+    inputs = const_inputs(cfg, alive)
+
+    run = make_sharded_run(cfg, mesh2d, rounds=12)
+    sharded_out = run(place_state(state, mesh2d), place_inputs(inputs, mesh2d))
+    single_out = run_rounds_const(cfg, state, inputs, 12, False)
+
+    assert bool(sharded_out.decided) and bool(single_out.decided)
+    cut_sharded = set(np.flatnonzero(np.asarray(sharded_out.proposal)))
+    assert cut_sharded == {7, 33}
+    assert int(sharded_out.decided_round) == int(single_out.decided_round)
+    np.testing.assert_array_equal(
+        np.asarray(sharded_out.fd_fail), np.asarray(single_out.fd_fail)
+    )
