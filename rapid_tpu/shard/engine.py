@@ -61,8 +61,12 @@ def make_mesh(
         assert total <= len(devices), (
             f"mesh shape {shape} needs {total} devices, have {len(devices)}"
         )
-        names = axis_names if axis_names is not None else ("dcn", "ici")
-        assert len(names) == len(shape)
+        if axis_names is None:
+            assert len(shape) <= 2, "pass axis_names for meshes beyond 2D"
+            names = ("dcn", "ici")[-len(shape):]
+        else:
+            names = axis_names
+        assert len(names) == len(shape), f"{len(shape)} axes need {len(shape)} names"
         return Mesh(np.array(devices[:total]).reshape(shape), names)
     if n_devices is not None:
         devices = devices[:n_devices]

@@ -63,11 +63,19 @@ class Simulator:
         capacity: Optional[int] = None,
         config: Optional[SimConfig] = None,
         seed: int = 0,
+        mesh=None,
     ) -> None:
+        """``mesh``: a jax.sharding.Mesh (from shard.engine.make_mesh) to run
+        the round loop sharded over multiple devices -- per-edge state
+        row-sharded over every mesh axis, alert fan-out as a psum over
+        ICI/DCN. The whole fault/join/leave API and view-change machinery is
+        identical in both modes; sharded dispatches use the scan path (the
+        early-exit closed form is single-device)."""
         capacity = capacity if capacity is not None else n_nodes
         assert n_nodes <= capacity
         self.config = config if config is not None else SimConfig(capacity=capacity)
         assert self.config.capacity == capacity
+        self.mesh = mesh
         self.cluster = VirtualCluster.synthesize(capacity, self.config.k, seed=seed)
         self.active = np.zeros(capacity, dtype=bool)
         self.active[:n_nodes] = True
@@ -96,6 +104,7 @@ class Simulator:
         Shared by __init__ and from_configuration so restored simulators can
         never silently diverge from freshly-constructed ones."""
         capacity = self.config.capacity
+        self._sharded_runs: dict = {}
         self._init_device_caches()
         self.state = self._fresh_state(self.seed)
         self._billed_rounds = 0  # rounds of this configuration already billed
@@ -119,17 +128,38 @@ class Simulator:
         self._sorted_identifiers()
         self._seen_id_hashes()
 
+    def _rep(self, arr) -> jax.Array:
+        """Place as replicated over the mesh (or the default device)."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def _row(self, arr) -> jax.Array:
+        """Place row-sharded over every mesh axis (observer-sharded [C, K])."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, P(self.mesh.axis_names, None))
+        )
+
     def _init_device_caches(self) -> None:
         """Device-resident constants allocated once per simulator: the signed
         ring keys (so adjacency rebuilds never re-upload them) and the
         all-clear fault-plane arrays (so quiet rounds transfer nothing but
-        the [C] liveness mask)."""
+        the [C] liveness mask). In mesh mode every fault-plane array is placed
+        under its dispatch sharding at creation, so dispatches never reshard."""
         c, k, g = self.config.capacity, self.config.k, self.config.groups
         self._ring_rank_dev = jnp.asarray(self.cluster.ring_rank())
         self._ring_rank_dirty = False
-        self._zero_ck = jnp.zeros((c, k), bool)
-        self._zero_drop_prob = jnp.zeros(c, jnp.float32)
-        self._ones_deliver = jnp.ones((g, c), bool)
+        zeros_ck = np.zeros((c, k), bool)
+        self._zero_ck_row = self._row(zeros_ck)  # probe_drop role
+        self._zero_ck = self._rep(zeros_ck)  # join/down report roles
+        self._zero_drop_prob = self._rep(np.zeros(c, np.float32))
+        self._ones_deliver = self._rep(np.ones((g, c), bool))
         self._alive_dev: Optional[jax.Array] = None
         self._probe_drop_dev: Optional[jax.Array] = None
         self._subjects_host: Optional[np.ndarray] = None
@@ -149,7 +179,7 @@ class Simulator:
         self._alive_dev = None
         self._probe_drop_dev = None  # partition set maps onto new adjacency
         self._down_reports_dev = None  # leave alerts map onto new adjacency
-        return device_initial_state(
+        state = device_initial_state(
             self.config,
             self._ring_rank_dev,
             jnp.asarray(self.active),
@@ -157,6 +187,11 @@ class Simulator:
             jnp.asarray(self.group_of),
             jax.random.PRNGKey(seed),
         )
+        if self.mesh is not None:
+            from ..shard.engine import place_state
+
+            state = place_state(state, self.mesh)
+        return state
 
     # ------------------------------------------------------------------ #
     # Fault injection (BASELINE.json configs)
@@ -167,14 +202,14 @@ class Simulator:
         self.alive[np.atleast_1d(node_ids)] = False
         # enqueue the liveness transfer now (async) so the decision loop's
         # dispatch never waits on a host->device round trip for it
-        self._alive_dev = jnp.asarray(self.alive)
+        self._alive_dev = self._rep(self.alive)
 
     def revive(self, node_ids: np.ndarray) -> None:
         """Flip-flop support: nodes become reachable again (cumulative FD
         counters are deliberately NOT reset -- PingPongFailureDetector.java:116-118)."""
         node_ids = np.atleast_1d(node_ids)
         self.alive[node_ids] = self.active[node_ids]
-        self._alive_dev = jnp.asarray(self.alive)
+        self._alive_dev = self._rep(self.alive)
 
     def leave(self, node_ids: np.ndarray) -> None:
         """Graceful leave: each leaver proactively notifies its K observers,
@@ -306,30 +341,30 @@ class Simulator:
                 leavers = sorted(self._pending_leavers)
                 obs = self._observers_host[leavers]  # [L, K]
                 mask[leavers] |= self.alive[obs] & self.active[obs]
-            self._down_reports_dev = jnp.asarray(mask)
+            self._down_reports_dev = self._rep(mask)
         return self._down_reports_dev
 
     def _const_inputs(self, join_reports: Optional[np.ndarray]) -> RoundInputs:
         """This dispatch's fault plane, reusing the device-resident all-clear
         arrays whenever a fault class is inactive."""
         if self._alive_dev is None:
-            self._alive_dev = jnp.asarray(self.alive)
+            self._alive_dev = self._rep(self.alive)
         if self._ingress_partitioned and self._probe_drop_dev is None:
-            self._probe_drop_dev = jnp.asarray(self._probe_drop_mask())
+            self._probe_drop_dev = self._row(self._probe_drop_mask())
         return RoundInputs(
             alive=self._alive_dev,
             probe_drop=(
                 self._probe_drop_dev
                 if self._ingress_partitioned
-                else self._zero_ck
+                else self._zero_ck_row
             ),
             drop_prob=(
-                jnp.asarray(self._drop_prob)
+                self._rep(self._drop_prob)
                 if (self._drop_prob > 0).any()
                 else self._zero_drop_prob
             ),
             join_reports=(
-                self._zero_ck if join_reports is None else jnp.asarray(join_reports)
+                self._zero_ck if join_reports is None else self._rep(join_reports)
             ),
             down_reports=(
                 self._down_reports() if self._has_down_reports() else self._zero_ck
@@ -337,7 +372,7 @@ class Simulator:
             deliver=(
                 self._ones_deliver
                 if self._deliver.all()
-                else jnp.asarray(self._deliver)
+                else self._rep(self._deliver)
             ),
         )
 
@@ -438,7 +473,10 @@ class Simulator:
             # it runs on the general scan path
             use_scan = random_loss or self.config.fd_policy == "windowed"
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
-                if use_scan:
+                if self.mesh is not None:
+                    # inputs are already placed under their dispatch shardings
+                    self.state = self._sharded_run(n)(self.state, inputs)
+                elif use_scan:
                     # per-round (possibly RNG-consuming) scan path
                     self.state = run_rounds_const(
                         self.config, self.state, inputs, n, random_loss
@@ -485,6 +523,16 @@ class Simulator:
         self.virtual_ms += rounds_done * self.config.fd_interval_ms
         self._billed_rounds += rounds_done
         return None
+
+    def _sharded_run(self, rounds: int):
+        """The jitted mesh round loop, cached per dispatch length."""
+        if rounds not in self._sharded_runs:
+            from ..shard.engine import make_sharded_run
+
+            self._sharded_runs[rounds] = make_sharded_run(
+                self.config, self.mesh, rounds
+            )
+        return self._sharded_runs[rounds]
 
     def _classic_round_winner(
         self, announced: np.ndarray, proposals: np.ndarray
@@ -683,7 +731,7 @@ class Simulator:
         )
 
     @staticmethod
-    def from_configuration(path: str) -> "Simulator":
+    def from_configuration(path: str, mesh=None) -> "Simulator":
         """Rebuild a simulator from a configuration snapshot; the
         configuration id of the restored instance equals the saved one."""
         with np.load(path) as data:
@@ -698,6 +746,7 @@ class Simulator:
             )
             sim = Simulator.__new__(Simulator)
             sim.config = config
+            sim.mesh = mesh
             sim.cluster = VirtualCluster(
                 hostnames=data["hostnames"],
                 host_lengths=data["host_lengths"],

@@ -134,3 +134,8 @@ def test_2d_dcn_ici_mesh_matches_single_device():
     np.testing.assert_array_equal(
         np.asarray(sharded_out.fd_fail), np.asarray(single_out.fd_fail)
     )
+
+
+def test_make_mesh_1d_shape_names_ici():
+    m = make_mesh(shape=(8,))
+    assert m.axis_names == ("ici",)
