@@ -119,6 +119,7 @@ def _sharded_round(
     config: SimConfig,
     axes: Tuple[str, ...],
     axis_sizes: Tuple[int, ...],
+    random_loss: bool,
     state: SimState,
     inputs: RoundInputs,
 ) -> SimState:
@@ -145,7 +146,14 @@ def _sharded_round(
     edge_live = active[my_ids][:, None] & active[subj]
     observer_up = alive[my_ids][:, None]
     target_up = alive[subj]
-    rand_drop = jax.random.uniform(probe_key, (local_rows, k)) < inputs.drop_prob[subj]
+    if random_loss:
+        rand_drop = (
+            jax.random.uniform(probe_key, (local_rows, k)) < inputs.drop_prob[subj]
+        )
+    else:
+        # statically elide the per-edge threefry draw when no lossy ingress
+        # is active (mirrors the single-device step's random_loss flag)
+        rand_drop = jnp.zeros((local_rows, k), bool)
     probe_ok = target_up & ~inputs.probe_drop & ~rand_drop
     probed = edge_live & observer_up
     fail_event = probed & ~probe_ok
@@ -202,15 +210,21 @@ def _sharded_round(
     )
 
 
-def make_sharded_run(config: SimConfig, mesh: Mesh, rounds: int):
+def make_sharded_run(
+    config: SimConfig, mesh: Mesh, rounds: int, random_loss: bool = True
+):
     """Build the jitted multi-device round loop: scan of shard_map'd rounds."""
+    n_dev = int(np.prod([mesh.shape[name] for name in mesh.axis_names]))
+    assert config.capacity % n_dev == 0, (
+        f"capacity {config.capacity} must divide evenly over {n_dev} devices"
+    )
     state_specs = jax.tree_util.tree_map(lambda s: s.spec, state_shardings(mesh))
     input_specs = jax.tree_util.tree_map(lambda s: s.spec, input_shardings(mesh))
     axes = tuple(mesh.axis_names)
     axis_sizes = tuple(mesh.shape[name] for name in axes)
 
     body = jax.shard_map(
-        functools.partial(_sharded_round, config, axes, axis_sizes),
+        functools.partial(_sharded_round, config, axes, axis_sizes, random_loss),
         mesh=mesh,
         in_specs=(state_specs, input_specs),
         out_specs=state_specs,

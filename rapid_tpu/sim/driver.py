@@ -75,6 +75,12 @@ class Simulator:
         assert n_nodes <= capacity
         self.config = config if config is not None else SimConfig(capacity=capacity)
         assert self.config.capacity == capacity
+        if mesh is not None:
+            n_dev = int(np.prod(list(mesh.shape.values())))
+            assert capacity % n_dev == 0, (
+                f"capacity {capacity} must divide evenly over the mesh's "
+                f"{n_dev} devices (row-sharded per-edge state)"
+            )
         self.mesh = mesh
         self.cluster = VirtualCluster.synthesize(capacity, self.config.k, seed=seed)
         self.active = np.zeros(capacity, dtype=bool)
@@ -307,7 +313,7 @@ class Simulator:
         assert group_of.max(initial=0) < self.config.groups
         self.group_of = group_of
         self.state = dataclasses.replace(
-            self.state, group_of=jnp.asarray(group_of)
+            self.state, group_of=self._rep(group_of)
         )
 
     def drop_broadcasts(self, receiver_group: int, sender_nodes: np.ndarray) -> None:
@@ -413,7 +419,7 @@ class Simulator:
             obs_ids, obs_alive = self._expected_observers(node)
             join_reports[node, :] = obs_alive
             observers[node, :] = obs_ids
-        self.state = dataclasses.replace(self.state, observers=jnp.asarray(observers))
+        self.state = dataclasses.replace(self.state, observers=self._rep(observers))
         return join_reports
 
     def expected_observers(self, node: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -475,7 +481,9 @@ class Simulator:
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
                 if self.mesh is not None:
                     # inputs are already placed under their dispatch shardings
-                    self.state = self._sharded_run(n)(self.state, inputs)
+                    self.state = self._sharded_run(n, random_loss)(
+                        self.state, inputs
+                    )
                 elif use_scan:
                     # per-round (possibly RNG-consuming) scan path
                     self.state = run_rounds_const(
@@ -524,15 +532,16 @@ class Simulator:
         self._billed_rounds += rounds_done
         return None
 
-    def _sharded_run(self, rounds: int):
-        """The jitted mesh round loop, cached per dispatch length."""
-        if rounds not in self._sharded_runs:
+    def _sharded_run(self, rounds: int, random_loss: bool):
+        """The jitted mesh round loop, cached per (length, loss-model)."""
+        key = (rounds, random_loss)
+        if key not in self._sharded_runs:
             from ..shard.engine import make_sharded_run
 
-            self._sharded_runs[rounds] = make_sharded_run(
-                self.config, self.mesh, rounds
+            self._sharded_runs[key] = make_sharded_run(
+                self.config, self.mesh, rounds, random_loss
             )
-        return self._sharded_runs[rounds]
+        return self._sharded_runs[key]
 
     def _classic_round_winner(
         self, announced: np.ndarray, proposals: np.ndarray
@@ -688,7 +697,10 @@ class Simulator:
         """Block until construction/rebuild work has drained from the device
         queue -- separates setup cost from measured protocol time."""
         jax.block_until_ready(jax.tree_util.tree_leaves(self.state))
-        jax.block_until_ready((self._zero_ck, self._ones_deliver))
+        jax.block_until_ready(
+            (self._zero_ck, self._zero_ck_row, self._zero_drop_prob,
+             self._ones_deliver)
+        )
         return self
 
     @property
