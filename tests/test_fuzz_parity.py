@@ -111,3 +111,78 @@ def test_fuzzed_schedule_deterministic():
     script, history_a = generate_and_run(5)
     _, history_b = generate_and_run(5, script=script)
     assert history_a == history_b
+
+
+# --------------------------------------------------------------------------- #
+# Cross-plane fuzzing: protocol plane (full object-model cluster with real
+# message passing on virtual time) vs the TPU sim plane, same schedule.
+# --------------------------------------------------------------------------- #
+
+def run_cross_plane_schedule(fuzz_seed: int, n_start: int = 10, steps: int = 5):
+    """Apply one randomized membership schedule to both planes; after every
+    converged step the set of member *indices* must be identical."""
+    from harness import BASE_PORT, ClusterHarness
+
+    rng = random.Random(fuzz_seed * 104729)
+    capacity = n_start + steps  # at most one join per step
+
+    harness = ClusterHarness(seed=fuzz_seed)
+    harness.create_cluster(n_start, parallel=False)
+    harness.wait_and_verify_agreement(n_start)
+    sim = Simulator(n_start, capacity=capacity, seed=fuzz_seed)
+
+    members = set(range(n_start))  # indices alive in both planes
+    next_join = n_start
+    schedule = []
+    for _ in range(steps):
+        choices = []
+        if len(members) > 4:
+            choices += ["crash", "leave"]
+        if next_join < capacity:
+            choices.append("join")
+        kind = rng.choice(choices)
+        if kind == "crash":
+            victims = rng.sample(sorted(members), k=min(2, len(members) - 3))
+            schedule.append(("crash", victims))
+            harness.fail_nodes([harness.addr(i) for i in victims])
+            sim.crash(np.array(victims, dtype=int))
+            members -= set(victims)
+        elif kind == "leave":
+            leaver = rng.choice(sorted(members))
+            schedule.append(("leave", [leaver]))
+            instance = harness.instances.pop(harness.addr(leaver))
+            done = instance.leave_gracefully_async()
+            assert harness.scheduler.run_until(done.done, timeout_ms=120_000)
+            sim.leave(np.array([leaver]))
+            members -= {leaver}
+        else:
+            joiner = next_join
+            next_join += 1
+            schedule.append(("join", [joiner]))
+            harness.join(joiner, seed_index=min(members))
+            sim.request_joins(np.array([joiner]))
+            members |= {joiner}
+
+        harness.wait_and_verify_agreement(len(members))
+        deadline = 8
+        while sim.membership_size != len(members) and deadline > 0:
+            sim.run_until_decision(max_rounds=16, batch=16)
+            deadline -= 1
+
+        protocol_members = {
+            int(ep.port) - BASE_PORT for ep in
+            next(iter(harness.instances.values())).get_memberlist()
+        }
+        sim_members = {int(i) for i in sim.members()}
+        assert protocol_members == sim_members == members, (
+            f"divergence after {schedule}: protocol={sorted(protocol_members)} "
+            f"sim={sorted(sim_members)} expected={sorted(members)}"
+        )
+    harness.shutdown()
+    return schedule
+
+
+@pytest.mark.parametrize("fuzz_seed", [11, 12])
+def test_cross_plane_fuzzed_schedule(fuzz_seed):
+    schedule = run_cross_plane_schedule(fuzz_seed)
+    assert schedule
