@@ -128,6 +128,17 @@ def build_adjacency(
     return subjects, observers
 
 
+def config_fold(xs: np.ndarray) -> Optional[int]:
+    """Chained configuration-id fold h=1; h=h*37+x (mod 2^64) over the
+    already-interleaved element hashes; returns the Java-signed value."""
+    lib = load()
+    if lib is None:
+        return None
+    xs = np.ascontiguousarray(xs, dtype=np.uint64)
+    total = lib.rapid_config_fold(xs, xs.shape[0])
+    return int(np.uint64(total).astype(np.int64))
+
+
 if __name__ == "__main__":
     path = build()
     print(f"built {path}")

@@ -288,6 +288,11 @@ def config_fold(
         eps[0::2] = host_h
         eps[1::2] = port_h
         xs = np.concatenate([ids, eps])
+        from .. import native
+
+        native_total = native.config_fold(xs)
+        if native_total is not None:
+            return native_total
         m = len(xs)
         pw = _powers_of_37(m)
         powers = pw[:m][::-1]  # [37^(m-1), ..., 37^0]

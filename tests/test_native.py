@@ -84,3 +84,20 @@ def test_config_fold_matches_python():
     for x in xs:
         h = (h * 37 + int(x)) & (2**64 - 1)
     assert int(lib.rapid_config_fold(xs, len(xs))) == h
+
+
+def test_native_config_fold_matches_numpy():
+    """The C fold and the vectorized power-ladder formula agree bit-exactly."""
+    from rapid_tpu import native
+    from rapid_tpu.sim.topology import _powers_of_37
+
+    rng = np.random.default_rng(3)
+    for m in (0, 1, 7, 1000):
+        xs = rng.integers(0, 2**64, size=m, dtype=np.uint64)
+        got = native.config_fold(xs)
+        with np.errstate(over="ignore"):
+            pw = _powers_of_37(m)
+            want = int(
+                (pw[m] + (xs * pw[:m][::-1]).sum(dtype=np.uint64)).astype(np.int64)
+            )
+        assert got == want
