@@ -145,6 +145,13 @@ def _sharded_round(
     # --- probes over local observer edges ---------------------------------
     edge_live = active[my_ids][:, None] & active[subj]
     observer_up = alive[my_ids][:, None]
+    if config.rounds_per_interval > 1:
+        from ..sim.engine import probe_phases
+
+        my_turn = probe_phases(config)[my_ids] == (
+            state.round % config.rounds_per_interval
+        )
+        observer_up = observer_up & my_turn[:, None]
     target_up = alive[subj]
     if random_loss:
         rand_drop = (

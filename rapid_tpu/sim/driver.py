@@ -75,6 +75,9 @@ class Simulator:
         assert n_nodes <= capacity
         self.config = config if config is not None else SimConfig(capacity=capacity)
         assert self.config.capacity == capacity
+        assert self.config.fd_interval_ms % self.config.rounds_per_interval == 0, (
+            "fd_interval_ms must divide evenly into sub-interval rounds"
+        )
         if mesh is not None:
             n_dev = int(np.prod(list(mesh.shape.values())))
             assert capacity % n_dev == 0, (
@@ -528,9 +531,15 @@ class Simulator:
                         )
                         record.via_classic_round = True
                         return record
-        self.virtual_ms += rounds_done * self.config.fd_interval_ms
+        self.virtual_ms += rounds_done * self._round_ms
         self._billed_rounds += rounds_done
         return None
+
+    @property
+    def _round_ms(self) -> int:
+        """Protocol time per engine round (a whole FD interval, or a fraction
+        of one under the staggered-phase asynchrony model)."""
+        return self.config.fd_interval_ms // self.config.rounds_per_interval
 
     def _sharded_run(self, rounds: int, random_loss: bool):
         """The jitted mesh round loop, cached per (length, loss-model)."""
@@ -621,7 +630,7 @@ class Simulator:
         # plus the batching window before the deciding broadcast
         unbilled = decided_round - self._billed_rounds
         self.virtual_ms += (
-            unbilled * self.config.fd_interval_ms + self.config.batching_window_ms
+            unbilled * self._round_ms + self.config.batching_window_ms
         )
         self._billed_rounds = 0
         record = ViewChangeRecord(

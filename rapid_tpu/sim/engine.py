@@ -54,6 +54,13 @@ class SimConfig:
     fd_threshold: int = 10  # PingPongFailureDetector.FAILURE_THRESHOLD
     fd_interval_ms: int = 1000  # MembershipService.java:77
     batching_window_ms: int = 100  # MembershipService.java:75
+    # Asynchrony model (SURVEY.md §7.4): with rounds_per_interval > 1 a round
+    # is a fraction of the FD interval and each node probes only in its own
+    # phase of the interval (a fixed pseudo-random offset) -- alerts from
+    # different observers then arrive staggered across the batching timeline
+    # instead of quantized to whole intervals, exercising the H/L flux window
+    # in time. 1 = the reference-aligned synchronous model.
+    rounds_per_interval: int = 1
     groups: int = 1  # delivery classes (heterogeneous broadcast delivery)
     # Failure-detection policy. "cumulative" = the reference code's
     # never-reset counter (PingPongFailureDetector.java:116-118, the parity
@@ -245,6 +252,16 @@ def route_and_tally(
     return reports, seen_down, announced, proposal, decided, decided_group, decided_round
 
 
+def probe_phases(config: SimConfig) -> jnp.ndarray:
+    """Each node's fixed probe phase within the FD interval ([C] int32 in
+    [0, rounds_per_interval)): a Knuth multiplicative hash of the node index,
+    so phases are deterministic, seed-free, and identical across the scan,
+    closed-form, and sharded lowerings."""
+    rpi = config.rounds_per_interval
+    idx = jnp.arange(config.capacity, dtype=jnp.uint32)
+    return ((idx * jnp.uint32(2654435761)) % jnp.uint32(rpi)).astype(jnp.int32)
+
+
 def windowed_fd_phase(
     config: SimConfig,
     state: SimState,
@@ -296,6 +313,14 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
     else:
         rand_drop = jnp.zeros((c, k), bool)
     probe_ok = target_up & ~inputs.probe_drop & ~rand_drop
+
+    if config.rounds_per_interval > 1:
+        # staggered FD phases: a node probes only in its own sub-interval
+        # round (0-based round t probes nodes with phase == t mod rpi)
+        my_turn = probe_phases(config) == (
+            state.round % config.rounds_per_interval
+        )
+        observer_up = observer_up & my_turn[:, None]
 
     fd_fail, fd_hist, fd_seen = state.fd_fail, state.fd_hist, state.fd_seen
     if config.fd_policy == "windowed":
@@ -440,9 +465,18 @@ def run_until_decided_const(
     # Round (1-based within this dispatch) at which each observer-indexed edge
     # crosses the cumulative threshold; never fires here otherwise. An edge
     # already at/over threshold but unalerted fires on the next failed probe.
+    # With staggered phases an observer probes only at relative rounds
+    # p_rel+1, p_rel+1+rpi, ... where p_rel re-bases its fixed phase onto this
+    # dispatch's starting round.
     never = jnp.int32(0x7FFFFFFF)
+    rpi = config.rounds_per_interval
     rem = jnp.maximum(config.fd_threshold - state.fd_fail, 1)
-    fire = jnp.where(fail_event & ~state.alerted, rem, never)
+    if rpi > 1:
+        p_rel = (probe_phases(config) - state.round) % rpi  # [C]
+        fire_round = p_rel[:, None] + 1 + (rem - 1) * rpi
+    else:
+        fire_round = rem
+    fire = jnp.where(fail_event & ~state.alerted, fire_round, never)
     cols = jnp.arange(k, dtype=jnp.int32)[None, :]
     # dst-indexed arrival round (see the gather-not-scatter note in ``step``).
     # Proactive DOWN reports (graceful leave) arrive in the first round; the
@@ -507,8 +541,13 @@ def run_until_decided_const(
     final, r_exec = jax.lax.while_loop(
         cond, body, (state, start)
     )
-    # Reconstruct the per-edge FD state the executed rounds produced.
-    fd_fail = state.fd_fail + r_exec * fail_event.astype(jnp.int32)
+    # Reconstruct the per-edge FD state the executed rounds produced (number
+    # of scheduled probes within [1, r_exec] per observer).
+    if rpi > 1:
+        probes = jnp.maximum(0, (r_exec - 1 - p_rel) // rpi + 1)[:, None]
+    else:
+        probes = r_exec
+    fd_fail = state.fd_fail + probes * fail_event.astype(jnp.int32)
     alerted = state.alerted | (fire <= r_exec)
     return dataclasses.replace(final, fd_fail=fd_fail, alerted=alerted)
 

@@ -184,3 +184,35 @@ def test_leave_and_crash_combined_match_scan_path():
     )
     scan, fast = _run_both(config, sim.state, inputs, 10)
     _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 10))
+
+
+def test_staggered_phases_match_scan_path():
+    """rounds_per_interval > 1: the closed-form probe schedule (phase-offset
+    arithmetic) must be bit-identical to scanning the phase-gated step."""
+    config = SimConfig(capacity=32, k=5, h=4, l=2, fd_threshold=4,
+                       rounds_per_interval=4)
+    sim = Simulator(32, config=config, seed=17)
+    sim.crash(np.array([6, 21]))
+    inputs = const_inputs(config, sim.alive)
+    scan, fast = _run_both(config, sim.state, inputs, 24)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 24))
+
+
+def test_staggered_phases_multi_dispatch_resume():
+    """Dispatch boundaries at arbitrary rounds: the phase re-basing onto the
+    dispatch's starting round must keep the probe schedule aligned."""
+    config = SimConfig(capacity=24, k=5, h=4, l=2, fd_threshold=3,
+                       rounds_per_interval=5)
+    sim = Simulator(24, config=config, seed=18)
+    sim.crash(np.array([9]))
+    inputs = const_inputs(config, sim.alive)
+    state_a = state_b = sim.state
+    for chunk in (3, 7, 4, 9):
+        state_a = run_rounds_const(config, state_a, inputs, chunk, False)
+        state_b = run_until_decided_const(config, state_b, inputs, jnp.int32(chunk), True)
+        if int(state_b.round) < int(state_a.round):
+            state_b = run_rounds_const(
+                config, state_b, inputs,
+                int(state_a.round) - int(state_b.round), False,
+            )
+        _assert_states_equal(state_a, state_b)

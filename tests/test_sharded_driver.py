@@ -58,3 +58,21 @@ def test_sharded_driver_windowed_policy(mesh):
     rec = sim.run_until_decision(max_rounds=20, batch=10)
     assert rec is not None and list(rec.cut) == [3]
     assert rec.virtual_time_ms == 10 * 1000 + 100
+
+
+def test_sharded_driver_staggered_phases(mesh):
+    """The staggered-phase asynchrony model produces identical records on the
+    mesh and on a single device."""
+    records = {}
+    for label, m in (("sharded", mesh), ("single", None)):
+        config = SimConfig(capacity=128, rounds_per_interval=5)
+        sim = Simulator(128, config=config, seed=44, mesh=m)
+        sim.crash(np.array([8, 90]))
+        rec = sim.run_until_decision(max_rounds=64, batch=16)
+        assert rec is not None
+        records[label] = (
+            tuple(sorted(int(i) for i in rec.cut)),
+            rec.configuration_id,
+            rec.virtual_time_ms,
+        )
+    assert records["sharded"] == records["single"]

@@ -375,3 +375,36 @@ def test_windowed_fd_cuts_sustained_crash():
     rec = sim.run_until_decision(max_rounds=20, batch=10)
     assert rec is not None and sorted(rec.cut) == [7, 19]
     assert rec.virtual_time_ms == 10 * 1000 + 100  # window fills at round 10
+
+
+def test_staggered_phases_decide_with_subinterval_resolution():
+    """With rounds_per_interval=10, rounds are 100ms and alerts arrive
+    staggered by per-node phase: the cut still matches, and the decision time
+    lands inside the 10th FD interval with sub-interval resolution rather
+    than on a whole-interval boundary."""
+    from rapid_tpu.sim.engine import SimConfig
+
+    config = SimConfig(capacity=64, rounds_per_interval=10)
+    sim = Simulator(64, config=config, seed=33)
+    victims = np.array([5, 40])
+    sim.crash(victims)
+    rec = sim.run_until_decision(max_rounds=128, batch=64)
+    assert rec is not None and sorted(rec.cut) == [5, 40]
+    # 10th interval spans (9000, 10000]; plus the batching window
+    assert 9000 < rec.virtual_time_ms - 100 <= 10_000
+
+
+def test_staggered_phases_cut_parity_with_synchronous_model():
+    """The asynchrony model changes timing, never the decided cut."""
+    from rapid_tpu.sim.engine import SimConfig
+
+    victims = np.array([11, 12, 50])
+    cuts = {}
+    for rpi in (1, 10):
+        config = SimConfig(capacity=64, rounds_per_interval=rpi)
+        sim = Simulator(64, config=config, seed=34)
+        sim.crash(victims)
+        rec = sim.run_until_decision(max_rounds=128, batch=64)
+        assert rec is not None
+        cuts[rpi] = (tuple(sorted(rec.cut)), rec.configuration_id)
+    assert cuts[1] == cuts[10]
