@@ -3,22 +3,20 @@
 Multi-chip sharding is validated on a virtual 8-device CPU mesh (the driver
 dry-runs the real multi-chip path separately). The axon TPU plugin in this
 image overrides JAX_PLATFORMS from the environment, so the platform must be
-forced through jax.config before any test imports jax.
+forced through jax.config before any test imports jax — one canonical
+implementation lives in ``__graft_entry__._force_cpu_mesh``.
 """
 
 import os
+import sys
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if os.environ.get("RAPID_TPU_PALLAS_HW"):
     # opt-in hardware runs (test_pallas_kernels.py::test_hardware_*) keep the
     # real accelerator visible
-    import jax  # noqa: E402
+    import jax  # noqa: F401
 else:
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    from __graft_entry__ import _force_cpu_mesh
 
-    import jax  # noqa: E402
-
-    jax.config.update("jax_platforms", "cpu")
+    _force_cpu_mesh(8)
