@@ -28,6 +28,7 @@ the reference's one-transport-fits-all gRPC fan-out (SURVEY.md §5.8).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Tuple
 
@@ -91,7 +92,13 @@ def state_shardings(mesh: Mesh) -> SimState:
         reports=rep,
         seen_down=rep,
         announced=rep,
+        announced_round=rep,
         proposal=rep,
+        auto_vote=rep,
+        voted=rep,
+        vote_prop=rep,
+        vote_new=rep,
+        votes_recv=rep,
         decided=rep,
         decided_group=rep,
         decided_round=rep,
@@ -187,28 +194,20 @@ def _sharded_round(
     # leave notifications (already dst-indexed, replicated)
     down_arrivals = (delta > 0) | (inputs.down_reports & active[:, None])
 
-    # --- replicated delivery + cut detection + tally (identical per shard) -
-    (reports, seen_down, announced, proposal, decided, decided_group,
-     decided_round) = route_and_tally(config, state, down_arrivals, inputs,
-                                      active, alive)
+    # --- replicated delivery + cut detection + per-node vote tally
+    # (identical on every shard -- cheap [C]/[G,C] ops, no second collective)
+    tallied = route_and_tally(config, state, down_arrivals, inputs,
+                              active, alive)
 
-    new_state = SimState(
+    new_state = dataclasses.replace(
+        tallied,
         active=active,
         alive=inputs.alive,
-        group_of=state.group_of,
         subjects=subj,
-        observers=state.observers,
         fd_fail=fd_fail,
         fd_hist=fd_hist,
         fd_seen=fd_seen,
         alerted=alerted,
-        reports=reports,
-        seen_down=seen_down,
-        announced=announced,
-        proposal=proposal,
-        decided=decided,
-        decided_group=decided_group,
-        decided_round=decided_round,
         round=state.round + 1,
         rng_key=key,
     )

@@ -90,6 +90,10 @@ class Simulator:
         self.active[:n_nodes] = True
         self.alive = self.active.copy()
         self.group_of = np.zeros(capacity, dtype=np.int32)
+        # slots whose fast-round votes the engine casts itself. The bridge
+        # seam: TpuSimMessaging clears a slot when a real member owns it, so
+        # only that node's actually-received votes count toward the tally
+        self.auto_vote = np.ones(capacity, dtype=bool)
         # identifiersSeen is an append-only *value* history of every NodeId
         # ever admitted (MembershipView.java:51,155): stored by (high, low)
         # value, not by slot, so slots can be re-seated with fresh identities
@@ -194,6 +198,7 @@ class Simulator:
             jnp.asarray(self.active),
             jnp.asarray(self.alive & self.active),
             jnp.asarray(self.group_of),
+            jnp.asarray(self.auto_vote),
             jax.random.PRNGKey(seed),
         )
         if self.mesh is not None:
@@ -462,17 +467,16 @@ class Simulator:
         """Run device batches until consensus decides a cut, then apply the
         view change. Returns the record, or None if no decision in budget.
 
-        If the fast round stalls (some group announced a proposal but no
-        identical-proposal pool reaches the 3/4 supermajority -- too many
+        If the fast round stalls (proposals announced but no value's received
+        votes reach the 3/4 supermajority in any group's tally -- too many
         members crashed, blind, or holding diverging proposals) for
         ``classic_fallback_after_rounds`` rounds, the host runs the classic
         Paxos recovery round among the live members (FastPaxos.java:189-195):
-        the coordinator value-pick rule chooses among the groups' fast-round
-        votes (see _classic_round_winner), and the choice decides iff live
-        members form a majority (> N/2, Paxos.java:168,229)."""
+        the coordinator value-pick rule chooses among the members' actual
+        fast-round votes (see _classic_round_winner), and the choice decides
+        iff live members form a majority (> N/2, Paxos.java:168,229)."""
         t0 = time.perf_counter()
         rounds_done = 0
-        announced_for = 0
         while rounds_done < max_rounds:
             join_reports = self._arm_pending_joins()
             inputs = self._const_inputs(join_reports)
@@ -499,13 +503,16 @@ class Simulator:
                         bool(self._deliver.all()),
                     )
                 # ONE host<->device round trip syncs the batch and fetches
-                # everything a decision (or the classic fallback) needs, so
-                # neither pays a second transfer latency
-                (decided, announced_np, proposal_np, decided_group,
-                 decided_round, round_np) = jax.device_get(
+                # everything a decision needs, so it never pays a second
+                # transfer latency. The [C]-sized per-node vote arrays are
+                # NOT in this sync -- they are only needed by the rare
+                # classic-fallback branch, which pays its own fetch.
+                (decided, announced_np, announced_round_np, proposal_np,
+                 decided_group, decided_round, round_np) = jax.device_get(
                     (self.state.decided, self.state.announced,
-                     self.state.proposal, self.state.decided_group,
-                     self.state.decided_round, self.state.round)
+                     self.state.announced_round, self.state.proposal,
+                     self.state.decided_group, self.state.decided_round,
+                     self.state.round)
                 )
                 announced_any = announced_np.any()
             self.metrics.incr("rounds", n)
@@ -516,12 +523,20 @@ class Simulator:
                     t0, (proposal_np, decided_group, decided_round)
                 )
             if announced_any:
-                announced_for += n
+                # rounds the announced proposal has actually been stalled --
+                # the fallback timer runs from propose(), not from the start
+                # of the dispatch batch (FastPaxos.java:105-107)
+                stalled_rounds = int(round_np) - int(announced_round_np)
                 if (
                     classic_fallback_after_rounds is not None
-                    and announced_for >= classic_fallback_after_rounds
+                    and stalled_rounds >= classic_fallback_after_rounds
                 ):
-                    winner = self._classic_round_winner(announced_np, proposal_np)
+                    voted_np, vote_prop_np = jax.device_get(
+                        (self.state.voted, self.state.vote_prop)
+                    )
+                    winner = self._classic_round_winner(
+                        announced_np, proposal_np, voted_np, vote_prop_np
+                    )
                     if winner is not None:
                         # no need to write the decision back to the device:
                         # _apply_view_change consumes the fetched arrays and
@@ -553,37 +568,46 @@ class Simulator:
         return self._sharded_runs[key]
 
     def _classic_round_winner(
-        self, announced: np.ndarray, proposals: np.ndarray
+        self,
+        announced: np.ndarray,
+        proposals: np.ndarray,
+        voted: np.ndarray,
+        vote_prop: np.ndarray,
     ) -> Optional[int]:
         """Host-side classic recovery round: the coordinator value-pick rule
-        over the groups' fast-round votes (Paxos.java:269-326), deciding iff
-        live members form a majority (Paxos.java:168,229).
+        over the members' actual fast-round votes (Paxos.java:269-326),
+        deciding iff live members form a majority (Paxos.java:168,229).
 
-        All fast-round votes are at the same (fast) rank, so the rule reduces
-        to: a single distinct proposed value wins; otherwise a value with
-        more than N/4 votes wins; otherwise any proposed value may be picked.
-        Returns the winning group's index, or None if no decision is possible."""
+        Phase-1b responses come from live members only; each reports the vote
+        it cast in the fast round (its vval; nothing if it never voted). All
+        fast-round votes are at the same (fast) rank, so the rule reduces to:
+        a single distinct voted value wins; otherwise a value with more than
+        N/4 phase-1b votes wins; otherwise any announced value may be picked.
+        Returns the winning proposal row, or None if no decision is possible."""
         n = int(self.active.sum())
         live = self.active & self.alive
         if int(live.sum()) <= n // 2:
             return None
         if not announced.any():
             return None
-        group_live = np.bincount(
-            self.group_of[live], minlength=self.config.groups
+        # per-row vote counts among live responders (the quorum's vvals)
+        responders = live & voted
+        row_votes = np.bincount(
+            vote_prop[responders], minlength=len(announced)
         )
-        announced_groups = np.flatnonzero(announced)
+        # pool rows holding identical proposal values
         distinct: dict = {}
-        for g in announced_groups:
-            key = proposals[g].tobytes()
-            distinct.setdefault(key, [0, int(g)])
-            distinct[key][0] += int(group_live[g])
-        if len(distinct) == 1:
-            return next(iter(distinct.values()))[1]
-        for votes, g in distinct.values():
+        for row in np.flatnonzero(announced):
+            key = proposals[row].tobytes()
+            distinct.setdefault(key, [0, int(row)])
+            distinct[key][0] += int(row_votes[row])
+        voted_values = [v for v in distinct.values() if v[0] > 0]
+        if len(voted_values) == 1:
+            return voted_values[0][1]
+        for votes, row in voted_values:
             if votes > n // 4:
-                return g
-        # any proposed value is safe to pick at this point
+                return row
+        # no voted value is privileged: any announced value is safe to pick
         return next(iter(distinct.values()))[1]
 
     def _apply_view_change(
@@ -593,7 +617,7 @@ class Simulator:
     ) -> ViewChangeRecord:
         self.metrics.incr("view_changes")
         proposal_np, decided_group, decided_round = fetched
-        # the winning group's proposal is the decided cut
+        # the winning proposal row's value is the decided cut
         cut = proposal_np[int(decided_group)]
         decided_round = int(decided_round)
         removed = np.flatnonzero(cut & self.active)
@@ -626,8 +650,9 @@ class Simulator:
         self.alive[list(left)] = False
         self._injected_down[:] = False  # alerts are per-configuration
 
-        # protocol-time: only the rounds of this configuration not yet billed,
-        # plus the batching window before the deciding broadcast
+        # protocol-time: only the rounds of this configuration not yet billed
+        # (decided_round includes the vote-delivery round between announcement
+        # and decision), plus the batching window before the alert broadcast
         unbilled = decided_round - self._billed_rounds
         self.virtual_ms += (
             unbilled * self._round_ms + self.config.batching_window_ms
@@ -796,5 +821,8 @@ class Simulator:
                 if "group_of" in data
                 else np.zeros(capacity, dtype=np.int32)
             )
+            # bridged-vote ownership is a live-bridge property, not part of a
+            # configuration snapshot: a restored swarm starts all-simulated
+            sim.auto_vote = np.ones(capacity, dtype=bool)
         sim._init_runtime_state()
         return sim

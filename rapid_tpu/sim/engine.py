@@ -16,10 +16,24 @@ This is the TPU execution backend for Rapid's steady-state loop
 nodes are partitioned into G delivery classes; the fault plane can drop
 broadcast traffic per (receiving group, sender), so groups can see different
 alert subsets, hold *different* cut-detector states, and propose different
-cuts. Consensus then genuinely has to resolve the divergence: votes are
-tallied by comparing group proposals, and a cut only decides when groups
-agreeing on an identical proposal hold a 3/4 supermajority of live members.
-G=1 reduces to uniform delivery.
+cuts. G=1 reduces to uniform delivery.
+
+**Consensus is per-node** (FastPaxos.java:125-156): every live node casts one
+fast-round vote -- for its own cut detector's proposal, i.e. its delivery
+group's -- the round that proposal is announced, guarded by a per-sender
+dedup latch (``voted``, the votesReceived set of FastPaxos.java:134-141). The
+vote broadcast is itself a delivery hop: votes cast in round t are in flight
+(``vote_new``) and arrive in round t+1, gated per receiving group by the same
+``deliver`` fault mask as alert broadcasts (a dropped vote is lost, exactly
+like the reference's best-effort unicast). Each group tallies the votes it
+received (``votes_recv``); identical proposals pool their votes; a cut decides
+when some group's tally holds N - floor((N-1)/4) votes for one value
+(FastPaxos.java:145-150). ``decided_round`` therefore always bills at least
+one round between announcement and decision -- vote propagation is simulated,
+not assumed. Proposal rows beyond the first G (``extern_proposals``) carry
+values proposed by *bridged real nodes*; the host registers their actual
+votes into the same per-node state, so a real member can swing or block a
+simulated quorum.
 
 All state lives in capacity-padded arrays (static shapes; membership churn is
 an active-mask update + host-side adjacency rebuild). ``run_rounds*`` scans R
@@ -75,6 +89,14 @@ class SimConfig:
     # (sim/pallas_kernels.py). "off" = stock jax; "tpu" = hardware kernel;
     # "interpret" = Pallas interpreter (CPU-testable).
     pallas_fd: str = "off"
+    # Extra proposal rows past the G group rows, reserved for values proposed
+    # by bridged real nodes (sim/bridge.py registers their actual fast-round
+    # votes into these rows). 0 = all-simulated cluster.
+    extern_proposals: int = 0
+
+    @property
+    def proposal_rows(self) -> int:
+        return self.groups + self.extern_proposals
 
 
 @jax.tree_util.register_dataclass
@@ -93,10 +115,16 @@ class SimState:
     alerted: jax.Array  # bool[C, K] edge already reported DOWN
     reports: jax.Array  # bool[G, C, K] per-group report tables (dst, ring)
     seen_down: jax.Array  # bool[G] group saw a DOWN alert this configuration
-    announced: jax.Array  # bool[G] group announced its proposal
-    proposal: jax.Array  # bool[G, C] latched proposal mask per group
+    announced: jax.Array  # bool[P] proposal row holds an announced value
+    announced_round: jax.Array  # int32[] round of the first announcement
+    proposal: jax.Array  # bool[P, C] latched proposal masks (G group + extern)
+    auto_vote: jax.Array  # bool[C] slot casts its own votes (False = bridged)
+    voted: jax.Array  # bool[C] fast-round per-sender dedup latch
+    vote_prop: jax.Array  # int32[C] proposal row each voter voted for
+    vote_new: jax.Array  # bool[C] votes cast this round, arriving next round
+    votes_recv: jax.Array  # bool[G, C] votes received per (group, sender)
     decided: jax.Array  # bool[] consensus reached
-    decided_group: jax.Array  # int32[] group whose proposal won
+    decided_group: jax.Array  # int32[] proposal row whose value won
     decided_round: jax.Array  # int32[] round at which decision happened
     round: jax.Array  # int32[] rounds elapsed in this configuration
     rng_key: jax.Array
@@ -124,6 +152,7 @@ def initial_state(
 ) -> SimState:
     subjects, observers = build_adjacency(cluster, active)
     c, k, g = config.capacity, config.k, config.groups
+    p = config.proposal_rows
     if group_of is None:
         group_of = np.zeros(c, dtype=np.int32)
     return SimState(
@@ -138,8 +167,14 @@ def initial_state(
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         seen_down=jnp.zeros(g, bool),
-        announced=jnp.zeros(g, bool),
-        proposal=jnp.zeros((g, c), bool),
+        announced=jnp.zeros(p, bool),
+        announced_round=jnp.asarray(0, jnp.int32),
+        proposal=jnp.zeros((p, c), bool),
+        auto_vote=jnp.ones(c, bool),
+        voted=jnp.zeros(c, bool),
+        vote_prop=jnp.zeros(c, jnp.int32),
+        vote_new=jnp.zeros(c, bool),
+        votes_recv=jnp.zeros((g, c), bool),
         decided=jnp.asarray(False),
         decided_group=jnp.asarray(0, jnp.int32),
         decided_round=jnp.asarray(0, jnp.int32),
@@ -158,9 +193,10 @@ def route_and_tally(
     *,
     uniform_delivery: bool = False,
     gate_implicit: bool = False,
-):
-    """Alert delivery, per-group cut detection, and the fast-round tally --
-    shared by the single-device and sharded steps.
+) -> SimState:
+    """Alert delivery, per-group cut detection, per-node vote casting, the
+    vote delivery hop, and the fast-round tally -- shared by the
+    single-device and sharded steps.
 
     ``down_arrivals[d, k]`` is the (dst, ring)-indexed view of this round's
     DOWN alerts; the sender of the (d, k) alert is ``observers[d, k]`` (the
@@ -176,8 +212,10 @@ def route_and_tally(
     both saw a DOWN alert and has a node in flux -- it is the identity
     otherwise, so gating is exact.
 
-    Returns (reports, seen_down, announced, proposal, decided, decided_group,
-    decided_round).
+    Returns ``state`` with the tally-owned fields replaced (reports,
+    seen_down, announced, proposal, voted, vote_prop, vote_new, votes_recv,
+    decided, decided_group, decided_round); the caller layers the FD fields
+    and the round increment on top.
     """
     sender = state.observers  # [C, K]
     arrivals = down_arrivals | inputs.join_reports  # [C, K]
@@ -224,32 +262,88 @@ def route_and_tally(
     stable = counts >= config.h
 
     # --- proposal emission per group ---------------------------------------
-    emit = jnp.any(stable, axis=1) & ~jnp.any(in_flux, axis=1) & ~state.announced
-    announced = state.announced | emit
-    proposal = jnp.where(emit[:, None], stable, state.proposal)
-
-    # --- fast-round vote tally across groups -------------------------------
-    # Every live member votes its group's proposal once announced; identical
-    # proposals pool their votes; decision at N - floor((N-1)/4) identical
-    # votes (FastPaxos.java:145-150).
-    live = active & alive
+    # Group rows are the first G of the [P, C] proposal table; extern rows are
+    # written only by the host (bridged real proposers, sim/bridge.py).
     g = config.groups
-    group_live = jnp.zeros(g, jnp.int32).at[state.group_of].add(
-        live.astype(jnp.int32)
+    p_rows = config.proposal_rows
+    announced_g = state.announced[:g]
+    emit = jnp.any(stable, axis=1) & ~jnp.any(in_flux, axis=1) & ~announced_g
+    announced = state.announced.at[:g].set(announced_g | emit)
+    proposal = state.proposal.at[:g].set(
+        jnp.where(emit[:, None], stable, state.proposal[:g])
     )
-    eq = jnp.all(proposal[:, None, :] == proposal[None, :, :], axis=2)  # [G, G]
-    votes_for = jnp.sum(
-        jnp.where(eq & announced[None, :], group_live[None, :], 0), axis=1
-    )  # [G]
+    # the round at which the first value was proposed -- the anchor for the
+    # host's classic-fallback timer (the reference schedules its fallback
+    # relative to propose(), FastPaxos.java:105-107). Latched when no
+    # announcement round is recorded yet (0 = none; rounds are 1-based), so a
+    # host-written extern-row announcement between dispatches is stamped with
+    # the first round the device processes it.
+    announced_round = jnp.where(
+        (state.announced_round == 0) & jnp.any(announced),
+        state.round + 1,
+        state.announced_round,
+    )
+
+    # --- per-node fast-round votes (FastPaxos.java:125-156) ----------------
+    # A node casts its vote -- for its own group's proposal -- the round that
+    # proposal is announced, once per configuration (the per-sender dedup of
+    # FastPaxos.java:134-141). Bridged real slots (auto_vote=False) vote only
+    # when the host registers their actual message.
+    live = active & alive
+    new_voters = (
+        live & state.auto_vote & announced[state.group_of] & ~state.voted
+    )
+    voted = state.voted | new_voters
+    vote_prop = jnp.where(new_voters, state.group_of, state.vote_prop)
+
+    # The vote broadcast is a delivery hop: votes cast last round
+    # (state.vote_new) arrive now, gated per receiving group by the same
+    # fault mask as any broadcast. A vote dropped on its delivery round is
+    # lost for good (best-effort unicast, UnicastToAllBroadcaster.java:46-52).
+    if uniform_delivery:
+        votes_recv = state.votes_recv | state.vote_new[None, :]
+    else:
+        votes_recv = state.votes_recv | (
+            state.vote_new[None, :] & inputs.deliver
+        )
+
+    # --- tally, per receiving group ----------------------------------------
+    # counts[g, q] = votes group g has received for proposal row q; identical
+    # rows pool via the [P, P] equality matrix; decision when some group sees
+    # N - floor((N-1)/4) votes for one value (FastPaxos.java:145-150).
+    onehot = (
+        (vote_prop[:, None] == jnp.arange(p_rows, dtype=jnp.int32)[None, :])
+        & voted[:, None]
+    )  # [C, P]
+    counts = votes_recv.astype(jnp.int32) @ onehot.astype(jnp.int32)  # [G, P]
+    eq = jnp.all(
+        proposal[:, None, :] == proposal[None, :, :], axis=2
+    )  # [P, P]
+    pooled = counts @ (eq & announced[:, None]).astype(jnp.int32)  # [G, P]
     n = active.sum()
     quorum = n - (n - 1) // 4
-    qualifies = announced & (votes_for >= quorum)
+    qualifies = announced[None, :] & (pooled >= quorum)  # [G, P]
     decide_now = jnp.any(qualifies) & ~state.decided
-    winner = jnp.argmax(jnp.where(qualifies, votes_for, -1)).astype(jnp.int32)
+    best = jnp.max(jnp.where(qualifies, pooled, -1), axis=0)  # [P]
+    winner = jnp.argmax(best).astype(jnp.int32)
     decided = state.decided | decide_now
     decided_group = jnp.where(decide_now, winner, state.decided_group)
     decided_round = jnp.where(decide_now, state.round + 1, state.decided_round)
-    return reports, seen_down, announced, proposal, decided, decided_group, decided_round
+    return dataclasses.replace(
+        state,
+        reports=reports,
+        seen_down=seen_down,
+        announced=announced,
+        announced_round=announced_round,
+        proposal=proposal,
+        voted=voted,
+        vote_prop=vote_prop,
+        vote_new=new_voters,
+        votes_recv=votes_recv,
+        decided=decided,
+        decided_group=decided_group,
+        decided_round=decided_round,
+    )
 
 
 def probe_phases(config: SimConfig) -> jnp.ndarray:
@@ -367,27 +461,17 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
         new_down[state.observers, cols] | inputs.down_reports
     ) & active[:, None]
 
-    (reports, seen_down, announced, proposal, decided, decided_group,
-     decided_round) = route_and_tally(config, state, down_arrivals, inputs,
-                                      active, alive)
+    tallied = route_and_tally(config, state, down_arrivals, inputs,
+                              active, alive)
 
-    new_state = SimState(
+    new_state = dataclasses.replace(
+        tallied,
         active=active,
         alive=inputs.alive,
-        group_of=state.group_of,
-        subjects=state.subjects,
-        observers=state.observers,
         fd_fail=fd_fail,
         fd_hist=fd_hist,
         fd_seen=fd_seen,
         alerted=alerted,
-        reports=reports,
-        seen_down=seen_down,
-        announced=announced,
-        proposal=proposal,
-        decided=decided,
-        decided_group=decided_group,
-        decided_round=decided_round,
         round=state.round + 1,
         rng_key=key,
     )
@@ -492,18 +576,21 @@ def run_until_decided_const(
     )
 
     # Fast-forward over provably-inert rounds: from a *fresh* configuration
-    # (no reports, nothing announced, no join traffic) a round with no alert
-    # arrivals is a strict no-op -- counts stay zero, the implicit pass and
-    # the tally cannot fire -- so execution can start at the first arrival
-    # round. Skipped rounds still count toward the budget, the round counter,
-    # and the closed-form FD reconstruction below, so the result (including
-    # decided_round and virtual-time billing) is bit-identical to sequential
-    # execution. Saves ~threshold-1 loop iterations per decision dispatch.
+    # (no reports, nothing announced, no votes cast or in flight, no join
+    # traffic) a round with no alert arrivals is a strict no-op -- counts stay
+    # zero, the implicit pass, the vote casting, and the tally cannot fire --
+    # so execution can start at the first arrival round. Skipped rounds still
+    # count toward the budget, the round counter, and the closed-form FD
+    # reconstruction below, so the result (including decided_round and
+    # virtual-time billing) is bit-identical to sequential execution. Saves
+    # ~threshold-1 loop iterations per decision dispatch.
     fresh = (
         ~state.decided
         & ~jnp.any(state.reports)
         & ~jnp.any(state.announced)
         & ~jnp.any(state.seen_down)
+        & ~jnp.any(state.voted)
+        & ~jnp.any(state.vote_new)
         & ~jnp.any(inputs.join_reports)
     )
     first_arrival = jnp.min(fire_dst)  # == `never` when no edge will fire
@@ -526,16 +613,11 @@ def run_until_decided_const(
         st, r = carry
         r = r + 1
         down_arrivals = fire_dst == r
-        (reports, seen_down, announced, proposal, decided, decided_group,
-         decided_round) = route_and_tally(
+        st = route_and_tally(
             config, st, down_arrivals, inputs, active, alive,
             uniform_delivery=uniform_delivery, gate_implicit=True,
         )
-        st = dataclasses.replace(
-            st, reports=reports, seen_down=seen_down, announced=announced,
-            proposal=proposal, decided=decided, decided_group=decided_group,
-            decided_round=decided_round, round=st.round + 1,
-        )
+        st = dataclasses.replace(st, round=st.round + 1)
         return st, r
 
     final, r_exec = jax.lax.while_loop(
@@ -559,6 +641,7 @@ def device_initial_state(
     active: jax.Array,  # bool[C]
     alive: jax.Array,  # bool[C]
     group_of: jax.Array,  # int32[C]
+    auto_vote: jax.Array,  # bool[C] (False = slot voted by a bridged real node)
     rng_key: jax.Array,
 ) -> SimState:
     """Fresh-configuration state built entirely on device.
@@ -593,6 +676,7 @@ def device_initial_state(
     observers = base.at[nodes_flat, ring_ids].set(succs.reshape(-1))
 
     g = config.groups
+    p = config.proposal_rows
     return SimState(
         active=active,
         alive=alive,
@@ -605,8 +689,14 @@ def device_initial_state(
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         seen_down=jnp.zeros(g, bool),
-        announced=jnp.zeros(g, bool),
-        proposal=jnp.zeros((g, c), bool),
+        announced=jnp.zeros(p, bool),
+        announced_round=jnp.asarray(0, jnp.int32),
+        proposal=jnp.zeros((p, c), bool),
+        auto_vote=auto_vote,
+        voted=jnp.zeros(c, bool),
+        vote_prop=jnp.zeros(c, jnp.int32),
+        vote_new=jnp.zeros(c, bool),
+        votes_recv=jnp.zeros((g, c), bool),
         decided=jnp.asarray(False),
         decided_group=jnp.asarray(0, jnp.int32),
         decided_round=jnp.asarray(0, jnp.int32),

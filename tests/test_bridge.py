@@ -94,8 +94,8 @@ def test_real_node_leaves_gracefully():
     rec = h.swarm.pump(max_rounds=8)
     assert rec is not None
     assert [h.swarm._endpoint(int(s)) for s in rec.cut] == [cluster.listen_address]
-    # leave decided in ~1 round, not the 10-round FD threshold
-    assert rec.virtual_time_ms - join_rec.virtual_time_ms == 1 * 1000 + 100
+    # leave decided in 1 alert round + 1 vote round, not the 10-round FD wait
+    assert rec.virtual_time_ms - join_rec.virtual_time_ms == 2 * 1000 + 100
     assert h.swarm.sim.membership_size == 16
     assert h.scheduler.run_until(done.done, timeout_ms=30_000)
 

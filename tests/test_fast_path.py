@@ -137,6 +137,7 @@ def test_device_initial_state_matches_host_adjacency():
         jnp.asarray(active),
         jnp.asarray(active),
         jnp.zeros(50, jnp.int32),
+        jnp.ones(50, bool),
         jax.random.PRNGKey(0),
     )
     np.testing.assert_array_equal(np.asarray(st.subjects), host_subjects)
@@ -155,6 +156,7 @@ def test_device_initial_state_tiny_membership(n_active):
         jnp.asarray(active),
         jnp.asarray(active),
         jnp.zeros(8, jnp.int32),
+        jnp.ones(8, bool),
         jax.random.PRNGKey(0),
     )
     np.testing.assert_array_equal(np.asarray(st.subjects), host_subjects)

@@ -1,0 +1,199 @@
+"""Per-node fast-round votes: cast, deliver, dedup, tally (FastPaxos.java:125-156).
+
+The engine simulates the vote broadcast as a real delivery hop rather than
+assuming every live member's vote arrives the moment its group announces:
+votes are cast once per sender (dedup latch), spend one round in flight, can
+be dropped by the delivery fault plane (and are then lost for good, like the
+reference's best-effort unicast), and only *received* votes count toward the
+N - floor((N-1)/4) quorum. The last test is the cross-plane differential:
+the object-model stack (untouched Cluster/MembershipService/FastPaxos over
+the in-process transport) and the TPU sim agree on decision-round timing for
+the same crash fault once the object plane's vote hop is given the same
+one-round latency the sim bills.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.sim.engine import SimConfig, const_inputs, run_rounds_const
+from rapid_tpu.types import FastRoundPhase2bMessage
+
+from harness import ClusterHarness
+
+
+def _round_by_round(config, state, inputs, rounds):
+    """Yield state after each single engine round."""
+    for _ in range(rounds):
+        state = run_rounds_const(config, state, inputs, 1, False)
+        yield state
+
+
+def test_decision_exactly_one_round_after_announcement():
+    """Votes cast the announcement round arrive -- and decide -- the next."""
+    config = SimConfig(capacity=16, k=4, h=3, l=2, fd_threshold=3)
+    sim = Simulator(16, config=config, seed=1)
+    sim.crash(np.array([15]))
+    inputs = const_inputs(config, sim.alive)
+    announce_round = decide_round = None
+    state = sim.state
+    for state in _round_by_round(config, state, inputs, 8):
+        r = int(state.round)
+        if announce_round is None and bool(np.asarray(state.announced).any()):
+            announce_round = r
+            # votes are cast this round and still in flight: none received
+            assert int(np.asarray(state.vote_new).sum()) == 15
+            assert int(np.asarray(state.votes_recv).sum()) == 0
+            assert not bool(state.decided)
+        if decide_round is None and bool(state.decided):
+            decide_round = r
+    assert announce_round is not None and decide_round is not None
+    assert decide_round == announce_round + 1
+    assert int(state.decided_round) == int(state.announced_round) + 1
+
+
+def test_one_vote_per_sender_dedup():
+    """The per-sender dedup latch (FastPaxos.java:134-141): every live member
+    votes exactly once per configuration, crashed members never vote."""
+    config = SimConfig(capacity=16, k=4, h=3, l=2, fd_threshold=3)
+    sim = Simulator(16, config=config, seed=2)
+    sim.crash(np.array([7]))
+    inputs = const_inputs(config, sim.alive)
+    state = sim.state
+    total_casts = 0
+    for state in _round_by_round(config, state, inputs, 10):
+        total_casts += int(np.asarray(state.vote_new).sum())
+    voted = np.asarray(state.voted)
+    assert total_casts == 15  # 16 members, the crashed one never votes
+    assert voted.sum() == 15 and not voted[7]
+    # every received vote is for the single announced proposal row
+    assert np.asarray(state.vote_prop)[voted].max() == 0
+
+
+def test_votes_dropped_on_their_delivery_round_are_lost():
+    """A vote is one best-effort broadcast (UnicastToAllBroadcaster.java:46-52):
+    if the fault plane drops it on its delivery round, it never reaches any
+    tally -- even after the link heals -- and the fast quorum stays
+    unreachable."""
+    config = SimConfig(capacity=16, k=4, h=3, l=2, fd_threshold=3)
+    sim = Simulator(16, config=config, seed=3)
+    sim.crash(np.array([15]))
+    clear = const_inputs(config, sim.alive)
+    state = sim.state
+    # run until the proposal is announced (votes now in flight)
+    for state in _round_by_round(config, state, clear, 8):
+        if bool(np.asarray(state.announced).any()):
+            break
+    assert not bool(state.decided)
+    # quorum is N - floor((N-1)/4) = 13; drop votes from 13 senders for the
+    # one round they are in flight
+    deliver = np.ones((1, 16), dtype=bool)
+    deliver[0, :13] = False
+    state = run_rounds_const(
+        config, state, const_inputs(config, sim.alive, deliver=deliver), 1, False
+    )
+    assert int(np.asarray(state.votes_recv).sum()) == 2  # senders 13, 14
+    # link heals, but the dropped votes were lost for good: no decision ever
+    state = run_rounds_const(config, state, clear, 20, False)
+    assert not bool(state.decided)
+    assert int(np.asarray(state.votes_recv).sum()) == 2
+
+
+def test_non_auto_vote_slots_count_only_registered_votes():
+    """Slots with auto_vote=False (bridged real members, sim/bridge.py) do not
+    have votes cast for them; the quorum is reachable only once their actual
+    votes are registered into the per-node state -- the seam that lets a real
+    node swing or block a simulated decision."""
+    config = SimConfig(capacity=16, k=4, h=3, l=2, fd_threshold=3)
+    sim = Simulator(16, config=config, seed=4)
+    auto = np.ones(16, dtype=bool)
+    real_slots = np.array([0, 1, 2, 3, 4])  # 5 > F = 3 withheld votes
+    auto[real_slots] = False
+    state = dataclasses.replace(sim.state, auto_vote=jnp.asarray(auto))
+    sim.crash(np.array([15]))
+    inputs = const_inputs(config, sim.alive)
+    # auto voters: 16 - 5 - 1 crashed = 10 < quorum 13 -> the fast round stalls
+    state = run_rounds_const(config, state, inputs, 12, False)
+    assert bool(np.asarray(state.announced).any()) and not bool(state.decided)
+    assert int(np.asarray(state.voted).sum()) == 10
+    # the host registers the real members' votes for the announced proposal
+    # (row 0) -- what TpuSimMessaging does when FastRoundPhase2bMessages arrive
+    state = dataclasses.replace(
+        state,
+        voted=state.voted.at[real_slots].set(True),
+        vote_prop=state.vote_prop.at[real_slots].set(0),
+        vote_new=state.vote_new.at[real_slots].set(True),
+    )
+    state = run_rounds_const(config, state, inputs, 2, False)
+    assert bool(state.decided)
+    assert int(np.asarray(state.decided_group)) == 0
+
+
+def test_cross_plane_decision_round_timing():
+    """Differential timing parity: for the same crash fault the object-model
+    plane and the sim plane agree on decision-round timing.
+
+    Mapping: the sim quantizes delivery to rounds -- the vote broadcast costs
+    exactly one round (one FD interval at rounds_per_interval=1). Giving the
+    object plane's vote messages the same one-interval latency, both planes
+    decide 11 FD intervals (10 probe rounds to the threshold + 1 vote hop)
+    plus one batching window after the crash; and removing the object plane's
+    vote latency shifts its decision earlier by exactly one interval, which
+    is precisely the round the sim bills for vote propagation."""
+    fd_interval = 1000
+
+    # --- sim plane: N=10, one crash ------------------------------------
+    sim = Simulator(10, seed=5)
+    sim.crash(np.array([9]))
+    rec = sim.run_until_decision(max_rounds=40)
+    assert rec is not None and list(rec.cut) == [9]
+    assert rec.virtual_time_ms == 11 * fd_interval + 100
+
+    # --- object plane, parameterized by the vote-hop latency ------------
+    def run_object(vote_delay_ms: int) -> int:
+        view_change_times = []
+        h = ClusterHarness(seed=1, use_static_fd=False)  # real PingPong FDs
+        from rapid_tpu.events import ClusterEvents
+
+        h.start_seed(0, subscriptions=[(
+            ClusterEvents.VIEW_CHANGE,
+            lambda cid, changes: view_change_times.append(h.scheduler.now_ms()),
+        )])
+        for i in range(1, 10):
+            h.join(i)
+        h.wait_and_verify_agreement(10)
+        h.network.add_delay(
+            lambda s, d, m: vote_delay_ms
+            if isinstance(m, FastRoundPhase2bMessage)
+            else 0
+        )
+        # every view change cancels and recreates all FD jobs with initial
+        # delay 0, so after the last join the whole cluster's FDs tick in
+        # lockstep at t_f + k*interval; crash 1ms before a tick so the first
+        # failing probe lands on the very next tick (the sim's round 1)
+        t_f = view_change_times[-1]
+        k = (h.scheduler.now_ms() - t_f) // fd_interval + 1
+        h.scheduler.run_until_time(t_f + k * fd_interval - 1)
+        t_crash = h.scheduler.now_ms()
+        h.fail_nodes([h.addr(9)])
+        ok = h.scheduler.run_until(
+            lambda: h.converged(9), timeout_ms=60_000, poll_ms=1
+        )
+        assert ok, "object plane never converged after the crash"
+        elapsed = h.scheduler.now_ms() - t_crash
+        h.shutdown()
+        return elapsed
+
+    with_hop = run_object(vote_delay_ms=fd_interval)
+    without_hop = run_object(vote_delay_ms=0)
+    # the modeled vote round corresponds exactly to vote propagation time
+    assert with_hop - without_hop == fd_interval
+    # same decision round as the sim: 11 intervals + batching, measured from
+    # the first failing probe (1ms after the crash, by the tick alignment
+    # above). The sim bills exactly one batching window; the object plane's
+    # quiescence batcher fires one-to-two windows after the alert enqueue
+    # (MembershipService.java:602-626), so the planes agree to within one
+    # extra window -- far inside the round quantum.
+    assert 0 <= (with_hop - 1) - rec.virtual_time_ms <= 100

@@ -38,8 +38,9 @@ def test_single_crash_produces_singleton_cut():
     assert rec is not None
     assert list(rec.cut) == [3]
     assert rec.membership_size == 9
-    # protocol time: threshold FD rounds + batching window
-    assert rec.virtual_time_ms == 10 * 1000 + 100
+    # protocol time: threshold FD rounds + the vote-delivery round between
+    # announcement and decision + batching window
+    assert rec.virtual_time_ms == (10 + 1) * 1000 + 100
 
 
 def test_crash_burst_cut_parity_with_object_model():
@@ -214,7 +215,7 @@ def test_virtual_time_not_double_counted():
     sim_one = Simulator(10, seed=2)
     sim_one.crash(np.array([3]))
     rec_one = sim_one.run_until_decision(max_rounds=40)
-    assert rec_split.virtual_time_ms == rec_one.virtual_time_ms == 10100
+    assert rec_split.virtual_time_ms == rec_one.virtual_time_ms == 11_100
 
 
 def test_two_join_requests_both_delivered():
@@ -298,8 +299,9 @@ def test_graceful_leave_decides_without_fd_wait():
     assert rec is not None
     assert sorted(rec.cut) == [4, 19]
     assert rec.membership_size == 30
-    # 1 round + batching window, vs 10*1000+100 for a crash
-    assert rec.virtual_time_ms == 1 * 1000 + 100
+    # 1 alert round + 1 vote round + batching window, vs 11*1000+100 for a
+    # crash (no waiting out the 10-round FD threshold)
+    assert rec.virtual_time_ms == 2 * 1000 + 100
 
 
 def test_graceful_leave_parity_with_object_model():
@@ -374,7 +376,8 @@ def test_windowed_fd_cuts_sustained_crash():
     sim.crash(np.array([7, 19]))
     rec = sim.run_until_decision(max_rounds=20, batch=10)
     assert rec is not None and sorted(rec.cut) == [7, 19]
-    assert rec.virtual_time_ms == 10 * 1000 + 100  # window fills at round 10
+    # window fills at round 10, votes arrive round 11
+    assert rec.virtual_time_ms == 11 * 1000 + 100
 
 
 def test_staggered_phases_decide_with_subinterval_resolution():
@@ -390,8 +393,9 @@ def test_staggered_phases_decide_with_subinterval_resolution():
     sim.crash(victims)
     rec = sim.run_until_decision(max_rounds=128, batch=64)
     assert rec is not None and sorted(rec.cut) == [5, 40]
-    # 10th interval spans (9000, 10000]; plus the batching window
-    assert 9000 < rec.virtual_time_ms - 100 <= 10_000
+    # announcement in the 10th interval (9000, 10000]; the vote-delivery hop
+    # costs one 100ms sub-round and the batching window another 100ms
+    assert 9000 < rec.virtual_time_ms - 100 - sim._round_ms <= 10_000
 
 
 def test_staggered_phases_cut_parity_with_synchronous_model():
