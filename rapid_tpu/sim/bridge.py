@@ -25,6 +25,16 @@ How each protocol interaction crosses the bridge:
 - **Alerts** (real node -> all): DOWN alerts about virtual nodes are injected
   into the simulated report tables (Simulator.inject_down_report), so a real
   observer's evidence counts toward the swarm's H/L watermarks.
+- **Votes** (real node -> swarm): a real member's fast-round vote counts.
+  Its slot's ``auto_vote`` is cleared when the identity is seated, so the
+  engine never casts a vote on its behalf; when a proposal is announced but
+  undecided, the bridge broadcasts the proposed cut to real members *before*
+  the decision (``pump`` phase B), their own cut detectors propose, and the
+  FastRoundPhase2bMessages they broadcast back are registered into the
+  device tally (Simulator.register_extern_vote) -- interned as extern
+  proposal rows that pool with identical group proposals. A real member can
+  therefore complete a quorum the virtual members alone cannot reach, or
+  block it by voting a conflicting value (forcing the classic fallback).
 - **Decisions** (swarm -> real members): when the simulator decides a cut,
   every real member of the pre-decision configuration receives (a) one
   batched alert carrying the joiner UUIDs/metadata the view change will need
@@ -32,17 +42,11 @@ How each protocol interaction crosses the bridge:
   members; the real node's own FastPaxos then reaches the 3/4 supermajority
   and applies the view change itself -- including firing KICKED if it was cut.
 - **Leave** (real node -> observers): converted to the simulator's proactive
-  leave, deciding in ~1 round.
+  leave, deciding in ~2 rounds (alert hop + vote hop).
 - **Real-node liveness** (swarm side): a real node is sensed alive while its
   server is registered on the network; when it disappears (crash or
   shutdown), the swarm marks its slot dead and the *simulated* failure
   detectors remove it through the normal 10-round threshold cut.
-
-Fidelity note: the device-side vote tally counts every live member's slot --
-including real nodes' -- as voting with its delivery group's proposal. Real
-nodes' actual votes are received and acknowledged but do not change the
-simulated tally; with uniform delivery both tallies agree (all members see
-the same alert stream), which is the regime this bridge runs in.
 """
 
 from __future__ import annotations
@@ -111,6 +115,15 @@ class TpuSimMessaging:
         seed: int = 0,
     ) -> None:
         capacity = capacity if capacity is not None else n_virtual + 16
+        if config is None:
+            config = SimConfig(capacity=capacity)
+        if config.extern_proposals == 0:
+            # extern rows so real members' votes can be interned as proposal
+            # values (register_extern_vote); 4 covers the common regimes --
+            # real members agreeing with the swarm pool into one row
+            import dataclasses
+
+            config = dataclasses.replace(config, extern_proposals=4)
         self.sim = Simulator(n_virtual, capacity=capacity, config=config, seed=seed)
         self.network = network
         network.attach_handler(self)
@@ -122,6 +135,9 @@ class TpuSimMessaging:
         # joiner endpoint -> [(observer endpoint, parked promise)]
         self._parked: Dict[Endpoint, List[Tuple[Endpoint, Promise]]] = {}
         self._metadata: Dict[Endpoint, tuple] = {}
+        # configuration id whose announced proposal was already broadcast to
+        # real members (pump phase B runs once per configuration)
+        self._informed_config: Optional[int] = None
 
     # ------------------------------------------------------------------ #
     # identity helpers
@@ -167,8 +183,13 @@ class TpuSimMessaging:
         if isinstance(msg, BatchedAlertMessage):
             self._absorb_alerts(msg)
             return Promise.completed(Response())
+        if isinstance(msg, FastRoundPhase2bMessage):
+            self._register_real_vote(msg)
+            return Promise.completed(ConsensusResponse())
         if isinstance(msg, _CONSENSUS_TYPES):
-            # real members' votes are acknowledged; see the fidelity note
+            # classic-round traffic from real members is acknowledged; the
+            # swarm's recovery round is the host-side coordinator
+            # (Simulator._classic_round_winner)
             return Promise.completed(ConsensusResponse())
         if isinstance(msg, LeaveMessage):
             sender_slot = self._slot_of.get(msg.sender)
@@ -222,6 +243,9 @@ class TpuSimMessaging:
                     msg.node_id.high,
                     msg.node_id.low,
                 )
+                # the engine must not cast votes for a real member's slot:
+                # only its actually-received votes count (_register_real_vote)
+                self.sim.set_auto_vote(slot, False)
         # expected observers = ring predecessors, for present members too
         # (MembershipView.java:293-304; service._handle_pre_join returns them
         # for HOSTNAME_ALREADY_IN_RING as well)
@@ -287,6 +311,34 @@ class TpuSimMessaging:
         )
 
     # ------------------------------------------------------------------ #
+    # votes from real members
+    # ------------------------------------------------------------------ #
+
+    def _register_real_vote(self, msg: FastRoundPhase2bMessage) -> None:
+        """Count a real member's fast-round vote in the device tally. The
+        message's endpoint list is its proposed cut; unknown endpoints (not
+        hosted by this swarm) make the value unrepresentable and the vote is
+        dropped, like any best-effort loss."""
+        sender_slot = self._slot_of.get(msg.sender)
+        if (
+            sender_slot is None
+            or msg.sender not in self._real
+            or not self.sim.active[sender_slot]
+            or msg.configuration_id != self.sim.configuration_id()
+        ):
+            return
+        cut_slots = [
+            self._slot_of[ep] for ep in msg.endpoints if ep in self._slot_of
+        ]
+        if len(cut_slots) != len(msg.endpoints):
+            LOG.warning(
+                "vote from %s names endpoints outside the swarm; dropped",
+                msg.sender,
+            )
+            return
+        self.sim.register_extern_vote(sender_slot, np.array(cut_slots))
+
+    # ------------------------------------------------------------------ #
     # alerts from real members
     # ------------------------------------------------------------------ #
 
@@ -310,12 +362,22 @@ class TpuSimMessaging:
     # ------------------------------------------------------------------ #
 
     def pump(
-        self, max_rounds: int = 32, batch: int = 8
+        self, max_rounds: int = 32, batch: int = 8,
+        classic_fallback_after_rounds: Optional[int] = 8,
     ) -> Optional[ViewChangeRecord]:
         """Sense real-node liveness, run simulated rounds until a decision,
         then make that decision real: alerts + votes to every real member of
         the pre-decision configuration, full configurations to admitted
-        joiners."""
+        joiners.
+
+        When live real members exist, the run pauses at the first proposal
+        announcement of each configuration (phase B): the proposed cut is
+        broadcast to the real members *before* the decision, the virtual
+        clock advances so their cut detectors propose and their
+        FastRoundPhase2bMessages flow back into the device tally, and only
+        then does the fast round resume -- so a real member's vote can
+        complete a quorum the virtual members alone cannot reach, or block
+        one by voting a conflicting value."""
         self._sense_real_liveness()
         sim = self.sim
         config_before = sim.configuration_id()
@@ -335,7 +397,37 @@ class TpuSimMessaging:
             )
             if ep not in self._real
         ]
-        rec = sim.run_until_decision(max_rounds=max_rounds, batch=batch)
+        rec = None
+        rounds_before = sim.metrics.get("rounds")
+        if members_before and self._informed_config != config_before:
+            # phase A: run only to the announcement, so real members can vote
+            rec = sim.run_until_decision(
+                max_rounds=max_rounds, batch=batch,
+                classic_fallback_after_rounds=classic_fallback_after_rounds,
+                stop_when_announced=True,
+            )
+            announced = sim.last_announcement
+            if (
+                rec is None
+                and announced is not None
+                and announced[0][: sim.config.groups].any()
+                and voters
+            ):
+                # phase B: pre-decision broadcast of the proposed cut; the
+                # clock advance lets the real members' protocol stacks
+                # process it and broadcast their votes back to the swarm
+                self._informed_config = config_before
+                self._broadcast_announced_proposal(
+                    config_before, members_before, voters[0]
+                )
+                self._advance_clock(100)
+        # phases A and resume share one round budget per pump call
+        remaining = max_rounds - (sim.metrics.get("rounds") - rounds_before)
+        if rec is None and remaining > 0:
+            rec = sim.run_until_decision(
+                max_rounds=remaining, batch=batch,
+                classic_fallback_after_rounds=classic_fallback_after_rounds,
+            )
         if rec is None:
             return None
         cut_eps = sorted(
@@ -368,10 +460,10 @@ class TpuSimMessaging:
                 for ep in cut_eps
             )
             quorum = n_before - (n_before - 1) // 4
-            if len(voters) < quorum:
+            if len(voters) + 1 < quorum:  # each member also tallies its own vote
                 LOG.warning(
                     "only %d live virtual voters for quorum %d; real members "
-                    "will need the classic fallback",
+                    "may need the classic fallback to learn this decision",
                     len(voters),
                     quorum,
                 )
@@ -403,8 +495,64 @@ class TpuSimMessaging:
                 del self._real[ep]
                 del self._slot_of[ep]
                 self._metadata.pop(ep, None)
+                self.sim.set_auto_vote(slot, True)
                 self._free_slots.append(slot)
         return rec
+
+    def _broadcast_announced_proposal(
+        self,
+        config_id: int,
+        members: List[Endpoint],
+        src: Endpoint,
+    ) -> None:
+        """Send real members the alert evidence behind the announced (still
+        undecided) proposal, so their own cut detectors cross H and they cast
+        genuine fast-round votes. Ring numbers 0..K-1 stand for the K
+        observers whose reports the swarm aggregated -- one report per
+        (dst, ring), exactly what the cut detector dedups on
+        (MultiNodeCutDetector.java:97-101)."""
+        announced, proposals = self.sim.last_announcement
+        # group rows only: extern rows are real members' own votes
+        row = int(np.flatnonzero(announced[: self.sim.config.groups])[0])
+        cut_slots = np.flatnonzero(proposals[row])
+        cut_eps = sorted(
+            (self._endpoint(int(s)) for s in cut_slots),
+            key=address_comparator_key,
+        )
+        rings = tuple(range(self.sim.config.k))
+        alerts = tuple(
+            AlertMessage(
+                edge_src=src,
+                edge_dst=ep,
+                edge_status=(
+                    EdgeStatus.UP
+                    if not self.sim.active[self._slot_of[ep]]
+                    else EdgeStatus.DOWN
+                ),
+                configuration_id=config_id,
+                ring_numbers=rings,
+                node_id=(
+                    self._node_id(self._slot_of[ep])
+                    if not self.sim.active[self._slot_of[ep]]
+                    else None
+                ),
+                metadata=self._metadata.get(ep, ()),
+            )
+            for ep in cut_eps
+        )
+        for member in members:
+            self._deliver(src, member, BatchedAlertMessage(src, alerts))
+
+    def _advance_clock(self, ms: int) -> None:
+        """Let the object plane process in-flight messages: drive the shared
+        virtual clock when there is one, otherwise wait out wall time."""
+        run_for = getattr(self.network.scheduler, "run_for", None)
+        if run_for is not None:
+            run_for(ms)
+        else:  # pragma: no cover - real-scheduler deployments
+            import time
+
+            time.sleep(ms / 1000.0)
 
     def _deliver(self, src: Endpoint, dst: Endpoint, msg: RapidMessage) -> None:
         self.network.deliver(src, dst, msg, timeout_ms=1000)
@@ -426,4 +574,5 @@ class TpuSimMessaging:
                 del self._slot_of[ep]
                 self._metadata.pop(ep, None)
                 self._parked.pop(ep, None)  # the dead joiner can't hear replies
+                self.sim.set_auto_vote(slot, True)
                 self._free_slots.append(slot)

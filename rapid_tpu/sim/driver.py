@@ -131,6 +131,7 @@ class Simulator:
         self._pending_joiners: Set[int] = set()
         self._join_reports_armed = False
         self._pending_leavers: Set[int] = set()
+        self._last_announcement: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._down_reports_dev: Optional[jax.Array] = None
         self._injected_down = np.zeros(
             (self.config.capacity, self.config.k), dtype=bool
@@ -182,6 +183,11 @@ class Simulator:
 
     def _fresh_state(self, seed: int) -> SimState:
         """Fresh-configuration state, built on device (engine.device_initial_state)."""
+        # extern proposal rows and the per-sender vote dedup are
+        # per-configuration, like every other consensus latch
+        self._extern_rows: dict = {}  # proposal-mask bytes -> extern row
+        self._extern_voted: Set[int] = set()
+        self._last_announcement = None
         if self._ring_rank_dirty:
             # identities assigned since the last rebuild (joiner seating)
             self._ring_rank_dev = jnp.asarray(self.cluster.ring_rank())
@@ -329,6 +335,63 @@ class Simulator:
         ``sender_nodes`` (models lossy/partitioned dissemination)."""
         self._deliver[receiver_group, np.atleast_1d(sender_nodes)] = False
 
+    # ------------------------------------------------------------------ #
+    # Bridged (external) voters
+    # ------------------------------------------------------------------ #
+
+    def set_auto_vote(self, slot: int, enabled: bool) -> None:
+        """Transfer fast-round vote ownership of a slot between the engine
+        and an external voter (a bridged real member, sim/bridge.py). With
+        auto_vote off, the slot's vote counts only when the host registers
+        the node's actually-received FastRoundPhase2bMessage. Clear it before
+        the slot's first configuration as a member -- an already-cast vote is
+        not retracted."""
+        self.auto_vote[slot] = bool(enabled)
+        self.state = dataclasses.replace(
+            self.state, auto_vote=self._rep(self.auto_vote)
+        )
+
+    def register_extern_vote(self, slot: int, cut: np.ndarray) -> bool:
+        """Count an external member's fast-round vote in the device tally
+        (FastPaxos.java:134-150): intern the voted cut as a proposal row
+        (identical values pool with group proposals in the [P, P] equality
+        tally), mark the sender's per-node vote state, and put the vote in
+        flight so it arrives -- like any vote -- one delivery round later.
+        Per-sender dedup: only the first vote of a configuration counts.
+        Returns True iff the vote was registered."""
+        if slot in self._extern_voted:
+            return False  # dedup by sender (FastPaxos.java:134-141)
+        mask = np.zeros(self.config.capacity, dtype=bool)
+        mask[np.atleast_1d(cut)] = True
+        key = mask.tobytes()
+        row = self._extern_rows.get(key)
+        st = self.state
+        if row is None:
+            if len(self._extern_rows) >= self.config.extern_proposals:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "no free extern proposal row (extern_proposals=%d); "
+                    "dropping external vote from slot %d",
+                    self.config.extern_proposals, slot,
+                )
+                return False
+            row = self.config.groups + len(self._extern_rows)
+            self._extern_rows[key] = row
+            st = dataclasses.replace(
+                st,
+                proposal=st.proposal.at[row].set(self._rep(mask)),
+                announced=st.announced.at[row].set(True),
+            )
+        self.state = dataclasses.replace(
+            st,
+            voted=st.voted.at[slot].set(True),
+            vote_prop=st.vote_prop.at[slot].set(row),
+            vote_new=st.vote_new.at[slot].set(True),
+        )
+        self._extern_voted.add(slot)
+        return True
+
     def _probe_drop_mask(self) -> np.ndarray:
         """Map the partitioned-destination set onto the current adjacency."""
         mask = np.zeros(self.config.capacity, dtype=bool)
@@ -463,6 +526,7 @@ class Simulator:
     def run_until_decision(
         self, max_rounds: int = 64, batch: int = 8,
         classic_fallback_after_rounds: Optional[int] = 8,
+        stop_when_announced: bool = False,
     ) -> Optional[ViewChangeRecord]:
         """Run device batches until consensus decides a cut, then apply the
         view change. Returns the record, or None if no decision in budget.
@@ -474,7 +538,12 @@ class Simulator:
         Paxos recovery round among the live members (FastPaxos.java:189-195):
         the coordinator value-pick rule chooses among the members' actual
         fast-round votes (see _classic_round_winner), and the choice decides
-        iff live members form a majority (> N/2, Paxos.java:168,229)."""
+        iff live members form a majority (> N/2, Paxos.java:168,229).
+
+        ``stop_when_announced``: return (None) as soon as a proposal is
+        announced but undecided, leaving the announcement snapshot in
+        ``last_announcement`` -- the bridge's hook for informing real members
+        so their votes can join the tally before the decision."""
         t0 = time.perf_counter()
         rounds_done = 0
         while rounds_done < max_rounds:
@@ -523,6 +592,14 @@ class Simulator:
                     t0, (proposal_np, decided_group, decided_round)
                 )
             if announced_any:
+                self._last_announcement = (announced_np, proposal_np)
+                # stop only on a *group* (cut-detector) announcement: extern
+                # rows are host-registered real-member votes, not swarm
+                # proposals to inform anyone about
+                if stop_when_announced and announced_np[: self.config.groups].any():
+                    self.virtual_ms += rounds_done * self._round_ms
+                    self._billed_rounds += rounds_done
+                    return None
                 # rounds the announced proposal has actually been stalled --
                 # the fallback timer runs from propose(), not from the start
                 # of the dispatch batch (FastPaxos.java:105-107)
@@ -549,6 +626,13 @@ class Simulator:
         self.virtual_ms += rounds_done * self._round_ms
         self._billed_rounds += rounds_done
         return None
+
+    @property
+    def last_announcement(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """(announced[P], proposal[P, C]) snapshot from the most recent
+        dispatch that saw an undecided announcement; None in a fresh
+        configuration."""
+        return self._last_announcement
 
     @property
     def _round_ms(self) -> int:

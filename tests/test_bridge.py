@@ -263,3 +263,85 @@ def test_joiner_death_before_admission_reclaims_slot():
     assert not h.swarm.sim.pending_joiners
     assert len(h.swarm._free_slots) == free_before
     assert h.swarm.sim.membership_size == 16
+
+
+def test_quorum_reachable_only_with_real_members_vote():
+    """A real member's registered vote completes a fast-round quorum the
+    virtual members alone cannot reach: N=16 (15 virtual + 1 real), 3 virtual
+    crashed => quorum 13, live virtual voters 12, and the real member's
+    FastRoundPhase2bMessage is the 13th vote."""
+    h = BridgeHarness(n_virtual=15, capacity=20, seed=9)
+    cluster, _ = h.join_real_node("real-1")
+    assert h.swarm.sim.membership_size == 16
+    victims = np.array([1, 2, 3])
+    h.swarm.sim.crash(victims)
+    rec = h.swarm.pump(max_rounds=32, classic_fallback_after_rounds=None)
+    assert rec is not None, "real member's vote should complete the quorum"
+    assert not rec.via_classic_round
+    assert sorted(rec.cut) == [1, 2, 3]
+    assert h.swarm.sim.membership_size == 13
+    # the decision genuinely consumed the real member's registered vote
+    assert h.swarm.sim.auto_vote[h.swarm._slot_of[cluster.listen_address]] == False  # noqa: E712
+
+
+def test_quorum_blocked_when_real_members_vote_is_dropped():
+    """Control arm: same scenario, but the real member's vote broadcasts are
+    dropped on the wire -- 12 of 16 votes < quorum 13, so the fast round
+    stalls until the classic recovery round decides."""
+    from rapid_tpu.types import FastRoundPhase2bMessage
+
+    h = BridgeHarness(n_virtual=15, capacity=20, seed=9)
+    cluster, _ = h.join_real_node("real-1")
+    h.network.add_filter(
+        lambda s, d, m: not (
+            s == cluster.listen_address and isinstance(m, FastRoundPhase2bMessage)
+        )
+    )
+    h.swarm.sim.crash(np.array([1, 2, 3]))
+    rec = h.swarm.pump(max_rounds=32, classic_fallback_after_rounds=None)
+    assert rec is None, "12 received votes must not reach the quorum of 13"
+    rec = h.swarm.pump(max_rounds=16, classic_fallback_after_rounds=4)
+    assert rec is not None and rec.via_classic_round
+    assert sorted(rec.cut) == [1, 2, 3]
+
+
+def test_real_members_conflicting_vote_forces_classic_fallback():
+    """A real member that saw different evidence votes a *different* cut; its
+    conflicting vote denies the swarm's proposal the 13th vote it needs, and
+    the classic recovery round (coordinator value-pick over the actual
+    fast-round votes) decides the majority value."""
+    from rapid_tpu.types import AlertMessage, BatchedAlertMessage, EdgeStatus
+
+    h = BridgeHarness(n_virtual=15, capacity=20, seed=10)
+    cluster, _ = h.join_real_node("real-1")
+    victims = np.array([1, 2, 3])
+    h.swarm.sim.crash(victims)
+    # Asymmetric dissemination: before the swarm's own broadcast, the real
+    # member receives evidence for only a PARTIAL cut {1, 2} (K rings each,
+    # so its detector crosses H and latches announcedProposal) -- it then
+    # proposes and votes {1, 2}, and ignores the later {1, 2, 3} alerts.
+    src = h.swarm.endpoint(5)
+    partial = tuple(
+        AlertMessage(
+            edge_src=src,
+            edge_dst=h.swarm.endpoint(int(v)),
+            edge_status=EdgeStatus.DOWN,
+            configuration_id=cluster.get_current_configuration_id(),
+            ring_numbers=tuple(range(10)),
+        )
+        for v in (1, 2)
+    )
+    h.network.deliver(
+        src, cluster.listen_address, BatchedAlertMessage(src, partial), 1000
+    )
+    h.scheduler.run_for(300)  # real member proposes {1,2} and votes it
+    slot = h.swarm._slot_of[cluster.listen_address]
+    assert slot in h.swarm.sim._extern_voted, "conflicting vote not registered"
+    # fast round: 12 votes for {1,2,3} + 1 for {1,2} -- no value reaches 13
+    rec = h.swarm.pump(max_rounds=32, classic_fallback_after_rounds=None)
+    assert rec is None, "conflicting vote must block the fast quorum"
+    # the classic round picks the majority value (> N/4 rule) and decides
+    rec = h.swarm.pump(max_rounds=16, classic_fallback_after_rounds=4)
+    assert rec is not None and rec.via_classic_round
+    assert sorted(rec.cut) == [1, 2, 3]
+    assert h.swarm.sim.membership_size == 13
