@@ -99,6 +99,9 @@ def state_shardings(mesh: Mesh) -> SimState:
         vote_prop=rep,
         vote_new=rep,
         votes_recv=rep,
+        classic_rnd=rep,
+        classic_vrnd=rep,
+        classic_vval=rep,
         decided=rep,
         decided_group=rep,
         decided_round=rep,

@@ -188,8 +188,9 @@ class TpuSimMessaging:
             return Promise.completed(ConsensusResponse())
         if isinstance(msg, _CONSENSUS_TYPES):
             # classic-round traffic from real members is acknowledged; the
-            # swarm's recovery round is the host-side coordinator
-            # (Simulator._classic_round_winner)
+            # swarm's recovery exchange (Simulator._run_classic_round over
+            # sim/classic.py's device acceptor state) represents their slots
+            # as acceptors, with their registered fast votes as vvals
             return Promise.completed(ConsensusResponse())
         if isinstance(msg, LeaveMessage):
             sender_slot = self._slot_of.get(msg.sender)

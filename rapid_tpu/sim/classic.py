@@ -1,0 +1,196 @@
+"""Classic Paxos recovery round with per-node acceptor state on device.
+
+The simulation plane's fallback when the fast round stalls, with the same
+message-level semantics as the object plane's ``rapid_tpu.paxos`` (and the
+reference ``Paxos.java``), scaled to 100k+ virtual nodes: the coordinator
+exchange is host-driven (four hops -- phase1a broadcast, phase1b responses,
+phase2a broadcast, phase2b tally), but every acceptor's (rnd, vrnd, vval)
+lives in device arrays, so rank contention between concurrent coordinators is
+resolved by the acceptors themselves, not by a host-side shortcut.
+
+Mapping to the reference:
+
+- ranks are (round, node) pairs (Paxos.java:97-110,328-334) packed into one
+  int32 as ``round << RANK_BITS | node`` -- lexicographic order becomes
+  integer order. The fast round is rank (1, 1) (registerFastRoundVote,
+  Paxos.java:244-258); classic rounds start at round 2, so every classic rank
+  outranks the fast round.
+- the fast-round participation of each node is *derived* from the engine's
+  ``voted``/``vote_prop`` arrays rather than stored again, so the jitted hot
+  path never writes acceptor state.
+- phase1a/1b (``phase1``): an acceptor promises iff ``rank > rnd``
+  (Paxos.java:135-145); the device aggregates what the coordinator's
+  phase1b inbox would hold -- responder count, the max vrnd among voted
+  responders, per-value counts at that vrnd, and per-value counts overall.
+- the coordinator value-pick rule (``pick_value``) is Figure 2 of the Fast
+  Paxos paper as implemented by selectProposalUsingCoordinatorRule
+  (Paxos.java:269-326): a single value at the highest vrnd wins; else a
+  value with more than N/4 votes at that vrnd; else any reported vval; with
+  no valid vote the coordinator does not proceed.
+- phase2a/2b (``phase2``): an acceptor accepts iff ``rank >= rnd`` and
+  ``vrnd != rank`` (Paxos.java:205-213); the decision needs more than N/2
+  acceptances (Paxos.java:229-236).
+
+Delivery is uniform for recovery traffic: the per-group broadcast fault
+plane shapes the *fast* round (that is what makes it stall); the classic
+round models the post-stall repair among whoever is live.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import SimConfig, SimState
+
+# rank = round << RANK_BITS | node  (node = slot index; distinct per slot,
+# the reference uses an address hash for the same tie-breaking role)
+RANK_BITS = 21
+FAST_RANK = (1 << RANK_BITS) | 1  # registerFastRoundVote's (1, 1) rank
+
+
+def make_rank(round_no: int, node: int) -> int:
+    assert 2 <= round_no < (1 << (31 - RANK_BITS)), round_no
+    assert 0 <= node < (1 << RANK_BITS), node
+    return (round_no << RANK_BITS) | node
+
+
+def _effective(state: SimState):
+    """Acceptor state with the fast round folded in: a node that cast a fast
+    vote holds rnd = vrnd = FAST_RANK, vval = its fast vote, unless a classic
+    round already moved it further."""
+    fast = jnp.where(state.voted, jnp.int32(FAST_RANK), 0)
+    rnd = jnp.maximum(state.classic_rnd, fast)
+    vrnd = jnp.maximum(state.classic_vrnd, fast)
+    vval = jnp.where(
+        state.classic_vrnd >= fast, state.classic_vval,
+        jnp.where(state.voted, state.vote_prop, -1),
+    )
+    return rnd, vrnd, vval
+
+
+class Phase1Summary(NamedTuple):
+    promised: jax.Array  # int32[] responders (> N/2 needed)
+    max_vrnd: jax.Array  # int32[] highest vrnd among voted responders (0=none)
+    at_max: jax.Array  # int32[P] per-VALUE votes at max_vrnd (row-pooled)
+    any_vval: jax.Array  # int32[P] per-VALUE votes at any vrnd (row-pooled)
+    rep: jax.Array  # int32[P] canonical (lowest) row holding each row's value
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def phase1(config: SimConfig, state: SimState, rank: jax.Array):
+    """Phase1a broadcast + the aggregate of the phase1b responses.
+
+    Every live acceptor with ``rnd < rank`` promises (bumps rnd) and reports
+    its (vrnd, vval); the summary is what the coordinator's phase1b inbox
+    would contain (Paxos.java:135-145,160-190). Votes are counted per
+    *value*: proposal rows holding identical cut masks (a group row and an
+    extern row interned from real members' votes) pool their counts through
+    the same [P, P] equality matrix as the fast-round tally, with ``rep``
+    naming each value's canonical row."""
+    live = state.active & state.alive
+    rnd, vrnd, vval = _effective(state)
+    promise = live & (rank > rnd)
+    classic_rnd = jnp.where(promise, rank, state.classic_rnd)
+
+    has_vote = promise & (vrnd > 0) & (vval >= 0)
+    max_vrnd = jnp.max(jnp.where(has_vote, vrnd, 0))
+    p = config.proposal_rows
+    rows = jnp.clip(vval, 0, p - 1)
+    at_max_row = (
+        jnp.zeros(p, jnp.int32)
+        .at[rows]
+        .add((has_vote & (vrnd == max_vrnd)).astype(jnp.int32))
+    )
+    any_row = jnp.zeros(p, jnp.int32).at[rows].add(has_vote.astype(jnp.int32))
+    eq = jnp.all(
+        state.proposal[:, None, :] == state.proposal[None, :, :], axis=2
+    ).astype(jnp.int32)  # [P, P]
+    summary = Phase1Summary(
+        promised=promise.sum(),
+        max_vrnd=max_vrnd,
+        at_max=eq @ at_max_row,
+        any_vval=eq @ any_row,
+        rep=jnp.argmax(eq, axis=1).astype(jnp.int32),
+    )
+    return dataclasses.replace(state, classic_rnd=classic_rnd), summary
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def phase2(config: SimConfig, state: SimState, rank: jax.Array, row: jax.Array):
+    """Phase2a broadcast + the phase2b acceptance count.
+
+    An acceptor accepts iff ``rnd <= rank`` and ``vrnd != rank``
+    (Paxos.java:205-213); more than N/2 acceptances decide
+    (Paxos.java:229-236)."""
+    live = state.active & state.alive
+    rnd, vrnd, _ = _effective(state)
+    accept = live & (rank >= rnd) & (vrnd != rank)
+    state = dataclasses.replace(
+        state,
+        classic_rnd=jnp.where(accept, rank, state.classic_rnd),
+        classic_vrnd=jnp.where(accept, rank, state.classic_vrnd),
+        classic_vval=jnp.where(accept, row, state.classic_vval),
+    )
+    return state, accept.sum()
+
+
+class ClassicCoordinator:
+    """One coordinator's view of one classic round (host side of
+    Paxos.java:97-132,160-236). Multiple instances may run concurrently
+    against the same simulator; the shared device acceptor state arbitrates
+    their rank contention."""
+
+    def __init__(self, sim, round_no: int, slot: int) -> None:
+        self.sim = sim
+        self.slot = slot
+        self.rank = make_rank(round_no, slot)
+        self._summary: Optional[Phase1Summary] = None
+
+    def phase1(self) -> bool:
+        """Run phase1a/1b; True iff a majority of the membership promised."""
+        self.sim.state, summary = phase1(
+            self.sim.config, self.sim.state, jnp.int32(self.rank)
+        )
+        self._summary = jax.device_get(summary)
+        n = int(self.sim.active.sum())
+        return int(self._summary.promised) > n // 2
+
+    def pick_value(self) -> Optional[int]:
+        """The Fig.-2 coordinator rule over the phase1b aggregate
+        (Paxos.java:269-326), on value-pooled counts (canonical rows via
+        ``rep``). Returns the chosen proposal row, or None when no responder
+        reported a valid vote (the coordinator must not proceed)."""
+        s = self._summary
+        assert s is not None, "phase1 must run first"
+        n = int(self.sim.active.sum())
+        at_max = np.asarray(s.at_max)
+        rep = np.asarray(s.rep)
+        # distinct VALUES at the max vrnd, each named by its canonical row
+        candidates = np.unique(rep[at_max > 0])
+        if len(candidates) == 1:
+            return int(candidates[0])
+        if len(candidates) > 1:
+            over = candidates[at_max[candidates] > n // 4]
+            if len(over):
+                return int(over[0])
+        reported = np.unique(rep[np.asarray(s.any_vval) > 0])
+        if len(reported):
+            return int(reported[0])
+        return None
+
+    def phase2(self, row: int) -> Optional[int]:
+        """Run phase2a/2b for ``row``; returns the row iff a majority
+        accepted (the decision), else None (outranked by a concurrent
+        coordinator)."""
+        self.sim.state, accepted = phase2(
+            self.sim.config, self.sim.state, jnp.int32(self.rank),
+            jnp.int32(row),
+        )
+        n = int(self.sim.active.sum())
+        return row if int(jax.device_get(accepted)) > n // 2 else None

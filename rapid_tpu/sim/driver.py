@@ -42,6 +42,11 @@ from .topology import (
 )
 
 
+# the classic exchange's delivery hops (phase1a, 1b, 2a, 2b), billed at the
+# same one-round-per-hop quantization as fast-round vote propagation
+_CLASSIC_ROUND_HOPS = 4
+
+
 @dataclass
 class ViewChangeRecord:
     """One decided configuration change."""
@@ -183,11 +188,12 @@ class Simulator:
 
     def _fresh_state(self, seed: int) -> SimState:
         """Fresh-configuration state, built on device (engine.device_initial_state)."""
-        # extern proposal rows and the per-sender vote dedup are
-        # per-configuration, like every other consensus latch
+        # extern proposal rows, the per-sender vote dedup, and the classic
+        # round counter are per-configuration, like every consensus latch
         self._extern_rows: dict = {}  # proposal-mask bytes -> extern row
         self._extern_voted: Set[int] = set()
         self._last_announcement = None
+        self._classic_attempts = 0
         if self._ring_rank_dirty:
             # identities assigned since the last rebuild (joiner seating)
             self._ring_rank_dev = jnp.asarray(self.cluster.ring_rank())
@@ -534,11 +540,11 @@ class Simulator:
         If the fast round stalls (proposals announced but no value's received
         votes reach the 3/4 supermajority in any group's tally -- too many
         members crashed, blind, or holding diverging proposals) for
-        ``classic_fallback_after_rounds`` rounds, the host runs the classic
-        Paxos recovery round among the live members (FastPaxos.java:189-195):
-        the coordinator value-pick rule chooses among the members' actual
-        fast-round votes (see _classic_round_winner), and the choice decides
-        iff live members form a majority (> N/2, Paxos.java:168,229).
+        ``classic_fallback_after_rounds`` rounds, a classic Paxos recovery
+        round runs with per-node acceptor state on device (sim/classic.py,
+        FastPaxos.java:189-195): phase1 promises, the coordinator value-pick
+        rule over the reported (vrnd, vval) pairs, and phase2 acceptances,
+        deciding iff a majority accepts (Paxos.java:229-236).
 
         ``stop_when_announced``: return (None) as soon as a proposal is
         announced but undecided, leaving the announcement snapshot in
@@ -608,18 +614,16 @@ class Simulator:
                     classic_fallback_after_rounds is not None
                     and stalled_rounds >= classic_fallback_after_rounds
                 ):
-                    voted_np, vote_prop_np = jax.device_get(
-                        (self.state.voted, self.state.vote_prop)
-                    )
-                    winner = self._classic_round_winner(
-                        announced_np, proposal_np, voted_np, vote_prop_np
-                    )
+                    winner = self._run_classic_round()
                     if winner is not None:
                         # no need to write the decision back to the device:
                         # _apply_view_change consumes the fetched arrays and
-                        # replaces the device state wholesale
+                        # replaces the device state wholesale. The exchange's
+                        # four hops (1a/1b/2a/2b) bill as four rounds, like
+                        # every other delivery hop.
                         record = self._apply_view_change(
-                            t0, (proposal_np, winner, round_np)
+                            t0, (proposal_np, winner,
+                                 int(round_np) + _CLASSIC_ROUND_HOPS)
                         )
                         record.via_classic_round = True
                         return record
@@ -651,48 +655,33 @@ class Simulator:
             )
         return self._sharded_runs[key]
 
-    def _classic_round_winner(
-        self,
-        announced: np.ndarray,
-        proposals: np.ndarray,
-        voted: np.ndarray,
-        vote_prop: np.ndarray,
-    ) -> Optional[int]:
-        """Host-side classic recovery round: the coordinator value-pick rule
-        over the members' actual fast-round votes (Paxos.java:269-326),
-        deciding iff live members form a majority (Paxos.java:168,229).
+    def _run_classic_round(self) -> Optional[int]:
+        """One classic recovery attempt with per-node acceptor state on
+        device (sim/classic.py): the lowest live slot coordinates -- the
+        deterministic stand-in for whichever node's expovariate fallback
+        timer fires first (FastPaxos.java:189-203) -- at a round number that
+        grows with each failed attempt, so retries outrank earlier rounds.
+        Returns the decided proposal row, or None if this attempt failed
+        (no quorum, no valid vote reported, or outranked)."""
+        from .classic import RANK_BITS, ClassicCoordinator
 
-        Phase-1b responses come from live members only; each reports the vote
-        it cast in the fast round (its vval; nothing if it never voted). All
-        fast-round votes are at the same (fast) rank, so the rule reduces to:
-        a single distinct voted value wins; otherwise a value with more than
-        N/4 phase-1b votes wins; otherwise any announced value may be picked.
-        Returns the winning proposal row, or None if no decision is possible."""
-        n = int(self.active.sum())
         live = self.active & self.alive
+        n = int(self.active.sum())
         if int(live.sum()) <= n // 2:
             return None
-        if not announced.any():
-            return None
-        # per-row vote counts among live responders (the quorum's vvals)
-        responders = live & voted
-        row_votes = np.bincount(
-            vote_prop[responders], minlength=len(announced)
+        if 2 + self._classic_attempts >= (1 << (31 - RANK_BITS)):
+            return None  # rank space exhausted: stay stalled gracefully
+        self._classic_attempts += 1
+        coordinator = ClassicCoordinator(
+            self, round_no=1 + self._classic_attempts,
+            slot=int(np.flatnonzero(live)[0]),
         )
-        # pool rows holding identical proposal values
-        distinct: dict = {}
-        for row in np.flatnonzero(announced):
-            key = proposals[row].tobytes()
-            distinct.setdefault(key, [0, int(row)])
-            distinct[key][0] += int(row_votes[row])
-        voted_values = [v for v in distinct.values() if v[0] > 0]
-        if len(voted_values) == 1:
-            return voted_values[0][1]
-        for votes, row in voted_values:
-            if votes > n // 4:
-                return row
-        # no voted value is privileged: any announced value is safe to pick
-        return next(iter(distinct.values()))[1]
+        if not coordinator.phase1():
+            return None
+        row = coordinator.pick_value()
+        if row is None:
+            return None
+        return coordinator.phase2(row)
 
     def _apply_view_change(
         self,

@@ -123,6 +123,13 @@ class SimState:
     vote_prop: jax.Array  # int32[C] proposal row each voter voted for
     vote_new: jax.Array  # bool[C] votes cast this round, arriving next round
     votes_recv: jax.Array  # bool[G, C] votes received per (group, sender)
+    # Classic-Paxos acceptor state (sim/classic.py; Paxos.java:63-70). Ranks
+    # are (round, node) pairs packed into int32 (round << RANK_BITS | node);
+    # 0 = never participated. The fast round's implicit rank/vote is derived
+    # from voted/vote_prop, so the hot path never writes these.
+    classic_rnd: jax.Array  # int32[C] highest rank promised (phase1a)
+    classic_vrnd: jax.Array  # int32[C] rank last accepted at (phase2a)
+    classic_vval: jax.Array  # int32[C] accepted proposal row (-1 = none)
     decided: jax.Array  # bool[] consensus reached
     decided_group: jax.Array  # int32[] proposal row whose value won
     decided_round: jax.Array  # int32[] round at which decision happened
@@ -175,6 +182,9 @@ def initial_state(
         vote_prop=jnp.zeros(c, jnp.int32),
         vote_new=jnp.zeros(c, bool),
         votes_recv=jnp.zeros((g, c), bool),
+        classic_rnd=jnp.zeros(c, jnp.int32),
+        classic_vrnd=jnp.zeros(c, jnp.int32),
+        classic_vval=jnp.full(c, -1, jnp.int32),
         decided=jnp.asarray(False),
         decided_group=jnp.asarray(0, jnp.int32),
         decided_round=jnp.asarray(0, jnp.int32),
@@ -697,6 +707,9 @@ def device_initial_state(
         vote_prop=jnp.zeros(c, jnp.int32),
         vote_new=jnp.zeros(c, bool),
         votes_recv=jnp.zeros((g, c), bool),
+        classic_rnd=jnp.zeros(c, jnp.int32),
+        classic_vrnd=jnp.zeros(c, jnp.int32),
+        classic_vval=jnp.full(c, -1, jnp.int32),
         decided=jnp.asarray(False),
         decided_group=jnp.asarray(0, jnp.int32),
         decided_round=jnp.asarray(0, jnp.int32),

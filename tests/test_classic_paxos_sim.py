@@ -1,0 +1,187 @@
+"""Classic Paxos recovery in the simulation plane: per-node acceptor state
+on device, host-driven coordinator exchange (sim/classic.py).
+
+The scale-out counterpart of tests/test_paxos.py: the same rank-contention
+and value-safety properties the object plane pins at tens of nodes
+(Paxos.java:97-236,269-326), exercised against device acceptor arrays at
+1000+ virtual nodes, including dueling concurrent coordinators.
+"""
+
+import numpy as np
+import pytest
+
+from rapid_tpu.sim.classic import ClassicCoordinator, make_rank
+from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.sim.engine import SimConfig
+
+
+def _stalled_sim(n=1000, n_blind=260, seed=7):
+    """A cluster whose fast round genuinely cannot decide: a blind delivery
+    class of more than F = floor((N-1)/4) members never hears the alert
+    broadcasts, so it never votes and live voters < quorum -- while every
+    victim keeps its full live observer set (exact cut) and the live
+    majority needed for classic recovery exists."""
+    config = SimConfig(capacity=n, groups=2)
+    sim = Simulator(n, config=config, seed=seed)
+    group_of = np.zeros(n, dtype=np.int32)
+    group_of[n - n_blind:] = 1
+    sim.set_delivery_groups(group_of)
+    victims = np.array([5, 6])
+    sim.crash(victims)
+    sim.drop_broadcasts(1, np.arange(n))  # group 1 hears nothing at all
+    rec = sim.run_until_decision(max_rounds=16, classic_fallback_after_rounds=None)
+    assert rec is None, "fast round must stall for these tests"
+    announced, proposals = sim.last_announcement
+    assert announced[0] and not announced[1]
+    np.testing.assert_array_equal(np.flatnonzero(proposals[0]), victims)
+    return sim, victims
+
+
+def test_rank_packing_orders_rounds_then_nodes():
+    assert make_rank(2, 0) > (1 << 21 | 1)  # any classic round beats fast
+    assert make_rank(2, 5) < make_rank(2, 6) < make_rank(3, 0)
+
+
+def test_single_coordinator_recovers_stalled_round_at_1k():
+    sim, victims = _stalled_sim()
+    live = np.flatnonzero(sim.active & sim.alive)
+    c = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
+    assert c.phase1()  # 998 live promises > 500
+    row = c.pick_value()
+    assert row == 0  # the single value at the max (fast) vrnd
+    assert c.phase2(row) == 0
+    # the decided value is the fast round's proposal: the crashed set
+    np.testing.assert_array_equal(
+        np.flatnonzero(np.asarray(sim.state.proposal)[row]), victims
+    )
+
+
+def test_dueling_coordinators_interleaved_phase1_at_1k():
+    """Two concurrent coordinators in the same round: the higher rank's
+    phase1a outranks the lower's promises, the lower coordinator's phase2a
+    is rejected by the acceptors, and only the higher decides -- the
+    acceptor-side arbitration of Paxos.java:135-145,205-213."""
+    sim, victims = _stalled_sim(seed=8)
+    live = np.flatnonzero(sim.active & sim.alive)
+    c_low = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
+    c_high = ClassicCoordinator(sim, round_no=2, slot=int(live[1]))
+    assert c_low.rank < c_high.rank
+
+    assert c_low.phase1()
+    assert c_high.phase1()  # re-promises every acceptor at the higher rank
+    # the outranked coordinator's phase2 must fail...
+    row_low = c_low.pick_value()
+    assert c_low.phase2(row_low) is None
+    # ...and must not have corrupted acceptor state for the winner
+    row_high = c_high.pick_value()
+    assert row_high == row_low == 0
+    assert c_high.phase2(row_high) == 0
+    np.testing.assert_array_equal(
+        np.flatnonzero(np.asarray(sim.state.proposal)[0]), victims
+    )
+
+
+def test_late_coordinator_must_choose_the_decided_value_at_1k():
+    """Safety across rounds: once a value is chosen, any later coordinator's
+    phase1b aggregate reports it at the highest vrnd, and the value-pick
+    rule forces re-proposing the same value (Fig. 2 / Paxos.java:269-326)."""
+    sim, victims = _stalled_sim(seed=9)
+    live = np.flatnonzero(sim.active & sim.alive)
+    first = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
+    assert first.phase1()
+    decided = first.phase2(first.pick_value())
+    assert decided == 0
+
+    late = ClassicCoordinator(sim, round_no=3, slot=int(live[5]))
+    assert late.phase1()
+    # every live acceptor reports vval=0 at vrnd=first.rank (the max)
+    assert int(late._summary.max_vrnd) == first.rank
+    assert late.pick_value() == decided
+    assert late.phase2(late.pick_value()) == decided
+
+
+def test_no_valid_vote_means_no_phase2():
+    """A quorum of never-voted acceptors yields no vval: the coordinator
+    must not proceed (Paxos.java:311-326 comment) -- nothing is invented."""
+    sim = Simulator(40, seed=11)  # healthy cluster: nobody ever voted
+    c = ClassicCoordinator(sim, round_no=2, slot=0)
+    assert c.phase1()
+    assert c.pick_value() is None
+
+
+def test_conflicting_fast_votes_pick_the_quarter_majority_value_at_1k():
+    """Diverging fast votes (two delivery groups proposing different cuts):
+    the rule's middle clause picks the value with more than N/4 votes at the
+    max vrnd."""
+    n = 1000
+    config = SimConfig(capacity=n, groups=2)
+    sim = Simulator(n, config=config, seed=12)
+    group_of = np.zeros(n, dtype=np.int32)
+    group_of[700:] = 1  # 300-member minority class
+    sim.set_delivery_groups(group_of)
+    victims = np.array([10, 11])
+    sim.crash(victims)
+    # the minority group misses alerts about victim 11: it proposes {10}
+    # while the majority proposes {10, 11} -- real proposal divergence
+    sim.drop_broadcasts(1, np.asarray(sim.state.observers)[11])
+    rec = sim.run_until_decision(max_rounds=20, classic_fallback_after_rounds=None)
+    if rec is not None:
+        pytest.skip("fault plane did not produce divergence for this seed")
+    live = np.flatnonzero(sim.active & sim.alive)
+    c = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
+    assert c.phase1()
+    row = c.pick_value()
+    proposals = np.asarray(sim.state.proposal)
+    # the chosen value is the one with > N/4 = 250 votes: the majority cut
+    np.testing.assert_array_equal(np.flatnonzero(proposals[row]), victims)
+    assert c.phase2(row) == row
+
+
+def test_driver_fallback_uses_device_exchange_at_1k():
+    """End-to-end through run_until_decision: the stalled fast round recovers
+    via the device classic exchange, bills the four hops, and applies the
+    correct cut."""
+    sim, victims = _stalled_sim(seed=13)
+    rec = sim.run_until_decision(max_rounds=16, classic_fallback_after_rounds=2)
+    assert rec is not None and rec.via_classic_round
+    np.testing.assert_array_equal(np.sort(rec.cut), victims)
+    assert sim.membership_size == 998
+    # acceptor state persisted on device through the exchange is reset with
+    # the new configuration
+    assert int(np.asarray(sim.state.classic_rnd).max()) == 0
+
+
+def test_phase1_pools_identical_values_across_rows():
+    """A value's phase1b votes pool across proposal rows holding the same
+    cut -- a group row and an extern row interned from real members' votes
+    (register_extern_vote) -- exactly like the fast tally's equality pooling;
+    the reference keys its phase1b counters by value, not by row
+    (Paxos.java:276-306)."""
+    n = 1000
+    config = SimConfig(capacity=n, groups=2, extern_proposals=2)
+    sim = Simulator(n, config=config, seed=21)
+    group_of = np.zeros(n, dtype=np.int32)
+    group_of[n - 260:] = 1
+    sim.set_delivery_groups(group_of)
+    victims = np.array([5, 6])
+    sim.crash(victims)
+    sim.drop_broadcasts(1, np.arange(n))
+    rec = sim.run_until_decision(max_rounds=16, classic_fallback_after_rounds=None)
+    assert rec is None
+    # ten blind-group members (who never heard the alerts, hence never voted)
+    # vote the same cut through the extern path, as bridged real nodes would
+    blind = np.flatnonzero((group_of == 1) & sim.active & sim.alive)[:10]
+    for slot in blind:
+        sim.auto_vote[int(slot)] = False
+        assert sim.register_extern_vote(int(slot), victims)
+    live = np.flatnonzero(sim.active & sim.alive)
+    c = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
+    assert c.phase1()
+    at_max = np.asarray(c._summary.at_max)
+    rep = np.asarray(c._summary.rep)
+    extern_row = 2  # first extern row (after the 2 group rows)
+    # 738 group-0 voters + 10 extern voters pool into one value of 748
+    assert at_max[0] == at_max[extern_row] == 748
+    assert rep[extern_row] == 0  # canonical row of the shared value
+    assert c.pick_value() == 0
+    assert c.phase2(0) == 0
