@@ -90,6 +90,7 @@ def state_shardings(mesh: Mesh) -> SimState:
         fd_seen=row,
         alerted=row,
         reports=rep,
+        arrival_hist=rep,
         seen_down=rep,
         announced=rep,
         announced_round=rep,
@@ -114,7 +115,8 @@ def input_shardings(mesh: Mesh) -> RoundInputs:
     row = NamedSharding(mesh, P(mesh.axis_names, None))
     rep = NamedSharding(mesh, P())
     return RoundInputs(alive=rep, probe_drop=row, drop_prob=rep,
-                       join_reports=rep, down_reports=rep, deliver=rep)
+                       join_reports=rep, down_reports=rep, deliver=rep,
+                       deliver_delay=rep)
 
 
 def place_state(state: SimState, mesh: Mesh) -> SimState:

@@ -179,6 +179,9 @@ class Simulator:
         self._zero_ck = self._rep(zeros_ck)  # join/down report roles
         self._zero_drop_prob = self._rep(np.zeros(c, np.float32))
         self._ones_deliver = self._rep(np.ones((g, c), bool))
+        self._zero_delay = self._rep(np.zeros((g, c), np.int32))
+        self._deliver_delay = np.zeros((g, c), dtype=np.int32)
+        self._deliver_delay_dev: Optional[jax.Array] = None
         self._alive_dev: Optional[jax.Array] = None
         self._probe_drop_dev: Optional[jax.Array] = None
         self._subjects_host: Optional[np.ndarray] = None
@@ -317,6 +320,8 @@ class Simulator:
         self._ingress_partitioned.clear()
         self._drop_prob[:] = 0.0
         self._deliver[:] = True
+        self._deliver_delay[:] = 0
+        self._deliver_delay_dev = None
         self._probe_drop_dev = None
 
     # ------------------------------------------------------------------ #
@@ -340,6 +345,22 @@ class Simulator:
         """Group ``receiver_group`` stops hearing broadcasts originating from
         ``sender_nodes`` (models lossy/partitioned dissemination)."""
         self._deliver[receiver_group, np.atleast_1d(sender_nodes)] = False
+
+    def delay_broadcasts(
+        self, receiver_group: int, sender_nodes: np.ndarray, rounds: int
+    ) -> None:
+        """Heterogeneous broadcast latency (timing, not loss): alerts from
+        ``sender_nodes`` reach ``receiver_group`` ``rounds`` rounds after
+        firing. Requires config.max_delivery_delay >= rounds. With staggered
+        FD phases this reproduces the paper's Fig.-11 regime -- nodes cross
+        H at different times holding different report snapshots and can
+        propose different cuts purely from timing."""
+        assert 0 <= rounds <= self.config.max_delivery_delay, (
+            f"delay {rounds} exceeds config.max_delivery_delay="
+            f"{self.config.max_delivery_delay}"
+        )
+        self._deliver_delay[receiver_group, np.atleast_1d(sender_nodes)] = rounds
+        self._deliver_delay_dev = None
 
     # ------------------------------------------------------------------ #
     # Bridged (external) voters
@@ -457,7 +478,15 @@ class Simulator:
                 if self._deliver.all()
                 else self._rep(self._deliver)
             ),
+            deliver_delay=self._deliver_delay_cached(),
         )
+
+    def _deliver_delay_cached(self) -> jax.Array:
+        if not self._deliver_delay.any():
+            return self._zero_delay
+        if self._deliver_delay_dev is None:
+            self._deliver_delay_dev = self._rep(self._deliver_delay)
+        return self._deliver_delay_dev
 
     # ------------------------------------------------------------------ #
     # Joins

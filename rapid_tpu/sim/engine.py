@@ -93,6 +93,14 @@ class SimConfig:
     # by bridged real nodes (sim/bridge.py registers their actual fast-round
     # votes into these rows). 0 = all-simulated cluster.
     extern_proposals: int = 0
+    # Heterogeneous broadcast LATENCY (the paper's Fig.-11 conflict regime):
+    # an alert from sender s reaches group g ``deliver_delay[g, s]`` rounds
+    # after it fires (0..max_delivery_delay). Nothing is lost -- but groups
+    # see different interleavings of the alert stream, so with staggered FD
+    # phases they can cross H at different times holding different report
+    # snapshots and propose *different* cuts, purely from timing. 0 disables
+    # the delay buffer entirely (static).
+    max_delivery_delay: int = 0
 
     @property
     def proposal_rows(self) -> int:
@@ -114,6 +122,7 @@ class SimState:
     fd_seen: jax.Array  # int32[C, K] probes recorded, saturating at W
     alerted: jax.Array  # bool[C, K] edge already reported DOWN
     reports: jax.Array  # bool[G, C, K] per-group report tables (dst, ring)
+    arrival_hist: jax.Array  # bool[Dmax, C, K] DOWN alerts aged 1..Dmax rounds
     seen_down: jax.Array  # bool[G] group saw a DOWN alert this configuration
     announced: jax.Array  # bool[P] proposal row holds an announced value
     announced_round: jax.Array  # int32[] round of the first announcement
@@ -148,6 +157,7 @@ class RoundInputs:
     join_reports: jax.Array  # bool[C, K] UP-alert reports for joining slots
     down_reports: jax.Array  # bool[C, K] proactive DOWN reports (graceful leave)
     deliver: jax.Array  # bool[G, C] does group g hear broadcasts from node i
+    deliver_delay: jax.Array  # int32[G, C] broadcast latency (rounds) per edge
 
 
 def initial_state(
@@ -173,6 +183,7 @@ def initial_state(
         fd_seen=jnp.zeros((c, k), jnp.int32),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
+        arrival_hist=jnp.zeros((config.max_delivery_delay, c, k), bool),
         seen_down=jnp.zeros(g, bool),
         announced=jnp.zeros(p, bool),
         announced_round=jnp.asarray(0, jnp.int32),
@@ -228,11 +239,33 @@ def route_and_tally(
     and the round increment on top.
     """
     sender = state.observers  # [C, K]
-    arrivals = down_arrivals | inputs.join_reports  # [C, K]
-    if uniform_delivery:
+    arrival_hist = state.arrival_hist
+    if config.max_delivery_delay > 0:
+        # Heterogeneous latency: an alert fired d rounds ago sits in
+        # hist[d]; group g reads the slot its (group, sender) delay names,
+        # so each alert reaches each group exactly once, at fire + delay.
+        # Join reports stay delay-0 (the experiment axis is DOWN timing).
+        hist = jnp.concatenate(
+            [down_arrivals[None], arrival_hist], axis=0
+        )  # [Dmax+1, C, K]
+        arrival_hist = hist[: config.max_delivery_delay]
+        delay_gck = inputs.deliver_delay[:, sender]  # [G, C, K]
+        c_idx = jnp.arange(config.capacity, dtype=jnp.int32)[None, :, None]
+        k_idx = jnp.arange(config.k, dtype=jnp.int32)[None, None, :]
+        arrived = hist[delay_gck, c_idx, k_idx]  # [G, C, K]
+        joins = inputs.join_reports[None, :, :]
+        if not uniform_delivery:
+            deliver = inputs.deliver[:, sender]  # [G, C, K]
+            arrived = arrived & deliver
+            joins = joins & deliver  # drop masks gate UP reports here too
+        reports = state.reports | arrived | joins
+        seen_down = state.seen_down | jnp.any(arrived, axis=(1, 2))
+    elif uniform_delivery:
+        arrivals = down_arrivals | inputs.join_reports  # [C, K]
         reports = state.reports | arrivals[None, :, :]
         seen_down = state.seen_down | jnp.any(down_arrivals)
     else:
+        arrivals = down_arrivals | inputs.join_reports  # [C, K]
         deliver = inputs.deliver[:, sender]  # [G, C, K]
         reports = state.reports | (arrivals[None, :, :] & deliver)
         seen_down = state.seen_down | jnp.any(
@@ -342,6 +375,7 @@ def route_and_tally(
     return dataclasses.replace(
         state,
         reports=reports,
+        arrival_hist=arrival_hist,
         seen_down=seen_down,
         announced=announced,
         announced_round=announced_round,
@@ -601,6 +635,7 @@ def run_until_decided_const(
         & ~jnp.any(state.seen_down)
         & ~jnp.any(state.voted)
         & ~jnp.any(state.vote_new)
+        & ~jnp.any(state.arrival_hist)
         & ~jnp.any(inputs.join_reports)
     )
     first_arrival = jnp.min(fire_dst)  # == `never` when no edge will fire
@@ -698,6 +733,7 @@ def device_initial_state(
         fd_seen=jnp.zeros((c, k), jnp.int32),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
+        arrival_hist=jnp.zeros((config.max_delivery_delay, c, k), bool),
         seen_down=jnp.zeros(g, bool),
         announced=jnp.zeros(p, bool),
         announced_round=jnp.asarray(0, jnp.int32),
@@ -726,6 +762,7 @@ def const_inputs(
     join_reports: Optional[np.ndarray] = None,
     deliver: Optional[np.ndarray] = None,
     down_reports: Optional[np.ndarray] = None,
+    deliver_delay: Optional[np.ndarray] = None,
 ) -> RoundInputs:
     """A single-round fault plane (for run_rounds_const)."""
     c, k, g = config.capacity, config.k, config.groups
@@ -736,4 +773,9 @@ def const_inputs(
         join_reports=jnp.zeros((c, k), bool) if join_reports is None else jnp.asarray(join_reports),
         down_reports=jnp.zeros((c, k), bool) if down_reports is None else jnp.asarray(down_reports),
         deliver=jnp.ones((g, c), bool) if deliver is None else jnp.asarray(deliver),
+        deliver_delay=(
+            jnp.zeros((g, c), jnp.int32)
+            if deliver_delay is None
+            else jnp.asarray(deliver_delay, dtype=jnp.int32)
+        ),
     )
