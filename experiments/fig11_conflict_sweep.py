@@ -7,6 +7,10 @@ dropped). 18 trials per skew: seeds 0-5 x victim pairs {5,40}, {11,52},
 {3,20}. A trial conflicts when the two classes announce unequal proposals;
 every conflict is then driven through the classic fallback to convergence.
 
+``run_trial`` is the single definition of the regime -- the fast regression
+(tests/test_timing_conflicts.py) imports it, so the published table and its
+test can never desynchronize.
+
 Run: python experiments/fig11_conflict_sweep.py   (~3 min on CPU jax)
 """
 
@@ -27,19 +31,22 @@ SKEWS = (0, 2, 5, 9)
 N = 64
 
 
-def trial(seed, victims, skew):
+def run_trial(seed, victims, skew, n=N, rpi=10, fallback=None):
+    """One scenario: two victims crash; delivery class 1 hears victim A's
+    observers ``skew`` sub-rounds late. Returns (conflict, record, sim)."""
     config = SimConfig(
-        capacity=N, rounds_per_interval=10, groups=2,
+        capacity=n, rounds_per_interval=rpi, groups=2,
         max_delivery_delay=max(skew, 1),
     )
-    sim = Simulator(N, config=config, seed=seed)
-    sim.set_delivery_groups((np.arange(N) % 2).astype(np.int32))
+    sim = Simulator(n, config=config, seed=seed)
+    sim.set_delivery_groups((np.arange(n) % 2).astype(np.int32))
     victims = np.array(victims)
     sim.crash(victims)
     if skew:
-        sim.delay_broadcasts(1, np.asarray(sim.state.observers)[victims[0]], skew)
+        obs_a = np.asarray(sim.state.observers)[victims[0]]
+        sim.delay_broadcasts(1, obs_a, skew)
     rec = sim.run_until_decision(
-        max_rounds=200, batch=40, classic_fallback_after_rounds=None
+        max_rounds=200, batch=40, classic_fallback_after_rounds=fallback
     )
     conflict = False
     if sim.last_announcement is not None:
@@ -48,17 +55,23 @@ def trial(seed, victims, skew):
             announced[:2].all()
             and not np.array_equal(proposals[0], proposals[1])
         )
-    converged = rec is not None
-    if not converged:
-        # drive the stalled conflict through the classic fallback
-        while sim.membership_size != N - len(victims):
-            follow = sim.run_until_decision(
-                max_rounds=300, batch=50, classic_fallback_after_rounds=20
-            )
-            assert follow is not None, "fallback failed to converge"
-        converged = True
-    assert not sim.active[victims].any()
-    return conflict, rec is None
+    return conflict, rec, sim
+
+
+def drive_to_convergence(sim, n_final, max_view_changes=3):
+    """Classic-fallback recovery until membership is exactly ``n_final``;
+    bounded so a protocol anomaly fails loudly instead of hanging."""
+    for _ in range(max_view_changes):
+        if sim.membership_size == n_final:
+            return
+        follow = sim.run_until_decision(
+            max_rounds=300, batch=50, classic_fallback_after_rounds=20
+        )
+        assert follow is not None, "fallback failed to converge"
+    assert sim.membership_size == n_final, (
+        f"membership {sim.membership_size} != {n_final} after "
+        f"{max_view_changes} view changes"
+    )
 
 
 def main():
@@ -68,10 +81,12 @@ def main():
         conflicts = stalls = trials = 0
         for seed in SEEDS:
             for victims in VICTIM_PAIRS:
-                c, stalled = trial(seed, victims, skew)
+                conflict, rec, sim = run_trial(seed, victims, skew)
                 trials += 1
-                conflicts += c
-                stalls += stalled
+                conflicts += conflict
+                stalls += rec is None
+                drive_to_convergence(sim, N - len(victims))
+                assert not sim.active[np.array(victims)].any()
         rows["conflict rate"].append(f"{conflicts}/{trials}")
         rows["fast round stalled"].append(f"{stalls}/{trials}")
         print(f"skew {skew}: conflicts {conflicts}/{trials}, "

@@ -1,0 +1,93 @@
+"""Fixed identities and message samples shared by the golden-vector
+generator and tests/test_golden_parity.py.
+
+Everything here is deliberately hard-coded: the golden contract freezes what
+these exact inputs must hash/order/serialize to, so a regression cannot move
+both the implementation and the expectation at once.
+"""
+
+from __future__ import annotations
+
+from rapid_tpu.types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    ConsensusResponse,
+    EdgeStatus,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    JoinMessage,
+    JoinResponse,
+    JoinStatusCode,
+    LeaveMessage,
+    NodeId,
+    NodeStatus,
+    Phase1aMessage,
+    Phase1bMessage,
+    Phase2aMessage,
+    Phase2bMessage,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    Rank,
+    Response,
+)
+
+K = 10
+
+
+def member(i: int) -> tuple[Endpoint, NodeId]:
+    """The i-th fixed identity: a stable endpoint and a spread-out NodeId
+    (negative highs exercise the signed NodeId ordering)."""
+    ep = Endpoint.from_parts(f"192.168.{i // 8}.{i % 8 + 1}", 20000 + 17 * i)
+    nid = NodeId(high=(i * 2654435761) % (1 << 63) - (i % 3) * (1 << 62),
+                 low=(i * 40503) % (1 << 31) - 7 * i)
+    return ep, nid
+
+
+INITIAL = 20  # members 0..19 form the base configuration
+DELETED = (3, 7, 15)  # removed for the second configuration
+ADDED = range(20, 25)  # joined for the third configuration
+
+EP_A, NID_A = member(0)
+EP_B, NID_B = member(1)
+
+REQUEST_SAMPLES = [
+    PreJoinMessage(sender=EP_A, node_id=NID_A),
+    JoinMessage(sender=EP_A, node_id=NID_A, ring_numbers=(0, 4, 9),
+                configuration_id=-6148914691236517206,
+                metadata=(("role", b"db"),)),
+    BatchedAlertMessage(sender=EP_B, messages=(
+        AlertMessage(edge_src=EP_A, edge_dst=EP_B, edge_status=EdgeStatus.DOWN,
+                     configuration_id=3, ring_numbers=(2,)),
+        AlertMessage(edge_src=EP_B, edge_dst=EP_A, edge_status=EdgeStatus.UP,
+                     configuration_id=3, ring_numbers=(0, 1), node_id=NID_A,
+                     metadata=(("x", b"y"),)),
+    )),
+    ProbeMessage(sender=EP_A),
+    FastRoundPhase2bMessage(sender=EP_A, configuration_id=8,
+                            endpoints=(EP_A, EP_B)),
+    Phase1aMessage(sender=EP_A, configuration_id=8, rank=Rank(2, -1)),
+    Phase1bMessage(sender=EP_B, configuration_id=8, rnd=Rank(2, 3),
+                   vrnd=Rank(1, 1), vval=(EP_A,)),
+    Phase2aMessage(sender=EP_A, configuration_id=8, rnd=Rank(2, 3),
+                   vval=(EP_B,)),
+    Phase2bMessage(sender=EP_B, configuration_id=8, rnd=Rank(2, 3),
+                   endpoints=(EP_A, EP_B)),
+    LeaveMessage(sender=EP_A),
+]
+
+RESPONSE_SAMPLES = [
+    JoinResponse(sender=EP_A, status_code=JoinStatusCode.SAFE_TO_JOIN,
+                 configuration_id=5, endpoints=(EP_A, EP_B),
+                 identifiers=(NID_A,), metadata=((EP_A, (("k", b"v"),)),)),
+    ProbeResponse(NodeStatus.BOOTSTRAPPING),
+    ConsensusResponse(),
+    Response(),
+]
+
+HASH_SAMPLES = [b"", b"a", b"hello world", b"192.168.0.1", bytes(range(32))]
+HASH_SEEDS = [0, 1, 9, 0xC0FFEE]
+
+
+def ep_str(ep: Endpoint) -> str:
+    return f"{ep.hostname.decode()}:{ep.port}"

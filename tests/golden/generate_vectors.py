@@ -1,0 +1,88 @@
+"""Generate tests/golden/parity_vectors.json -- the frozen JVM contract.
+
+Run from the repo root: python tests/golden/generate_vectors.py
+
+The committed JSON is the contract; regenerating it is only legitimate after
+a deliberate, independently cross-validated change to the hash chain or wire
+schema (e.g. re-proven against protoc output from the reference's
+rapid.proto and the published xxHash vectors). A regenerate-to-make-tests-
+pass is exactly the silent drift the golden file exists to catch.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from golden import fixtures as fx  # noqa: E402
+
+from rapid_tpu.hashing import endpoint_hash, xxh64  # noqa: E402
+from rapid_tpu.membership import MembershipView  # noqa: E402
+from rapid_tpu.messaging import grpc_transport as gt  # noqa: E402
+
+
+def build_views():
+    """The three fixed configurations, built through the object plane."""
+    view = MembershipView(fx.K)
+    for i in range(fx.INITIAL):
+        ep, nid = fx.member(i)
+        view.ring_add(ep, nid)
+    yield "initial20", view
+    for i in fx.DELETED:
+        view.ring_delete(fx.member(i)[0])
+    yield "after_delete3", view
+    for i in fx.ADDED:
+        ep, nid = fx.member(i)
+        view.ring_add(ep, nid)
+    yield "after_add5", view
+
+
+def main() -> None:
+    vectors = {
+        "xxh64": {
+            data.hex(): {
+                str(seed): f"{xxh64(data, seed):016x}" for seed in fx.HASH_SEEDS
+            }
+            for data in fx.HASH_SAMPLES
+        },
+        "endpoint_hashes": {
+            fx.ep_str(ep): {
+                str(seed): f"{endpoint_hash(ep.hostname, ep.port, seed):016x}"
+                for seed in range(fx.K)
+            }
+            for ep in (fx.member(i)[0] for i in range(3))
+        },
+        "configurations": {},
+        "requests": {},
+        "responses": {},
+    }
+    for name, view in build_views():
+        vectors["configurations"][name] = {
+            "configuration_id": view.get_current_configuration_id(),
+            "rings": [
+                [fx.ep_str(ep) for ep in view.get_ring(ring)]
+                for ring in range(fx.K)
+            ],
+        }
+    for msg in fx.REQUEST_SAMPLES:
+        wire = gt.to_wire_request(msg)
+        vectors["requests"][type(msg).__name__] = wire.SerializeToString(
+            deterministic=True
+        ).hex()
+    for msg in fx.RESPONSE_SAMPLES:
+        wire = gt.to_wire_response(msg)
+        vectors["responses"][type(msg).__name__] = wire.SerializeToString(
+            deterministic=True
+        ).hex()
+
+    out = os.path.join(os.path.dirname(__file__), "parity_vectors.json")
+    with open(out, "w") as f:
+        json.dump(vectors, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,41 +9,21 @@ different times holding different report snapshots, and propose *different*
 cuts -- conflicting proposals arising purely from timing, exactly the
 conflict source the paper measures in Fig. 11 (atc-2018 §7) and the reason
 Fast Paxos needs its classic fallback.
+
+The scenario definition (run_trial) lives in
+experiments/fig11_conflict_sweep.py -- the script that reproduces the
+BASELINE.md table -- so the published numbers and this regression can never
+desynchronize. This file pins the regime's endpoints on a smaller grid.
 """
 
+import os
+import sys
+
 import numpy as np
-import pytest
 
-from rapid_tpu.sim.driver import Simulator
-from rapid_tpu.sim.engine import SimConfig
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def run_trial(seed, victims, delay, n=64, rpi=10, fallback=None):
-    """One scenario: two victims crash; delivery class 1 hears victim A's
-    observers ``delay`` sub-rounds late. Returns (conflict, record, sim)."""
-    config = SimConfig(
-        capacity=n, rounds_per_interval=rpi, groups=2,
-        max_delivery_delay=max(delay, 1),
-    )
-    sim = Simulator(n, config=config, seed=seed)
-    sim.set_delivery_groups((np.arange(n) % 2).astype(np.int32))
-    victims = np.array(victims)
-    sim.crash(victims)
-    if delay:
-        obs_a = np.asarray(sim.state.observers)[victims[0]]
-        sim.delay_broadcasts(1, obs_a, delay)
-    rec = sim.run_until_decision(
-        max_rounds=200, batch=40, classic_fallback_after_rounds=fallback
-    )
-    conflict = False
-    if sim.last_announcement is not None:
-        announced, proposals = sim.last_announcement
-        conflict = bool(
-            announced[:2].all()
-            and not np.array_equal(proposals[0], proposals[1])
-        )
-    return conflict, rec, sim
-
+from experiments.fig11_conflict_sweep import drive_to_convergence, run_trial
 
 TRIALS = [(seed, victims) for seed in range(3) for victims in ([5, 40], [11, 52])]
 
@@ -52,7 +32,7 @@ def test_no_conflicts_without_latency_heterogeneity():
     """Uniform timing never diverges: same stream, same crossings, one
     proposal, fast-path decision."""
     for seed, victims in TRIALS:
-        conflict, rec, _ = run_trial(seed, victims, delay=0)
+        conflict, rec, _ = run_trial(seed, victims, skew=0)
         assert not conflict
         assert rec is not None and not rec.via_classic_round
         assert sorted(rec.cut) == sorted(victims)
@@ -63,7 +43,7 @@ def test_latency_heterogeneity_induces_conflicting_proposals():
     two delivery classes cross H on different snapshots and propose different
     cuts; the 50/50 vote split blocks the 3/4 quorum."""
     for seed, victims in TRIALS:
-        conflict, rec, _ = run_trial(seed, victims, delay=9)
+        conflict, rec, _ = run_trial(seed, victims, skew=9)
         assert conflict, f"no divergence for seed={seed} victims={victims}"
         assert rec is None, "conflicting 32/32 split must stall the fast round"
 
@@ -76,21 +56,14 @@ def test_conflicts_resolve_through_classic_fallback():
         # first observe the stalled conflict, then enable the fallback on
         # the same simulator (the view change consumes the announcement
         # snapshot, so the conflict must be captured before the decision)
-        conflict, stalled, sim = run_trial(seed, victims, delay=9, fallback=None)
+        conflict, stalled, sim = run_trial(seed, victims, skew=9, fallback=None)
         assert conflict and stalled is None
         rec = sim.run_until_decision(
             max_rounds=100, batch=40, classic_fallback_after_rounds=20
         )
         assert rec is not None and rec.via_classic_round
         assert set(rec.cut) <= set(victims)  # a proposed value, never invented
-        for _ in range(3):
-            if sim.membership_size == 62:
-                break
-            follow = sim.run_until_decision(
-                max_rounds=300, batch=50, classic_fallback_after_rounds=20
-            )
-            assert follow is not None, "residual cut never decided"
-        assert sim.membership_size == 62
+        drive_to_convergence(sim, 62)
         assert not sim.active[np.array(victims)].any()
 
 
@@ -98,12 +71,12 @@ def test_conflict_rate_grows_with_stagger():
     """The experiment behind the BASELINE.md row: conflict probability is
     monotone in the latency skew (0 at skew 0, 1 at skew 9 for this grid)."""
     rates = {}
-    for delay in (0, 5, 9):
+    for skew in (0, 5, 9):
         conflicts = 0
         for seed, victims in TRIALS:
-            conflict, _, _ = run_trial(seed, victims, delay=delay)
+            conflict, _, _ = run_trial(seed, victims, skew=skew)
             conflicts += conflict
-        rates[delay] = conflicts / len(TRIALS)
+        rates[skew] = conflicts / len(TRIALS)
     assert rates[0] == 0.0
     assert rates[0] <= rates[5] <= rates[9]
     assert rates[9] == 1.0
