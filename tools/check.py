@@ -1,0 +1,233 @@
+"""Static-analysis tier runnable in this image (stdlib-only).
+
+The reference treats static checking as part of its correctness story
+(error-prone with -Werror, findbugs, checkstyle -- pom.xml:40-76,
+build-common/). This repo's equivalents:
+
+- [tool.ruff] / [tool.mypy] in pyproject.toml for environments that have
+  the tools;
+- this checker, which needs nothing beyond the stdlib, for `make check`
+  anywhere: byte-compiles every file and enforces a focused, high-signal
+  AST rule set (unused imports, mutable default arguments, bare excepts,
+  `== None` comparisons, always-true tuple asserts, duplicate dict keys,
+  debugger/print leftovers in library code).
+
+Suppress a single line with `# noqa` or `# noqa: RULE`.
+
+Usage: python tools/check.py [paths...]   (default: the repo's source roots)
+"""
+
+from __future__ import annotations
+
+import ast
+import py_compile
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = ["rapid_tpu", "tests", "examples", "experiments", "tools",
+                 "bench.py", "scenarios.py", "__graft_entry__.py"]
+
+# modules where `print` is the intended UI (CLIs, benchmarks, experiments)
+PRINT_OK_ROOTS = ("examples", "experiments", "tools", "tests")
+PRINT_OK_FILES = {"bench.py", "scenarios.py", "__graft_entry__.py"}
+
+
+class Finding:
+    def __init__(self, path: Path, line: int, rule: str, msg: str) -> None:
+        self.path, self.line, self.rule, self.msg = path, line, rule, msg
+
+    def __str__(self) -> str:
+        rel = self.path.relative_to(REPO) if self.path.is_absolute() else self.path
+        return f"{rel}:{self.line}: {self.rule} {self.msg}"
+
+
+def _noqa_lines(source: str) -> dict[int, set[str]]:
+    """line -> suppressed rules ('*' = all)."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        if "# noqa" not in line:
+            continue
+        _, _, tail = line.partition("# noqa")
+        tail = tail.strip()
+        if tail.startswith(":"):
+            out[i] = {r.strip() for r in tail[1:].split(",")}
+        else:
+            out[i] = {"*"}
+    return out
+
+
+class Checker(ast.NodeVisitor):
+    def __init__(self, path: Path, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.tree = tree
+        self.findings: list[Finding] = []
+        self._noqa = _noqa_lines(source)
+        rel = path.relative_to(REPO)
+        self.print_ok = (
+            rel.parts[0] in PRINT_OK_ROOTS or rel.name in PRINT_OK_FILES
+        )
+
+    def report(self, node: ast.AST, rule: str, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        suppressed = self._noqa.get(line, set())
+        if "*" in suppressed or rule in suppressed:
+            return
+        self.findings.append(Finding(self.path, line, rule, msg))
+
+    # -- unused imports ----------------------------------------------------
+
+    def check_unused_imports(self) -> None:
+        imported: dict[str, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".")[0]
+                    imported[name] = node
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    imported[alias.asname or alias.name] = node
+
+        used: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                base = node
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                if isinstance(base, ast.Name):
+                    used.add(base.id)
+        # names re-exported via __all__ count as used
+        for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        used.add(elt.value)
+        # string annotations (from __future__ import annotations) reference
+        # names the walker cannot see; treat annotation strings as usage
+        for node in ast.walk(self.tree):
+            ann = getattr(node, "annotation", None)
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                used.update(
+                    part for part in ann.value.replace("[", " ")
+                    .replace("]", " ").replace(",", " ").replace(".", " ").split()
+                )
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ret = node.returns
+                if isinstance(ret, ast.Constant) and isinstance(ret.value, str):
+                    used.update(
+                        part for part in ret.value.replace("[", " ")
+                        .replace("]", " ").replace(",", " ").replace(".", " ").split()
+                    )
+        for name, node in imported.items():
+            if name not in used:
+                self.report(node, "unused-import", f"'{name}' imported but unused")
+
+    # -- node rules --------------------------------------------------------
+
+    def visit_FunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_defaults(self, node) -> None:
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.report(
+                    default, "mutable-default",
+                    f"mutable default argument in {node.name}()",
+                )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.report(node, "bare-except", "bare 'except:' hides SystemExit")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comparator in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                isinstance(comparator, ast.Constant) and comparator.value is None
+            ):
+                self.report(node, "none-compare", "use 'is None' / 'is not None'")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.report(node, "assert-tuple", "assert on tuple is always true")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        seen: set = set()
+        for key in node.keys:
+            if isinstance(key, ast.Constant):
+                try:
+                    if key.value in seen:
+                        self.report(key, "dup-dict-key",
+                                    f"duplicate dict key {key.value!r}")
+                    seen.add(key.value)
+                except TypeError:
+                    pass
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "print" and not self.print_ok:
+            self.report(node, "print-in-lib",
+                        "print() in library code; use logging")
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "set_trace"
+        ):
+            self.report(node, "debugger", "debugger breakpoint left in code")
+        self.generic_visit(node)
+
+
+def check_file(path: Path) -> list[Finding]:
+    source = path.read_text()
+    try:
+        py_compile.compile(str(path), doraise=True, cfile="/dev/null")
+    except py_compile.PyCompileError as exc:
+        return [Finding(path, 0, "syntax", str(exc))]
+    tree = ast.parse(source, filename=str(path))
+    checker = Checker(path, source, tree)
+    checker.check_unused_imports()
+    checker.visit(tree)
+    return checker.findings
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(p) for p in (argv or DEFAULT_PATHS)]
+    files: list[Path] = []
+    for root in roots:
+        root = (REPO / root) if not root.is_absolute() else root
+        if root.is_dir():
+            files.extend(sorted(root.rglob("*.py")))
+        elif root.exists():
+            files.append(root)
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(check_file(f))
+    for finding in findings:
+        print(finding)
+    print(f"checked {len(files)} files: "
+          f"{'OK' if not findings else f'{len(findings)} findings'}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
