@@ -24,6 +24,20 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="rapid-tpu standalone agent")
     parser.add_argument("--listen-address", required=True, help="host:port to listen on")
     parser.add_argument("--seed-address", help="host:port of a seed to join")
+    parser.add_argument(
+        "--gateway-address",
+        help="host:port of a SwarmGateway; destinations whose hostname is not "
+        "in the direct set (the swarm's virtual endpoints) ride this connection",
+    )
+    parser.add_argument(
+        "--direct-host",
+        action="append",
+        default=[],
+        help="additional hostname reached directly rather than via the "
+        "gateway (repeatable; loopback and this agent's own hostname are "
+        "always direct). Required for multi-host deployments so peer agents "
+        "on other machines are not misrouted to the gateway",
+    )
     parser.add_argument("--fd-interval-ms", type=int, default=1000)
     parser.add_argument(
         "--transport", choices=("tcp", "grpc"), default="tcp",
@@ -41,11 +55,28 @@ def main() -> None:
     listen = Endpoint.from_string(args.listen_address)
     settings = Settings(failure_detector_interval_ms=args.fd_interval_ms)
     if args.transport == "grpc":
+        if args.gateway_address:
+            parser.error(
+                "--gateway-address requires the tcp transport: the gateway "
+                "delivers swarm traffic over framed TCP to the agent's server"
+            )
         from rapid_tpu.messaging.grpc_transport import GrpcClient, GrpcServer
 
         client, server = GrpcClient(listen, settings), GrpcServer(listen)
     else:
         client = server = TcpClientServer(listen, settings)
+    if args.gateway_address:
+        from rapid_tpu.messaging.gateway import (
+            DEFAULT_DIRECT_HOSTS,
+            GatewayRoutedClient,
+        )
+
+        direct = set(DEFAULT_DIRECT_HOSTS)
+        direct.update(h.encode() for h in args.direct_host)
+        client = GatewayRoutedClient(
+            listen, Endpoint.from_string(args.gateway_address), client, settings,
+            direct_hosts=direct,
+        )
 
     def on_event(name):
         def callback(configuration_id, changes):
@@ -72,8 +103,12 @@ def main() -> None:
         while True:
             time.sleep(1)
             members = cluster.get_memberlist()
-            log.info("membership size=%d members=%s", len(members),
-                     [str(m) for m in members])
+            log.info(
+                "membership size=%d config=%d members=%s",
+                len(members),
+                cluster.get_current_configuration_id(),
+                [str(m) for m in members] if len(members) <= 32 else "...",
+            )
     except KeyboardInterrupt:
         cluster.leave_gracefully()
 

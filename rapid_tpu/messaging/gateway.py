@@ -1,0 +1,424 @@
+"""Socket gateway: the TPU-hosted swarm reachable by external OS processes.
+
+The reference's seam design means *any* transport can host a membership
+service (IMessagingServer.java:24-41, GrpcServer.java:133-148). This module
+hosts ``TpuSimMessaging`` -- N virtual nodes whose protocol state lives as
+device arrays in the TPU simulator -- behind a real TCP socket, so a real
+agent process (the shape of the reference's standalone agent,
+StandaloneAgent.java:94-116) joins, probes, broadcasts, votes, observes cuts,
+and leaves against TPU-hosted peers over the wire.
+
+Routing: one gateway socket fronts *thousands* of virtual endpoints, so the
+wire frame must carry the destination (a plain rapid frame does not -- the
+reference's server knows who it is by which socket it binds). The routed
+frame prepends the destination endpoint to the standard codec envelope;
+responses travel back correlated by request number exactly as in the plain
+transport (NettyClientServer.java:267-277's pattern). Agent-side, a
+``GatewayRoutedClient`` wraps the agent's normal transport: destinations
+whose hostname is locally routable go direct (agent <-> agent traffic),
+everything else -- the synthetic 10.x.y.z virtual addresses -- rides the
+gateway connection. This is a transport-plugin concern, exactly what the
+IMessagingClient seam exists for (IMessagingClient.java:25-48).
+
+Threading model mirrors the reference: ALL swarm-side protocol logic
+(bridge.handle + pump) is serialized on one protocol thread
+(SharedResources.java:53's single protocolExecutor). The bridge's
+clock-advance during the pre-decision vote exchange (pump phase B) is mapped
+onto that thread's own task queue: ``run_for`` drains inbound requests for
+the wait window, so real members' votes are tallied *during* the pause
+rather than queuing behind it.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..runtime.futures import Promise
+from ..runtime.scheduler import RealScheduler
+from ..settings import Settings
+from ..types import Endpoint, RapidMessage
+from .base import IMessagingClient
+from .codec import ENVELOPE, decode, encode
+from .retries import call_with_retries
+from .tcp import FramedTcpServer, TcpClientServer, _Connection, _write_frame
+
+LOG = logging.getLogger(__name__)
+
+# routed envelope: request number, destination host length (host bytes and a
+# u32 port follow), then the standard (tag, payload) body
+ROUTED_HEADER = struct.Struct("!QH")
+_PORT = struct.Struct("!I")
+
+
+def encode_routed(request_no: int, dst: Endpoint, msg: RapidMessage) -> bytes:
+    body = encode(request_no, msg)[ENVELOPE.size - 1 :]  # (tag, payload)
+    return (
+        ROUTED_HEADER.pack(request_no, len(dst.hostname))
+        + dst.hostname
+        + _PORT.pack(dst.port)
+        + body
+    )
+
+
+def decode_routed(frame: bytes) -> Tuple[int, Endpoint, RapidMessage]:
+    request_no, host_len = ROUTED_HEADER.unpack_from(frame)
+    offset = ROUTED_HEADER.size
+    host = frame[offset : offset + host_len]
+    offset += host_len
+    (port,) = _PORT.unpack_from(frame, offset)
+    offset += _PORT.size
+    # reconstitute a standard envelope for the shared decoder
+    _, msg = decode(ENVELOPE.pack(request_no, frame[offset]) + frame[offset + 1 :])
+    return request_no, Endpoint(host, port), msg
+
+
+DEFAULT_DIRECT_HOSTS = (b"127.0.0.1", b"localhost")
+
+
+class GatewayRoutedClient(IMessagingClient):
+    """Agent-side client: direct transport for routable peers, the gateway
+    connection for everything else (the swarm's virtual endpoints)."""
+
+    def __init__(
+        self,
+        address: Endpoint,
+        gateway: Endpoint,
+        direct: IMessagingClient,
+        settings: Optional[Settings] = None,
+        direct_hosts: Optional[Set[bytes]] = None,
+    ) -> None:
+        self.address = address
+        self.gateway = gateway
+        self._direct = direct
+        self._settings = settings if settings is not None else Settings()
+        self._direct_hosts = (
+            set(direct_hosts)
+            if direct_hosts is not None
+            else set(DEFAULT_DIRECT_HOSTS)
+        )
+        self._direct_hosts.add(address.hostname)
+        self._request_no_lock = threading.Lock()
+        self._request_no = 0
+        self._conn: Optional[_Connection] = None
+        self._conn_lock = threading.Lock()
+
+    def _is_direct(self, remote: Endpoint) -> bool:
+        return remote.hostname in self._direct_hosts
+
+    def _connection(self) -> _Connection:
+        with self._conn_lock:
+            if self._conn is None or self._conn.closed:
+                self._conn = _Connection(
+                    self.gateway, self._settings.message_timeout_ms / 1000.0
+                )
+            return self._conn
+
+    def _next_request_no(self) -> int:
+        with self._request_no_lock:
+            self._request_no += 1
+            return self._request_no
+
+    def _send_routed_once(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        out: Promise = Promise()
+        try:
+            conn = self._connection()
+            request_no = self._next_request_no()
+            with conn.lock:
+                conn.outstanding[request_no] = out
+            _write_frame(conn.sock, encode_routed(request_no, remote, msg))
+        except OSError as e:
+            if not out.done():
+                out.set_exception(e)
+            return out
+        timeout_s = self._settings.timeout_for(msg) / 1000.0
+        timer = threading.Timer(
+            timeout_s,
+            lambda: out.done()
+            or out.set_exception(TimeoutError(f"no response from {remote}")),
+        )
+        timer.daemon = True
+        timer.start()
+
+        # on completion without a response frame (the gateway deliberately
+        # stays silent for dropped/unowned destinations) the correlation entry
+        # must not accumulate on this process-lifetime connection
+        def on_complete(_p: Promise, c=conn, rn=request_no) -> None:
+            timer.cancel()
+            c.forget(rn)
+
+        out.add_callback(on_complete)
+        return out
+
+    def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        if self._is_direct(remote):
+            return self._direct.send_message(remote, msg)
+        return call_with_retries(
+            lambda: self._send_routed_once(remote, msg),
+            self._settings.message_retries,
+        )
+
+    def send_message_best_effort(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        if self._is_direct(remote):
+            return self._direct.send_message_best_effort(remote, msg)
+        return self._send_routed_once(remote, msg)
+
+    def shutdown(self) -> None:
+        self._direct.shutdown()
+        with self._conn_lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+
+class _GatewayScheduler(RealScheduler):
+    """RealScheduler plus ``run_for``: the bridge's clock advance drains the
+    gateway's protocol queue for the window, so inbound votes are processed
+    *during* the pre-decision pause (TpuSimMessaging._advance_clock)."""
+
+    def __init__(self, drain: Callable[[float], None]) -> None:
+        super().__init__()
+        self._drain = drain
+
+    def run_for(self, ms: int) -> None:
+        self._drain(ms / 1000.0)
+
+
+class _GatewayNetwork:
+    """The bridge-facing network adapter: liveness by dialing, delivery over
+    the gateway's outbound client (InProcessNetwork's contract, on sockets)."""
+
+    # liveness sensing runs on the single protocol thread, so dials must be
+    # short; positive results are cached briefly to avoid dial-per-pump churn
+    PROBE_TIMEOUT_S = 0.25
+    PROBE_CACHE_S = 1.0
+
+    def __init__(self, out_client: TcpClientServer, scheduler: RealScheduler) -> None:
+        self.scheduler = scheduler
+        self._out = out_client
+        self._handlers: List[object] = []
+        self._probe_ok: Dict[Endpoint, float] = {}
+
+    def attach_handler(self, handler) -> None:
+        self._handlers.append(handler)
+
+    def is_listening(self, address: Endpoint) -> bool:
+        conn = self._out._connections.get(address)  # noqa: SLF001
+        if conn is not None and not conn.closed:
+            return True
+        now = time.monotonic()
+        last_ok = self._probe_ok.get(address)
+        if last_ok is not None and now - last_ok < self.PROBE_CACHE_S:
+            return True
+        try:
+            probe = socket.create_connection(
+                (address.hostname.decode(), address.port),
+                timeout=self.PROBE_TIMEOUT_S,
+            )
+            probe.close()
+            self._probe_ok[address] = now
+            return True
+        except OSError:
+            self._probe_ok.pop(address, None)
+            return False
+
+    def deliver(
+        self, src: Endpoint, dst: Endpoint, msg: RapidMessage, timeout_ms: int
+    ) -> Promise:
+        # src rides inside the message payload, as on every rapid transport
+        return self._out.send_message_best_effort(dst, msg)
+
+
+class SwarmGateway:
+    """Hosts a TpuSimMessaging swarm behind one real TCP socket.
+
+    start() binds the socket and the pump loop; external processes join the
+    swarm through ``seed_endpoint()`` using a GatewayRoutedClient. All bridge
+    access is serialized on the protocol thread; responses complete
+    asynchronously when the simulated view change commits (parked joins),
+    mirroring MembershipService.java:229-286 over a real wire.
+    """
+
+    def __init__(
+        self,
+        listen_address: Endpoint,
+        n_virtual: int,
+        capacity: Optional[int] = None,
+        config=None,
+        seed: int = 0,
+        settings: Optional[Settings] = None,
+        pump_interval_ms: int = 100,
+        pump_max_rounds: int = 32,
+    ) -> None:
+        from ..sim.bridge import TpuSimMessaging
+
+        self.address = listen_address
+        self._settings = settings if settings is not None else Settings()
+        self._out = TcpClientServer(listen_address, self._settings)
+        self._tasks: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self._scheduler = _GatewayScheduler(self._drain_for)
+        self.network = _GatewayNetwork(self._out, self._scheduler)
+        self.bridge = TpuSimMessaging(
+            self.network,
+            n_virtual=n_virtual,
+            capacity=capacity,
+            config=config,
+            seed=seed,
+        )
+        self._pump_interval_s = pump_interval_ms / 1000.0
+        self._pump_max_rounds = pump_max_rounds
+        self._framed = FramedTcpServer(listen_address, self._on_frame, "gateway")
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        self._decisions: List[object] = []
+        self._decision_lock = threading.Lock()
+        self._warned_unowned: set = set()
+
+    # ------------------------------------------------------------------ #
+    # public surface
+    # ------------------------------------------------------------------ #
+
+    def seed_endpoint(self, slot: int = 0) -> Endpoint:
+        return self.bridge.endpoint(slot)
+
+    def decisions(self) -> List[object]:
+        with self._decision_lock:
+            return list(self._decisions)
+
+    def configuration_id(self) -> int:
+        return self.bridge.sim.configuration_id()
+
+    def membership_size(self) -> int:
+        return self.bridge.sim.membership_size
+
+    def start(self) -> None:
+        self._running = True
+        self._framed.start()
+        for target, name in (
+            (self._protocol_loop, "gateway-protocol"),
+            (self._pump_loop, "gateway-pump"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._running = False
+        self._framed.shutdown()
+        self._tasks.put(None)
+        self._out.shutdown()
+        self._scheduler.shutdown()
+
+    # ------------------------------------------------------------------ #
+    # protocol serialization
+    # ------------------------------------------------------------------ #
+
+    def _protocol_loop(self) -> None:
+        while self._running:
+            fn = self._tasks.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 -- the loop must survive
+                LOG.exception("gateway protocol task failed")
+
+    def _drain_for(self, seconds: float) -> None:
+        """Process queued tasks for a wall-clock window (bridge clock advance;
+        runs ON the protocol thread, so serialization is preserved)."""
+        deadline = time.monotonic() + seconds
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            try:
+                fn = self._tasks.get(timeout=remaining)
+            except queue.Empty:
+                return
+            if fn is None:
+                self._tasks.put(None)  # re-post the shutdown sentinel
+                return
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                LOG.exception("gateway protocol task failed")
+
+    def _pump_loop(self) -> None:
+        pending = threading.Event()
+
+        def pump() -> None:
+            try:
+                rec = self.bridge.pump(max_rounds=self._pump_max_rounds)
+                if rec is not None:
+                    with self._decision_lock:
+                        self._decisions.append(rec)
+            finally:
+                pending.clear()
+
+        while self._running:
+            time.sleep(self._pump_interval_s)
+            if not self._running:
+                return
+            if not pending.is_set():
+                pending.set()
+                self._tasks.put(pump)
+
+    # ------------------------------------------------------------------ #
+    # inbound routed connections
+    # ------------------------------------------------------------------ #
+
+    def _on_frame(self, sock: socket.socket, write_lock: threading.Lock,
+                  frame: bytes) -> None:
+        request_no, dst, msg = decode_routed(frame)
+        self._tasks.put(
+            lambda rn=request_no, d=dst, m=msg: self._handle_one(
+                sock, write_lock, rn, d, m
+            )
+        )
+
+    def _handle_one(
+        self,
+        sock: socket.socket,
+        write_lock: threading.Lock,
+        request_no: int,
+        dst: Endpoint,
+        msg: RapidMessage,
+    ) -> None:
+        if not self.bridge.owns(dst):
+            # a real member's address, or an unknown endpoint: there is no
+            # virtual node here; the sender's deadline handles it. Warn once
+            # per endpoint -- a steady stream of these means an agent is
+            # misrouting peer traffic here (missing --direct-host)
+            if dst not in self._warned_unowned:
+                self._warned_unowned.add(dst)
+                LOG.warning(
+                    "routed frame for non-virtual endpoint %s dropped; if this "
+                    "is a real agent's address, its peers need it in their "
+                    "direct-host set",
+                    dst,
+                )
+            return
+        try:
+            promise = self.bridge.handle(dst, msg)
+        except Exception:  # noqa: BLE001
+            LOG.exception("bridge.handle failed for %s", dst)
+            return
+
+        def reply(p: Promise) -> None:
+            if p.exception() is not None:
+                return  # no response; the sender's deadline expires
+            response = p._result  # noqa: SLF001
+            if response is None:
+                return
+            try:
+                with write_lock:
+                    _write_frame(sock, encode(request_no, response))
+            except OSError:
+                pass
+
+        promise.add_callback(reply)

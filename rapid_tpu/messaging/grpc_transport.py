@@ -11,12 +11,14 @@ until the membership service is wired (GrpcServer.java:77-96).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import threading
-from concurrent import futures as cf
-from typing import Dict, Optional
+import time
+from typing import Dict, Optional, Tuple
 
 import grpc
+import grpc.aio
 
 from .. import types as T
 from ..runtime.futures import Promise
@@ -261,66 +263,166 @@ def from_wire_response(resp):
 # ---------------------------------------------------------------------------
 
 
+class _SharedAioLoop:
+    """One process-wide event loop thread hosting every grpc.aio server.
+
+    grpc.aio's completion-queue poller is process-global, so multiple event
+    loops in one process trip over each other (EAGAIN storms on shutdown).
+    One shared loop is also the faithful analogue of the reference's lazy
+    shared Netty event-loop group (SharedResources.java:48-67): many servers,
+    one reactor. The daemon thread starts on first use and lives for the
+    process -- individual servers start/stop on it without tearing it down.
+    """
+
+    _lock = threading.Lock()
+    _loop: Optional[asyncio.AbstractEventLoop] = None
+
+    @classmethod
+    def get(cls) -> asyncio.AbstractEventLoop:
+        with cls._lock:
+            if cls._loop is None or cls._loop.is_closed():
+                loop = asyncio.new_event_loop()
+
+                def run() -> None:
+                    asyncio.set_event_loop(loop)
+                    loop.run_forever()
+
+                thread = threading.Thread(
+                    target=run, name="grpc-aio-shared-loop", daemon=True
+                )
+                thread.start()
+                cls._loop = loop
+            return cls._loop
+
+    @classmethod
+    def call(cls, coro, timeout: float = 10.0):
+        """Run a coroutine on the shared loop and wait for its result."""
+        return asyncio.run_coroutine_threadsafe(coro, cls.get()).result(timeout)
+
+
 class GrpcServer(IMessagingServer):
+    """Async-completion server: no thread is ever parked on a pending response.
+
+    The reference's server is futures end-to-end -- the RPC completes whenever
+    the service's ListenableFuture does, without holding a worker thread
+    (GrpcServer.java:77-96). Join phase-2 responses are parked until the view
+    change commits (MembershipService.java:229-286), so a thread-per-response
+    server deadlocks at >= pool-size concurrent joiners; here the grpc.aio
+    event loop awaits each Promise, so thousands of parked joins cost nothing
+    but memory.
+    """
+
     def __init__(self, listen_address: T.Endpoint, max_workers: int = 8) -> None:
         self.address = listen_address
         self._service = None
-        self._server: Optional[grpc.Server] = None
+        self._server: Optional[grpc.aio.Server] = None
+        # retained for API compatibility; the aio server has no worker pool
         self._max_workers = max_workers
 
-    def _handle(self, request, context):
+    async def _handle(self, request, context):
         service = self._service
         if service is None:
             msg = from_wire_request(request)
             if isinstance(msg, T.ProbeMessage):
                 return to_wire_response(T.ProbeResponse(T.NodeStatus.BOOTSTRAPPING))
-            context.abort(grpc.StatusCode.UNAVAILABLE, "membership service not ready")
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, "membership service not ready"
+            )
         promise = service.handle_message(from_wire_request(request))
+        loop = asyncio.get_running_loop()
+        done: asyncio.Future = loop.create_future()
+
+        def on_complete(p: Promise) -> None:
+            def settle() -> None:
+                if done.cancelled():
+                    return
+                exc = p.exception()
+                if exc is not None:
+                    done.set_exception(exc)
+                else:
+                    done.set_result(p._result)  # noqa: SLF001
+
+            loop.call_soon_threadsafe(settle)
+
+        promise.add_callback(on_complete)
         try:
-            result = promise.result(timeout=30)
+            result = await asyncio.wait_for(done, timeout=30)
         except Exception as e:  # noqa: BLE001
-            context.abort(grpc.StatusCode.INTERNAL, str(e))
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
         return to_wire_response(result)
 
     def start(self) -> None:
-        handler = grpc.unary_unary_rpc_method_handler(
-            self._handle,
-            request_deserializer=MSG["RapidRequest"].FromString,
-            response_serializer=lambda m: m.SerializeToString(),
-        )
-        service = grpc.method_handlers_generic_handler(
-            "remoting.MembershipService", {"sendRequest": handler}
-        )
-        self._server = grpc.server(cf.ThreadPoolExecutor(max_workers=self._max_workers))
-        self._server.add_generic_rpc_handlers((service,))
-        self._server.add_insecure_port(
-            f"{self.address.hostname.decode()}:{self.address.port}"
-        )
-        self._server.start()
+        async def boot() -> grpc.aio.Server:
+            handler = grpc.unary_unary_rpc_method_handler(
+                self._handle,
+                request_deserializer=MSG["RapidRequest"].FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+            service = grpc.method_handlers_generic_handler(
+                "remoting.MembershipService", {"sendRequest": handler}
+            )
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((service,))
+            server.add_insecure_port(
+                f"{self.address.hostname.decode()}:{self.address.port}"
+            )
+            await server.start()
+            return server
+
+        self._server = _SharedAioLoop.call(boot())
 
     def shutdown(self) -> None:
-        if self._server is not None:
-            self._server.stop(grace=0.5)
-            self._server = None
+        server = self._server
+        if server is None:
+            return
+        self._server = None
+        try:
+            _SharedAioLoop.call(server.stop(grace=0.5))
+        except Exception:  # noqa: BLE001 -- loop already gone at interpreter exit
+            pass
 
     def set_membership_service(self, service) -> None:
         self._service = service
 
 
 class GrpcClient(IMessagingClient):
+    """Channel-caching client with the reference's lifecycle rules: the cached
+    channel is invalidated on call failure (Retries.java:63-66 ->
+    GrpcClient.java:113,131) and evicted after 30s idle (GrpcClient.java:87-95),
+    so a peer that restarts on the same address is reached over a fresh
+    connection within the retry budget instead of starving behind a dead one.
+    """
+
+    IDLE_EVICT_S = 30.0
+    # grpc-python's Channel.close() hard-cancels in-flight RPCs (there is no
+    # graceful shutdown() like the Java ManagedChannel), so invalidated and
+    # idle-evicted channels are *retired* -- dropped from the cache so new
+    # sends dial fresh -- and only closed once their in-flight calls (parked
+    # joins run the longest, <= the server's 30s ceiling) have drained.
+    RETIRE_CLOSE_S = 60.0
+
     def __init__(self, address: T.Endpoint, settings: Optional[Settings] = None) -> None:
         self.address = address
         self._settings = settings if settings is not None else Settings()
         self._channels: Dict[T.Endpoint, grpc.Channel] = {}
         self._stubs: Dict[T.Endpoint, object] = {}
+        self._last_used: Dict[T.Endpoint, float] = {}
+        self._retired: list = []  # [(retired_at, channel)]
         self._lock = threading.Lock()
 
     def _stub(self, remote: T.Endpoint):
+        now = time.monotonic()
         with self._lock:
+            self._evict_idle_locked(now)
             stub = self._stubs.get(remote)
             if stub is None:
+                # a local subchannel pool makes "new channel" mean "new
+                # connection": with the default process-global pool, a channel
+                # dialed right after an invalidation would reuse the broken
+                # subchannel still sitting in connect-backoff
                 channel = grpc.insecure_channel(
-                    f"{remote.hostname.decode()}:{remote.port}"
+                    f"{remote.hostname.decode()}:{remote.port}",
+                    options=[("grpc.use_local_subchannel_pool", 1)],
                 )
                 stub = channel.unary_unary(
                     GRPC_METHOD_PATH,
@@ -329,7 +431,42 @@ class GrpcClient(IMessagingClient):
                 )
                 self._channels[remote] = channel
                 self._stubs[remote] = stub
+            self._last_used[remote] = now
             return stub
+
+    def _evict_idle_locked(self, now: float) -> None:
+        for ep in [
+            ep
+            for ep, used in self._last_used.items()
+            if now - used > self.IDLE_EVICT_S
+        ]:
+            channel = self._channels.pop(ep, None)
+            self._stubs.pop(ep, None)
+            self._last_used.pop(ep, None)
+            if channel is not None:
+                self._retired.append((now, channel))
+        while self._retired and now - self._retired[0][0] > self.RETIRE_CLOSE_S:
+            _, channel = self._retired.pop(0)
+            channel.close()
+
+    def invalidate(self, remote: T.Endpoint) -> None:
+        """Drop the cached channel so the next attempt dials fresh
+        (GrpcClient.java:113,131 via Retries.onCallFailure). The channel is
+        retired, not closed: closing would cancel unrelated in-flight RPCs
+        sharing it (e.g. a parked join, while a probe's failure triggered the
+        invalidation)."""
+        now = time.monotonic()
+        with self._lock:
+            channel = self._channels.pop(remote, None)
+            self._stubs.pop(remote, None)
+            self._last_used.pop(remote, None)
+            if channel is not None:
+                self._retired.append((now, channel))
+            # sweep here too: a client that stops dialing new stubs must not
+            # hold retired channels' sockets past the drain window
+            while self._retired and now - self._retired[0][0] > self.RETIRE_CLOSE_S:
+                _, old = self._retired.pop(0)
+                old.close()
 
     def _send_once(self, remote: T.Endpoint, msg: T.RapidMessage) -> Promise:
         out: Promise = Promise()
@@ -338,6 +475,7 @@ class GrpcClient(IMessagingClient):
             timeout_s = self._settings.timeout_for(msg) / 1000.0
             future = stub.future(to_wire_request(msg), timeout=timeout_s)
         except Exception as e:  # noqa: BLE001
+            self.invalidate(remote)
             out.set_exception(e)
             return out
 
@@ -345,6 +483,7 @@ class GrpcClient(IMessagingClient):
             try:
                 out.try_set_result(from_wire_response(f.result()))
             except Exception as e:  # noqa: BLE001
+                self.invalidate(remote)
                 if not out.done():
                     out.set_exception(e)
 
@@ -363,5 +502,9 @@ class GrpcClient(IMessagingClient):
         with self._lock:
             for channel in self._channels.values():
                 channel.close()
+            for _, channel in self._retired:
+                channel.close()
             self._channels.clear()
             self._stubs.clear()
+            self._last_used.clear()
+            self._retired.clear()

@@ -20,7 +20,7 @@ import logging
 import socket
 import struct
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from ..runtime.futures import Promise
 from ..settings import Settings
@@ -88,6 +88,13 @@ class _Connection:
         finally:
             self.close()
 
+    def forget(self, request_no: int) -> None:
+        """Drop a correlation entry whose promise completed without a response
+        frame (timeout/drop) -- otherwise entries accumulate for the life of
+        the connection."""
+        with self.lock:
+            self.outstanding.pop(request_no, None)
+
     def close(self) -> None:
         with self.lock:
             if self.closed:
@@ -95,6 +102,10 @@ class _Connection:
             self.closed = True
             pending = list(self.outstanding.values())
             self.outstanding.clear()
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -105,6 +116,101 @@ class _Connection:
                     promise.set_exception(ConnectionError("connection closed"))
                 except Exception:  # noqa: BLE001 -- lost race with completion
                     pass
+
+
+class FramedTcpServer:
+    """Accept loop + connection lifecycle for length-prefixed framed servers.
+
+    Owns the subtle socket mechanics shared by every framed server (the node
+    transport and the swarm gateway): accepted-socket tracking, the
+    shutdown()-before-close() dance -- a thread blocked in accept()/recv()
+    holds the fd, so close() alone neither wakes it nor sends the FIN peers
+    rely on to sense liveness -- and the accept-vs-shutdown race. Inbound
+    frames are handed to ``on_frame(sock, write_lock, frame)``.
+    """
+
+    def __init__(
+        self,
+        listen_address: Endpoint,
+        on_frame: Callable[[socket.socket, threading.Lock, bytes], None],
+        name: str = "tcp-server",
+    ) -> None:
+        self.address = listen_address
+        self._on_frame = on_frame
+        self._name = name
+        self._server_sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._accepted: set = set()
+        self._accepted_lock = threading.Lock()
+        self._running = False
+
+    def start(self) -> None:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.address.hostname.decode(), self.address.port))
+        sock.listen(128)
+        self._server_sock = sock
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{self._name}-{self.address}", daemon=True
+        )
+        self._accept_thread.start()
+
+    def shutdown(self) -> None:
+        self._running = False
+        if self._server_sock is not None:
+            for op in (lambda s: s.shutdown(socket.SHUT_RDWR), lambda s: s.close()):
+                try:
+                    op(self._server_sock)
+                except OSError:
+                    pass
+        with self._accepted_lock:
+            accepted = list(self._accepted)
+            self._accepted.clear()
+        for sock in accepted:
+            for op in (lambda s: s.shutdown(socket.SHUT_RDWR), lambda s: s.close()):
+                try:
+                    op(sock)
+                except OSError:
+                    pass
+
+    def _accept_loop(self) -> None:
+        assert self._server_sock is not None
+        while self._running:
+            try:
+                conn, _ = self._server_sock.accept()
+            except OSError:
+                return
+            with self._accepted_lock:
+                if not self._running:
+                    # lost the race with shutdown(): its sweep already ran
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
+                self._accepted.add(conn)
+            threading.Thread(
+                target=self._serve_connection, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_connection(self, sock: socket.socket) -> None:
+        write_lock = threading.Lock()
+        try:
+            while True:
+                frame = _read_frame(sock)
+                if frame is None:
+                    return
+                self._on_frame(sock, write_lock, frame)
+        except (OSError, ValueError):
+            pass
+        finally:
+            with self._accepted_lock:
+                self._accepted.discard(sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class TcpClientServer(IMessagingClient, IMessagingServer):
@@ -118,53 +224,19 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
         self._request_no = itertools.count()
         self._connections: Dict[Endpoint, _Connection] = {}
         self._conn_lock = threading.Lock()
-        self._server_sock: Optional[socket.socket] = None
-        self._accept_thread: Optional[threading.Thread] = None
-        self._running = False
+        self._framed = FramedTcpServer(listen_address, self._on_frame, "tcp-server")
 
     # -- server side ---------------------------------------------------------
 
     def start(self) -> None:
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((self.address.hostname.decode(), self.address.port))
-        sock.listen(128)
-        self._server_sock = sock
-        self._running = True
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"tcp-server-{self.address}", daemon=True
+        self._framed.start()
+
+    def _on_frame(self, sock: socket.socket, write_lock: threading.Lock,
+                  frame: bytes) -> None:
+        request_no, msg = decode(frame)
+        self._dispatch(msg).add_callback(
+            lambda p, rn=request_no: self._reply(sock, write_lock, rn, p)
         )
-        self._accept_thread.start()
-
-    def _accept_loop(self) -> None:
-        assert self._server_sock is not None
-        while self._running:
-            try:
-                conn, _ = self._server_sock.accept()
-            except OSError:
-                return
-            threading.Thread(
-                target=self._serve_connection, args=(conn,), daemon=True
-            ).start()
-
-    def _serve_connection(self, sock: socket.socket) -> None:
-        write_lock = threading.Lock()
-        try:
-            while True:
-                frame = _read_frame(sock)
-                if frame is None:
-                    return
-                request_no, msg = decode(frame)
-                self._dispatch(msg).add_callback(
-                    lambda p, rn=request_no: self._reply(sock, write_lock, rn, p)
-                )
-        except (OSError, ValueError):
-            pass
-        finally:
-            try:
-                sock.close()
-            except OSError:
-                pass
 
     def _reply(self, sock: socket.socket, write_lock: threading.Lock,
                request_no: int, promise: Promise) -> None:
@@ -223,7 +295,12 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
         )
         timer.daemon = True
         timer.start()
-        out.add_callback(lambda _: timer.cancel())
+
+        def on_complete(_p: Promise, c=conn, rn=request_no) -> None:
+            timer.cancel()
+            c.forget(rn)
+
+        out.add_callback(on_complete)
         return out
 
     def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
@@ -237,12 +314,7 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
     # -- lifecycle -----------------------------------------------------------
 
     def shutdown(self) -> None:
-        self._running = False
-        if self._server_sock is not None:
-            try:
-                self._server_sock.close()
-            except OSError:
-                pass
+        self._framed.shutdown()
         with self._conn_lock:
             connections = list(self._connections.values())
             self._connections.clear()
