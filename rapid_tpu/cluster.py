@@ -187,7 +187,18 @@ class ClusterBuilder:
     def _fd(self, client: IMessagingClient) -> IEdgeFailureDetectorFactory:
         if self._fd_factory is not None:
             return self._fd_factory
-        return PingPongFailureDetectorFactory(self._listen_address, client)
+        if self._settings.fd_policy == "windowed":
+            from .monitoring.pingpong import WindowedPingPongFailureDetectorFactory
+
+            return WindowedPingPongFailureDetectorFactory(
+                self._listen_address, client,
+                window=self._settings.fd_window,
+                threshold=self._settings.fd_window_threshold,
+            )
+        return PingPongFailureDetectorFactory(
+            self._listen_address, client,
+            failure_threshold=self._settings.fd_failure_threshold,
+        )
 
     def start(self) -> Cluster:
         """Bootstrap a seed node (Cluster.java:255-280)."""

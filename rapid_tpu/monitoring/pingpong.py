@@ -30,18 +30,20 @@ class PingPongFailureDetector:
         subject: Endpoint,
         client: IMessagingClient,
         notifier: Callable[[], None],
+        failure_threshold: int = FAILURE_THRESHOLD,
     ) -> None:
         self._address = address
         self._subject = subject
         self._client = client
         self._notifier = notifier
+        self._failure_threshold = failure_threshold
         self._failure_count = 0
         self._bootstrap_response_count = 0
         self._notified = False
         self._probe = ProbeMessage(sender=address)
 
     def has_failed(self) -> bool:
-        return self._failure_count >= FAILURE_THRESHOLD
+        return self._failure_count >= self._failure_threshold
 
     def __call__(self) -> None:
         if self.has_failed() and not self._notified:
@@ -67,14 +69,19 @@ class PingPongFailureDetector:
 
 
 class PingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
-    def __init__(self, address: Endpoint, client: IMessagingClient) -> None:
+    def __init__(self, address: Endpoint, client: IMessagingClient,
+                 failure_threshold: int = FAILURE_THRESHOLD) -> None:
         self._address = address
         self._client = client
+        self._failure_threshold = failure_threshold
 
     def create_instance(
         self, subject: Endpoint, notifier: Callable[[], None]
     ) -> Callable[[], None]:
-        return PingPongFailureDetector(self._address, subject, self._client, notifier)
+        return PingPongFailureDetector(
+            self._address, subject, self._client, notifier,
+            self._failure_threshold,
+        )
 
 
 class WindowedPingPongFailureDetector(PingPongFailureDetector):
@@ -95,10 +102,13 @@ class WindowedPingPongFailureDetector(PingPongFailureDetector):
         return sum(window) >= self._threshold * window.maxlen  # type: ignore[operator]
 
     def _on_probe_done(self, promise: Promise) -> None:
-        before = self._failure_count + self._bootstrap_response_count
+        # only genuine failures enter the window: BOOTSTRAPPING replies within
+        # the 30-reply tolerance are not failures (they increment
+        # failure_count only past the tolerance, matching the cumulative
+        # policy), else the windowed policy would flap on joining subjects
+        before = self._failure_count
         super()._on_probe_done(promise)
-        failed = (self._failure_count + self._bootstrap_response_count) > before
-        self._window.append(failed)
+        self._window.append(self._failure_count > before)
 
 
 class WindowedPingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):

@@ -22,6 +22,23 @@ class Settings:
     failure_detector_interval_ms: int = 1000
     batching_window_ms: int = 100
 
+    # Failure-detector policy, mirrored from the sim plane's SimConfig
+    # (fd_policy/fd_window/fd_window_threshold) so both planes expose the
+    # same knobs: "cumulative" = the reference's never-reset counter
+    # (PingPongFailureDetector.java:69-77, FAILURE_THRESHOLD=10);
+    # "windowed" = the paper's policy (atc-2018 section 6): faulty when
+    # >= fd_window_threshold of the last fd_window probes failed.
+    fd_policy: str = "cumulative"
+    fd_failure_threshold: int = 10
+    fd_window: int = 10
+    fd_window_threshold: float = 0.4
+
+    def __post_init__(self) -> None:
+        assert self.fd_policy in ("cumulative", "windowed"), (
+            f"fd_policy must be 'cumulative' or 'windowed', got "
+            f"{self.fd_policy!r}"
+        )
+
     # Consensus fallback (FastPaxos.java:46)
     consensus_fallback_base_delay_ms: int = 1000
 

@@ -221,10 +221,7 @@ def _sharded_round(
     )
 
 
-def make_sharded_run(
-    config: SimConfig, mesh: Mesh, rounds: int, random_loss: bool = True
-):
-    """Build the jitted multi-device round loop: scan of shard_map'd rounds."""
+def _mesh_specs(config: SimConfig, mesh: Mesh):
     n_dev = int(np.prod([mesh.shape[name] for name in mesh.axis_names]))
     assert config.capacity % n_dev == 0, (
         f"capacity {config.capacity} must divide evenly over {n_dev} devices"
@@ -233,6 +230,14 @@ def make_sharded_run(
     input_specs = jax.tree_util.tree_map(lambda s: s.spec, input_shardings(mesh))
     axes = tuple(mesh.axis_names)
     axis_sizes = tuple(mesh.shape[name] for name in axes)
+    return state_specs, input_specs, axes, axis_sizes
+
+
+def make_sharded_run(
+    config: SimConfig, mesh: Mesh, rounds: int, random_loss: bool = True
+):
+    """Build the jitted multi-device round loop: scan of shard_map'd rounds."""
+    state_specs, input_specs, axes, axis_sizes = _mesh_specs(config, mesh)
 
     body = jax.shard_map(
         functools.partial(_sharded_round, config, axes, axis_sizes, random_loss),
@@ -251,3 +256,45 @@ def make_sharded_run(
         return final
 
     return run
+
+
+def make_sharded_run_until(
+    config: SimConfig, mesh: Mesh, random_loss: bool = True
+):
+    """One-dispatch mesh decision loop: a while_loop of shard_map'd rounds.
+
+    Multi-chip runs stop at the decision round exactly like the single-device
+    closed-form dispatch (engine.run_until_decided_const's early-exit
+    semantics) instead of paying full scan batches, and the round budget is a
+    *dynamic* operand, so changing the batch size never re-jits. The loop
+    condition reads the replicated ``decided`` scalar, so every shard takes
+    the same trip count and the in-body ``pmax`` stays collective-safe. The
+    body is the same per-round function the scan path runs, which makes the
+    two paths bit-identical round for round (post-decision scan rounds are
+    masked no-ops that preserve state, including ``rng_key``).
+    """
+    state_specs, input_specs, axes, axis_sizes = _mesh_specs(config, mesh)
+
+    def run_until(
+        state: SimState, inputs: RoundInputs, max_rounds: jax.Array
+    ) -> SimState:
+        def cond(carry):
+            st, r = carry
+            return (r < max_rounds) & ~st.decided
+
+        def body(carry):
+            st, r = carry
+            st = _sharded_round(config, axes, axis_sizes, random_loss, st, inputs)
+            return st, r + 1
+
+        final, _ = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
+        return final
+
+    sharded = jax.shard_map(
+        run_until,
+        mesh=mesh,
+        in_specs=(state_specs, input_specs, P()),
+        out_specs=state_specs,
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -401,9 +401,12 @@ class TpuSimMessaging:
         rec = None
         rounds_before = sim.metrics.get("rounds")
         if members_before and self._informed_config != config_before:
-            # phase A: run only to the announcement, so real members can vote
+            # phase A: run only to the announcement, so real members can vote.
+            # batch=1 so the announcement is observed the round it happens --
+            # with a wider batch, announcement and decision can land inside
+            # one dispatch and the pre-decision broadcast would be skipped
             rec = sim.run_until_decision(
-                max_rounds=max_rounds, batch=batch,
+                max_rounds=max_rounds, batch=1,
                 classic_fallback_after_rounds=classic_fallback_after_rounds,
                 stop_when_announced=True,
             )

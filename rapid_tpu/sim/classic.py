@@ -31,9 +31,14 @@ Mapping to the reference:
   ``vrnd != rank`` (Paxos.java:205-213); the decision needs more than N/2
   acceptances (Paxos.java:229-236).
 
-Delivery is uniform for recovery traffic: the per-group broadcast fault
-plane shapes the *fast* round (that is what makes it stall); the classic
-round models the post-stall repair among whoever is live.
+Recovery traffic rides the same delivery-group fault plane as alert and vote
+broadcasts: an acceptor only hears a coordinator whose group-delivery edge is
+up (phase1a/2a), and only responses the coordinator's own group hears count
+toward its quorums (phase1b/2b) -- so a partitioned coordinator cannot
+manufacture a decision, exactly as lost gRPC traffic starves the reference's
+coordinator (Paxos.java:160-236). Acceptor state still advances for every
+acceptor that heard the broadcast, even when its response is lost on the way
+back.
 """
 
 from __future__ import annotations
@@ -46,12 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .engine import SimConfig, SimState
-
-# rank = round << RANK_BITS | node  (node = slot index; distinct per slot,
-# the reference uses an address hash for the same tie-breaking role)
-RANK_BITS = 21
-FAST_RANK = (1 << RANK_BITS) | 1  # registerFastRoundVote's (1, 1) rank
+from .engine import FAST_RANK, RANK_BITS, SimConfig, SimState
 
 
 def make_rank(round_no: int, node: int) -> int:
@@ -83,22 +83,30 @@ class Phase1Summary(NamedTuple):
 
 
 @functools.partial(jax.jit, static_argnums=0)
-def phase1(config: SimConfig, state: SimState, rank: jax.Array):
+def phase1(
+    config: SimConfig,
+    state: SimState,
+    rank: jax.Array,
+    hears_coord: jax.Array,  # bool[C] acceptor hears the coordinator's 1a/2a
+    coord_hears: jax.Array,  # bool[C] coordinator hears the acceptor's 1b/2b
+):
     """Phase1a broadcast + the aggregate of the phase1b responses.
 
-    Every live acceptor with ``rnd < rank`` promises (bumps rnd) and reports
-    its (vrnd, vval); the summary is what the coordinator's phase1b inbox
-    would contain (Paxos.java:135-145,160-190). Votes are counted per
-    *value*: proposal rows holding identical cut masks (a group row and an
-    extern row interned from real members' votes) pool their counts through
-    the same [P, P] equality matrix as the fast-round tally, with ``rep``
-    naming each value's canonical row."""
+    Every live acceptor that *hears the broadcast* and has ``rnd < rank``
+    promises (bumps rnd) and reports its (vrnd, vval); only responses the
+    coordinator's delivery group hears enter the summary -- what its phase1b
+    inbox would actually contain (Paxos.java:135-145,160-190). Votes are
+    counted per *value*: proposal rows holding identical cut masks (a group
+    row and an extern row interned from real members' votes) pool their
+    counts through the same [P, P] equality matrix as the fast-round tally,
+    with ``rep`` naming each value's canonical row."""
     live = state.active & state.alive
     rnd, vrnd, vval = _effective(state)
-    promise = live & (rank > rnd)
+    promise = live & hears_coord & (rank > rnd)
     classic_rnd = jnp.where(promise, rank, state.classic_rnd)
 
-    has_vote = promise & (vrnd > 0) & (vval >= 0)
+    heard = promise & coord_hears
+    has_vote = heard & (vrnd > 0) & (vval >= 0)
     max_vrnd = jnp.max(jnp.where(has_vote, vrnd, 0))
     p = config.proposal_rows
     rows = jnp.clip(vval, 0, p - 1)
@@ -112,7 +120,7 @@ def phase1(config: SimConfig, state: SimState, rank: jax.Array):
         state.proposal[:, None, :] == state.proposal[None, :, :], axis=2
     ).astype(jnp.int32)  # [P, P]
     summary = Phase1Summary(
-        promised=promise.sum(),
+        promised=heard.sum(),
         max_vrnd=max_vrnd,
         at_max=eq @ at_max_row,
         any_vval=eq @ any_row,
@@ -122,22 +130,31 @@ def phase1(config: SimConfig, state: SimState, rank: jax.Array):
 
 
 @functools.partial(jax.jit, static_argnums=0)
-def phase2(config: SimConfig, state: SimState, rank: jax.Array, row: jax.Array):
+def phase2(
+    config: SimConfig,
+    state: SimState,
+    rank: jax.Array,
+    row: jax.Array,
+    hears_coord: jax.Array,
+    coord_hears: jax.Array,
+):
     """Phase2a broadcast + the phase2b acceptance count.
 
-    An acceptor accepts iff ``rnd <= rank`` and ``vrnd != rank``
-    (Paxos.java:205-213); more than N/2 acceptances decide
-    (Paxos.java:229-236)."""
+    An acceptor that hears the broadcast accepts iff ``rnd <= rank`` and
+    ``vrnd != rank`` (Paxos.java:205-213); more than N/2 acceptances decide
+    (Paxos.java:229-236) -- counted from the coordinator's vantage (only
+    phase2b broadcasts its group hears), a conservative stand-in for the
+    reference's any-node-with-majority-decides."""
     live = state.active & state.alive
     rnd, vrnd, _ = _effective(state)
-    accept = live & (rank >= rnd) & (vrnd != rank)
+    accept = live & hears_coord & (rank >= rnd) & (vrnd != rank)
     state = dataclasses.replace(
         state,
         classic_rnd=jnp.where(accept, rank, state.classic_rnd),
         classic_vrnd=jnp.where(accept, rank, state.classic_vrnd),
         classic_vval=jnp.where(accept, row, state.classic_vval),
     )
-    return state, accept.sum()
+    return state, (accept & coord_hears).sum()
 
 
 class ClassicCoordinator:
@@ -151,11 +168,19 @@ class ClassicCoordinator:
         self.slot = slot
         self.rank = make_rank(round_no, slot)
         self._summary: Optional[Phase1Summary] = None
+        # recovery traffic rides the delivery-group fault plane: which
+        # acceptors hear THIS coordinator's broadcasts, and whose responses
+        # its own group hears
+        deliver = sim._deliver  # noqa: SLF001 -- [G, C] host fault plane
+        group_of = sim.group_of
+        self._hears_coord = jnp.asarray(deliver[group_of, slot])
+        self._coord_hears = jnp.asarray(deliver[group_of[slot], :])
 
     def phase1(self) -> bool:
         """Run phase1a/1b; True iff a majority of the membership promised."""
         self.sim.state, summary = phase1(
-            self.sim.config, self.sim.state, jnp.int32(self.rank)
+            self.sim.config, self.sim.state, jnp.int32(self.rank),
+            self._hears_coord, self._coord_hears,
         )
         self._summary = jax.device_get(summary)
         n = int(self.sim.active.sum())
@@ -190,7 +215,7 @@ class ClassicCoordinator:
         coordinator)."""
         self.sim.state, accepted = phase2(
             self.sim.config, self.sim.state, jnp.int32(self.rank),
-            jnp.int32(row),
+            jnp.int32(row), self._hears_coord, self._coord_hears,
         )
         n = int(self.sim.active.sum())
         return row if int(jax.device_get(accepted)) > n // 2 else None

@@ -137,6 +137,10 @@ class Simulator:
         self._join_reports_armed = False
         self._pending_leavers: Set[int] = set()
         self._last_announcement: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        # host-side randomness for the classic-fallback coordinator race
+        # (which nodes' expovariate timers fire first, FastPaxos.java:200-203);
+        # seeded so runs replay deterministically
+        self._host_rng = np.random.default_rng(self.seed ^ 0x5EED_C1A5)
         self._down_reports_dev: Optional[jax.Array] = None
         self._injected_down = np.zeros(
             (self.config.capacity, self.config.k), dtype=bool
@@ -388,6 +392,14 @@ class Simulator:
         Returns True iff the vote was registered."""
         if slot in self._extern_voted:
             return False  # dedup by sender (FastPaxos.java:134-141)
+        from .engine import FAST_RANK
+
+        if int(np.asarray(self.state.classic_rnd[slot])) >= FAST_RANK:
+            # the slot already joined a classic round: its fast vote must not
+            # count toward a fast quorum (registerFastRoundVote refuses once
+            # rnd.round > 1, Paxos.java:246-248) -- same gate the engine
+            # applies to auto-voting slots
+            return False
         mask = np.zeros(self.config.capacity, dtype=bool)
         mask[np.atleast_1d(cut)] = True
         key = mask.tobytes()
@@ -591,9 +603,12 @@ class Simulator:
             use_scan = random_loss or self.config.fd_policy == "windowed"
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
                 if self.mesh is not None:
-                    # inputs are already placed under their dispatch shardings
-                    self.state = self._sharded_run(n, random_loss)(
-                        self.state, inputs
+                    # inputs are already placed under their dispatch shardings;
+                    # the while_loop runner exits at the decision round and
+                    # takes the budget as a dynamic operand (no re-jit when the
+                    # batch size changes)
+                    self.state = self._sharded_run_until(random_loss)(
+                        self.state, inputs, jnp.int32(n)
                     )
                 elif use_scan:
                     # per-round (possibly RNG-consuming) scan path
@@ -674,7 +689,8 @@ class Simulator:
         return self.config.fd_interval_ms // self.config.rounds_per_interval
 
     def _sharded_run(self, rounds: int, random_loss: bool):
-        """The jitted mesh round loop, cached per (length, loss-model)."""
+        """The jitted mesh scan loop, cached per (length, loss-model). Kept
+        for differential testing against the early-exit runner."""
         key = (rounds, random_loss)
         if key not in self._sharded_runs:
             from ..shard.engine import make_sharded_run
@@ -684,14 +700,35 @@ class Simulator:
             )
         return self._sharded_runs[key]
 
+    def _sharded_run_until(self, random_loss: bool):
+        """The jitted mesh decision loop, cached per loss-model only: the
+        round budget is a dynamic operand, so every batch size shares one
+        executable (two at most per simulator lifetime)."""
+        key = ("until", random_loss)
+        if key not in self._sharded_runs:
+            from ..shard.engine import make_sharded_run_until
+
+            self._sharded_runs[key] = make_sharded_run_until(
+                self.config, self.mesh, random_loss
+            )
+        return self._sharded_runs[key]
+
     def _run_classic_round(self) -> Optional[int]:
         """One classic recovery attempt with per-node acceptor state on
-        device (sim/classic.py): the lowest live slot coordinates -- the
-        deterministic stand-in for whichever node's expovariate fallback
-        timer fires first (FastPaxos.java:189-203) -- at a round number that
-        grows with each failed attempt, so retries outrank earlier rounds.
-        Returns the decided proposal row, or None if this attempt failed
-        (no quorum, no valid vote reported, or outranked)."""
+        device (sim/classic.py). Every live node's expovariate fallback timer
+        races (FastPaxos.java:200-203: delay ~ Exp(1/N), so ~1 start/sec
+        cluster-wide); the node(s) whose timers fire first within the attempt
+        window coordinate *concurrently* -- their phase1 promises contend on
+        the shared acceptor state, a later-arriving higher rank steals the
+        quorum from an earlier one mid-exchange, and safety rests on the
+        acceptors (rank checks + the Fig.-2 value pick), not on any host-side
+        single-coordinator shortcut. The attempt's round number grows with
+        each failure, so retries outrank earlier rounds. Recovery traffic
+        rides the delivery-group fault plane (see sim/classic.py).
+
+        Returns the decided proposal row, or None if the attempt failed
+        (no quorum, no valid vote reported, or every coordinator outranked).
+        """
         from .classic import RANK_BITS, ClassicCoordinator
 
         live = self.active & self.alive
@@ -701,16 +738,42 @@ class Simulator:
         if 2 + self._classic_attempts >= (1 << (31 - RANK_BITS)):
             return None  # rank space exhausted: stay stalled gracefully
         self._classic_attempts += 1
-        coordinator = ClassicCoordinator(
-            self, round_no=1 + self._classic_attempts,
-            slot=int(np.flatnonzero(live)[0]),
-        )
-        if not coordinator.phase1():
-            return None
-        row = coordinator.pick_value()
-        if row is None:
-            return None
-        return coordinator.phase2(row)
+        live_slots = np.flatnonzero(live)
+        # expovariate arrival times, mean n per node => cluster-wide the
+        # earliest fires ~Exp(1) into the window; everyone firing within one
+        # round of it races this attempt (capped: >3-way races are vanishing)
+        times = self._host_rng.exponential(scale=max(n, 1), size=len(live_slots))
+        order = np.argsort(times)
+        sorted_times = times[order]
+        racing = min(1 + int((sorted_times[1:] - sorted_times[0] < 1.0).sum()), 3)
+        coordinators = [
+            ClassicCoordinator(
+                self, round_no=1 + self._classic_attempts,
+                slot=int(live_slots[order[i]]),
+            )
+            for i in range(racing)
+        ]
+        # phase1 wave in arrival order. Ranks are (round, slot) pairs -- the
+        # higher SLOT outranks within the shared round regardless of who
+        # fired first (the reference breaks ties by address hash the same
+        # way, Paxos.java:97-110) -- so a later-arriving lower rank wins
+        # nothing, while a later-arriving higher rank steals the quorum from
+        # the earlier coordinator mid-exchange; acceptor-side rank checks
+        # arbitrate both interleavings
+        promised = [c.phase1() for c in coordinators]
+        decided = None
+        for coordinator, ok in zip(coordinators, promised):
+            if not ok:
+                continue
+            row = coordinator.pick_value()
+            if row is None:
+                continue
+            won = coordinator.phase2(row)
+            if won is not None and decided is None:
+                decided = won
+        if racing > 1:
+            self.metrics.incr("classic_coordinator_races")
+        return decided
 
     def _apply_view_change(
         self,

@@ -85,10 +85,6 @@ class SimConfig:
     fd_policy: str = "cumulative"
     fd_window: int = 10
     fd_window_threshold: float = 0.4
-    # Fuse the probe/counter/alert elementwise phase into one Pallas kernel
-    # (sim/pallas_kernels.py). "off" = stock jax; "tpu" = hardware kernel;
-    # "interpret" = Pallas interpreter (CPU-testable).
-    pallas_fd: str = "off"
     # Extra proposal rows past the G group rows, reserved for values proposed
     # by bridged real nodes (sim/bridge.py registers their actual fast-round
     # votes into these rows). 0 = all-simulated cluster.
@@ -99,12 +95,31 @@ class SimConfig:
     # see different interleavings of the alert stream, so with staggered FD
     # phases they can cross H at different times holding different report
     # snapshots and propose *different* cuts, purely from timing. 0 disables
-    # the delay buffer entirely (static).
+    # the delay buffer entirely (static). Scope: the delay applies to ALERT
+    # traffic only -- join reports and the fast-round vote hop always arrive
+    # exactly one round after casting (votes are shaped by the ``deliver``
+    # drop mask, not by latency); the conflict regime this models needs only
+    # the alert stream to skew.
     max_delivery_delay: int = 0
+
+    def __post_init__(self) -> None:
+        assert self.fd_policy in ("cumulative", "windowed"), (
+            f"fd_policy must be 'cumulative' or 'windowed', got "
+            f"{self.fd_policy!r}"
+        )
 
     @property
     def proposal_rows(self) -> int:
         return self.groups + self.extern_proposals
+
+
+# Classic-Paxos rank packing, shared with sim.classic: rank =
+# round << RANK_BITS | node; the fast round is rank (1, 1)
+# (registerFastRoundVote, Paxos.java:244-258), so every classic rank
+# outranks it. Defined here so the engine's fast-vote gate and the classic
+# recovery layer agree without a circular import.
+RANK_BITS = 21
+FAST_RANK = (1 << RANK_BITS) | 1
 
 
 @jax.tree_util.register_dataclass
@@ -333,8 +348,14 @@ def route_and_tally(
     # FastPaxos.java:134-141). Bridged real slots (auto_vote=False) vote only
     # when the host registers their actual message.
     live = active & alive
+    # a node that already joined a classic round (promised or accepted at a
+    # classic rank) must not have a fast vote counted toward a fast quorum --
+    # registerFastRoundVote refuses once rnd.round > 1 (Paxos.java:246-248);
+    # without this gate the fast/classic quorum-intersection argument weakens
+    # under concurrent coordinators
     new_voters = (
         live & state.auto_vote & announced[state.group_of] & ~state.voted
+        & (state.classic_rnd < FAST_RANK)
     )
     voted = state.voted | new_voters
     vote_prop = jnp.where(new_voters, state.group_of, state.vote_prop)
@@ -462,24 +483,11 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
 
     fd_fail, fd_hist, fd_seen = state.fd_fail, state.fd_hist, state.fd_seen
     if config.fd_policy == "windowed":
-        assert config.pallas_fd == "off", "windowed policy is stock-jax only"
         probed = edge_live & observer_up
         fd_hist, fd_seen, new_down = windowed_fd_phase(
             config, state, probed, probed & ~probe_ok
         )
         alerted = state.alerted | new_down
-    elif config.pallas_fd != "off":
-        from .pallas_kernels import fd_phase
-
-        fd_fail, alerted, new_down = fd_phase(
-            edge_live,
-            jnp.broadcast_to(observer_up, (c, k)),
-            probe_ok,
-            state.fd_fail,
-            state.alerted,
-            threshold=config.fd_threshold,
-            interpret=config.pallas_fd == "interpret",
-        )
     else:
         fail_event = edge_live & observer_up & ~probe_ok
         fd_fail = state.fd_fail + fail_event.astype(jnp.int32)

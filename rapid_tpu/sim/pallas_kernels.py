@@ -16,14 +16,27 @@ vectorize on the VPU's 8x128 shape).
 Validated in interpret mode against the stock-jax formulation
 (tests/test_pallas_kernels.py) and bit-identical on real TPU hardware
 (v5 lite, tests/test_pallas_kernels.py::test_hardware_kernel_matches_stock,
-opt-in via RAPID_TPU_PALLAS_HW=1). Measured on hardware the stock-XLA fusion
-of this elementwise chain is FASTER (1.6ms vs 2.4ms per call at [100k, 10]):
-K=10 occupies 10 of 128 VPU lanes per row tile, so the hand-written kernel
-wastes lane parallelism that XLA's layout assignment recovers by reshaping.
-The kernel therefore stays flag-gated (``SimConfig.pallas_fd``) as an
-exemplar of the Pallas seam rather than the default path; it would win only
-for K padded near the lane width or when fused with neighbor phases Pallas
-can keep in VMEM.
+opt-in via RAPID_TPU_PALLAS_HW=1).
+
+**Verdict: NOT wired into the engine.** Both halves of the question were
+measured on a real v5e chip:
+
+1. Elementwise-only kernel (this file): stock XLA is FASTER (1.6 ms vs
+   2.4 ms per call at [100k, 10]). K=10 occupies 10 of 128 VPU lanes per
+   row tile, so the hand-written kernel wastes lane parallelism that XLA's
+   layout assignment recovers by reshaping.
+2. The hypothesized win -- fusing the dst-indexed arrival gather
+   (``take_along_axis(new_down, observers, axis=0)``) into the same
+   VMEM-resident kernel -- does not lower: Mosaic rejects the dynamic
+   cross-row gather (MosaicError, v5e toolchain, 2026-07). The gather must
+   stay in stock jax, where XLA's TPU gather lowering already fuses the
+   producing elementwise chain into it.
+
+With neither path winning, the engine runs pure stock jax (the former
+``SimConfig.pallas_fd`` flag is deleted); this module remains as the
+measured exemplar of the Pallas seam, kept compiling and bit-identical by
+its tests. It would only be worth rewiring for K padded near the 128-lane
+width, or if a future Mosaic supports in-kernel row gathers.
 """
 
 from __future__ import annotations

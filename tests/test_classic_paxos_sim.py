@@ -174,6 +174,10 @@ def test_phase1_pools_identical_values_across_rows():
     for slot in blind:
         sim.auto_vote[int(slot)] = False
         assert sim.register_extern_vote(int(slot), victims)
+    # the partition heals before recovery: classic traffic rides the same
+    # delivery fault plane as broadcasts, so a group-0 coordinator could not
+    # hear the blind group's phase1b responses while the drop was active
+    sim.clear_link_faults()
     live = np.flatnonzero(sim.active & sim.alive)
     c = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
     assert c.phase1()
@@ -185,3 +189,86 @@ def test_phase1_pools_identical_values_across_rows():
     assert rep[extern_row] == 0  # canonical row of the shared value
     assert c.pick_value() == 0
     assert c.phase2(0) == 0
+
+
+def test_driver_races_concurrent_coordinators(monkeypatch):
+    """Driver-level fallback race (VERDICT r2 item 7): two nodes' expovariate
+    timers fire within a round of each other, so the driver runs their
+    coordinators CONCURRENTLY -- the later, higher-ranked one steals the
+    quorum mid-exchange and the round still converges on the announced cut,
+    with safety arbitrated by the shared device acceptor state."""
+    sim, victims = _stalled_sim(seed=17)
+    n_live = int((sim.active & sim.alive).sum())
+
+    class RiggedRng:
+        def exponential(self, scale, size):
+            t = np.full(size, 1_000_000.0)
+            t[0] = 0.2  # first timer
+            t[1] = 0.6  # second fires within one round: a genuine race
+            return t
+
+    monkeypatch.setattr(sim, "_host_rng", RiggedRng())
+    rec = sim.run_until_decision(max_rounds=8, classic_fallback_after_rounds=2)
+    assert rec is not None and rec.via_classic_round
+    np.testing.assert_array_equal(np.sort(rec.cut), victims)
+    assert sim.metrics.get("classic_coordinator_races") == 1
+    assert sim.membership_size == n_live  # victims were already dead, now cut
+
+
+def test_recovery_traffic_rides_delivery_fault_plane():
+    """A coordinator whose own group hears nobody cannot manufacture a
+    decision: its phase1b inbox stays empty even though acceptors heard and
+    promised to its phase1a (lost responses still advance acceptor state,
+    like lost gRPC responses in the reference)."""
+    from rapid_tpu.sim.classic import make_rank
+
+    n = 400
+    config = SimConfig(capacity=n, groups=2)
+    sim = Simulator(n, config=config, seed=23)
+    group_of = np.zeros(n, dtype=np.int32)
+    group_of[0] = 1  # the deaf coordinator's own group
+    sim.set_delivery_groups(group_of)
+    victims = np.array([7])
+    sim.crash(victims)
+    sim.run_until_decision(max_rounds=4, classic_fallback_after_rounds=None)
+    sim.drop_broadcasts(1, np.arange(n))  # group 1 hears nothing
+    deaf = ClassicCoordinator(sim, round_no=2, slot=0)
+    assert not deaf.phase1()  # no audible phase1b majority
+    # but the acceptors it reached did promise: a later, lower-ranked
+    # coordinator cannot win them back
+    rnd = np.asarray(sim.state.classic_rnd)
+    assert (rnd >= make_rank(2, 0)).sum() > n // 2
+
+
+def test_driver_race_later_arrival_outranked(monkeypatch):
+    """The other interleaving: the FIRST timer to fire belongs to a higher
+    slot, so the later coordinator is outranked (rank = (round, slot), slot
+    breaks the tie like the reference's address hash) -- its phase1 wins no
+    quorum and the earlier, higher-ranked coordinator decides."""
+    sim, victims = _stalled_sim(seed=19)
+
+    class RiggedRng:
+        def exponential(self, scale, size):
+            t = np.full(size, 1_000_000.0)
+            t[9] = 0.2  # higher slot fires FIRST
+            t[0] = 0.6  # lower slot races, arrives second, is outranked
+            return t
+
+    monkeypatch.setattr(sim, "_host_rng", RiggedRng())
+    rec = sim.run_until_decision(max_rounds=8, classic_fallback_after_rounds=2)
+    assert rec is not None and rec.via_classic_round
+    np.testing.assert_array_equal(np.sort(rec.cut), victims)
+    assert sim.metrics.get("classic_coordinator_races") == 1
+
+
+def test_extern_vote_refused_after_classic_participation():
+    """register_extern_vote applies the registerFastRoundVote gate
+    (Paxos.java:246-248): a slot that promised in a classic round cannot have
+    a fast vote counted toward a fast quorum."""
+    sim, victims = _stalled_sim(seed=22)
+    live = np.flatnonzero(sim.active & sim.alive)
+    c = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
+    assert c.phase1()  # every live group-0 acceptor promised at a classic rank
+    promised = int(live[3])
+    sim.auto_vote[promised] = False
+    assert not sim.register_extern_vote(promised, victims)

@@ -52,20 +52,30 @@ def test_fd_phase_odd_capacity_single_block():
         np.testing.assert_array_equal(np.asarray(g), w)
 
 
-def test_simulation_identical_with_pallas_fd():
-    """Whole-run equivalence: crash burst with the Pallas path (interpret) vs
-    stock jax -- identical cuts, rounds, and config ids."""
-    outputs = []
-    for pallas_fd in ("off", "interpret"):
-        config = SimConfig(capacity=64, pallas_fd=pallas_fd)
-        sim = Simulator(64, config=config, seed=9)
-        sim.crash(np.array([10, 20, 30]))
-        rec = sim.run_until_decision(max_rounds=20)
-        assert rec is not None
-        outputs.append(
-            (tuple(rec.cut), rec.configuration_id, int(rec.virtual_time_ms))
-        )
-    assert outputs[0] == outputs[1]
+def test_kernel_matches_engine_fd_phase_through_run():
+    """The exemplar kernel's semantics stay in lockstep with the engine's
+    stock-jax FD phase: the per-round state an actual run produces feeds the
+    kernel (interpret) and the reference identically. (The former pallas_fd
+    engine flag was deleted -- measured slower than XLA, see the module
+    docstring -- so equivalence is pinned at the kernel contract.)"""
+    config = SimConfig(capacity=64)
+    sim = Simulator(64, config=config, seed=9)
+    sim.crash(np.array([10, 20, 30]))
+    rec = sim.run_until_decision(max_rounds=20)
+    assert rec is not None
+    rng = np.random.default_rng(9)
+    c, k = 64, config.k
+    fd_fail = np.asarray(sim.state.fd_fail)
+    alerted = np.asarray(sim.state.alerted)
+    edge_live = rng.random((c, k)) < 0.9
+    observer_up = np.ones((c, k), dtype=bool)
+    probe_ok = rng.random((c, k)) < 0.5
+    got = fd_phase(edge_live, observer_up, probe_ok, fd_fail, alerted,
+                   threshold=config.fd_threshold, interpret=True)
+    want = _reference(edge_live, observer_up, probe_ok, fd_fail, alerted,
+                      config.fd_threshold)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), w)
 
 
 @pytest.mark.skipif(
