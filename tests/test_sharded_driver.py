@@ -120,3 +120,20 @@ def test_sharded_decision_single_dispatch_no_rejit(mesh):
     rec2 = sim.run_until_decision(max_rounds=32, batch=5)
     assert rec2 is not None and list(rec2.cut) == [40]
     assert len(sim._sharded_runs) == n_cached
+
+
+def test_sharded_driver_2d_dcn_ici_mesh():
+    """The full driver (early-exit runner included) on a (hosts, chips) 2D
+    mesh: decisions and configuration ids match the single-device run."""
+    mesh2d = make_mesh(shape=(2, 4))
+    records = {}
+    for label, m in (("2d", mesh2d), ("single", None)):
+        sim = Simulator(256, seed=47, mesh=m)
+        sim.crash(np.array([3, 99]))
+        rec = sim.run_until_decision(max_rounds=16, batch=16)
+        assert rec is not None
+        records[label] = rec
+    a, b = records["2d"], records["single"]
+    assert sorted(a.cut) == sorted(b.cut) == [3, 99]
+    assert a.configuration_id == b.configuration_id
+    assert a.virtual_time_ms == b.virtual_time_ms
