@@ -162,3 +162,91 @@ def test_agent_leaves_socket_swarm_gracefully():
         assert a1.get_current_configuration_id() == h.gateway.configuration_id()
     finally:
         h.shutdown()
+
+
+@pytest.mark.slow
+def test_gateway_checkpoint_restart_resume(tmp_path):
+    """Checkpoint/resume across a gateway restart (SURVEY section 5.4 on the
+    socket plane): the restored swarm keeps the configuration id and the
+    real members' seats; live agents reconnect transparently, observe a new
+    cut decided by the restored swarm, and a fresh agent can still join."""
+    h = GatewayHarness(n_virtual=24, seed=14)
+    snapshot = str(tmp_path / "swarm.npz")
+    try:
+        a1 = h.join_agent(1)
+        a2 = h.join_agent(2)
+        assert h.wait_converged(26)
+        config_before = h.gateway.configuration_id()
+
+        h.gateway.save(snapshot)
+        h.gateway.shutdown()
+        time.sleep(0.3)
+
+        h.gateway = SwarmGateway(
+            Endpoint.from_parts("127.0.0.1", h.base),
+            restore_from=snapshot,
+            settings=h.settings,
+            pump_interval_ms=50,
+        )
+        h.gateway.start()
+        assert h.gateway.configuration_id() == config_before
+        assert h.gateway.membership_size() == 26
+        # the restored bridge still knows which slots are real members
+        assert set(h.gateway.bridge._real) == {
+            a1.listen_address, a2.listen_address
+        }
+
+        # the restored swarm decides a new cut and the agents observe it
+        victims = np.array([5, 17])
+        h.gateway.bridge.sim.crash(victims)
+        assert h.wait_converged(24, timeout=90)
+        ids = {a.get_current_configuration_id() for a in h.agents}
+        ids.add(h.gateway.configuration_id())
+        assert len(ids) == 1
+
+        # a brand-new agent joins the restored swarm
+        a3 = h.join_agent(3)
+        assert h.wait_converged(25)
+        assert a3.get_current_configuration_id() == h.gateway.configuration_id()
+    finally:
+        h.shutdown()
+
+
+@pytest.mark.slow
+def test_rejoin_same_address_after_gateway_restore(tmp_path):
+    """A member that was cut BEFORE the snapshot can rejoin on the same
+    address AFTER the restore: stale endpoint->slot mappings must not
+    resurrect (the restored bridge maps only seated endpoints, so the
+    rejoiner is re-seated through the normal pre-join path and re-enters the
+    real-member plane)."""
+    h = GatewayHarness(n_virtual=24, seed=15)
+    snapshot = str(tmp_path / "swarm.npz")
+    try:
+        a1 = h.join_agent(1)
+        a2 = h.join_agent(2)
+        assert h.wait_converged(26)
+        dead_addr = a2.listen_address
+        a2.shutdown()  # abrupt death; the swarm cuts it
+        h.agents.remove(a2)
+        assert h.wait_converged(25, timeout=90)
+
+        h.gateway.save(snapshot)
+        h.gateway.shutdown()
+        h.gateway = SwarmGateway(
+            Endpoint.from_parts("127.0.0.1", h.base),
+            restore_from=snapshot,
+            settings=h.settings,
+            pump_interval_ms=50,
+        )
+        h.gateway.start()
+        assert dead_addr not in h.gateway.bridge._slot_of  # no stale seat
+
+        back = h.join_agent(dead_addr.port - h.base)  # same host:port
+        assert h.wait_converged(26, timeout=90)
+        assert back.listen_address == dead_addr
+        assert dead_addr in h.gateway.bridge._real  # monitored + voting again
+        ids = {a.get_current_configuration_id() for a in h.agents}
+        ids.add(h.gateway.configuration_id())
+        assert len(ids) == 1
+    finally:
+        h.shutdown()
