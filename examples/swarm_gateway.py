@@ -28,6 +28,12 @@ def main() -> None:
     parser.add_argument("--seed", type=int, default=0, help="simulator RNG seed")
     parser.add_argument("--pump-interval-ms", type=int, default=100)
     parser.add_argument("--platform", help="force a jax platform (e.g. cpu)")
+    parser.add_argument(
+        "--restore-from", help="resume from a swarm snapshot (same config id)"
+    )
+    parser.add_argument(
+        "--snapshot", help="checkpoint the swarm to this path on Ctrl-C"
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
 
@@ -52,6 +58,7 @@ def main() -> None:
         seed=args.seed,
         settings=Settings(),
         pump_interval_ms=args.pump_interval_ms,
+        restore_from=args.restore_from,
     )
     gateway.start()
     seed_ep = gateway.seed_endpoint()
@@ -82,6 +89,9 @@ def main() -> None:
                 gateway.configuration_id(),
             )
     except KeyboardInterrupt:
+        if args.snapshot:
+            gateway.save(args.snapshot)
+            log.info("snapshot written to %s", args.snapshot)
         gateway.shutdown()
 
 

@@ -247,13 +247,15 @@ class SwarmGateway:
     def __init__(
         self,
         listen_address: Endpoint,
-        n_virtual: int,
+        n_virtual: int = 0,
         capacity: Optional[int] = None,
         config=None,
         seed: int = 0,
         settings: Optional[Settings] = None,
         pump_interval_ms: int = 100,
         pump_max_rounds: int = 32,
+        restore_from: Optional[str] = None,
+        restore_config_overrides: Optional[dict] = None,
     ) -> None:
         from ..sim.bridge import TpuSimMessaging
 
@@ -263,13 +265,28 @@ class SwarmGateway:
         self._tasks: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
         self._scheduler = _GatewayScheduler(self._drain_for)
         self.network = _GatewayNetwork(self._out, self._scheduler)
-        self.bridge = TpuSimMessaging(
-            self.network,
-            n_virtual=n_virtual,
-            capacity=capacity,
-            config=config,
-            seed=seed,
-        )
+        if restore_from is not None:
+            if n_virtual or capacity is not None or config is not None or seed:
+                raise ValueError(
+                    "restore_from takes identity/config from the snapshot; "
+                    "re-apply non-persisted SimConfig fields via "
+                    "restore_config_overrides, not n_virtual/capacity/"
+                    "config/seed"
+                )
+            self.bridge = TpuSimMessaging.restore(
+                self.network, restore_from,
+                config_overrides=restore_config_overrides,
+            )
+        else:
+            if n_virtual <= 0:
+                raise ValueError("pass n_virtual > 0, or restore_from a snapshot")
+            self.bridge = TpuSimMessaging(
+                self.network,
+                n_virtual=n_virtual,
+                capacity=capacity,
+                config=config,
+                seed=seed,
+            )
         self._pump_interval_s = pump_interval_ms / 1000.0
         self._pump_max_rounds = pump_max_rounds
         self._framed = FramedTcpServer(listen_address, self._on_frame, "gateway")
@@ -295,6 +312,28 @@ class SwarmGateway:
 
     def membership_size(self) -> int:
         return self.bridge.sim.membership_size
+
+    def save(self, path: str, timeout: float = 30.0) -> None:
+        """Checkpoint the swarm (configuration + real-member plane) from the
+        protocol thread, so the snapshot is consistent with in-flight
+        handling. A new gateway started with ``restore_from=path`` resumes
+        the same configuration id; live agents reconnect transparently."""
+        done = threading.Event()
+        error: list = []
+
+        def task() -> None:
+            try:
+                self.bridge.save(path)
+            except Exception as e:  # noqa: BLE001
+                error.append(e)
+            finally:
+                done.set()
+
+        self._tasks.put(task)
+        if not done.wait(timeout):
+            raise TimeoutError("gateway snapshot did not complete")
+        if error:
+            raise error[0]
 
     def start(self) -> None:
         self._running = True

@@ -140,6 +140,84 @@ class TpuSimMessaging:
         self._informed_config: Optional[int] = None
 
     # ------------------------------------------------------------------ #
+    # checkpoint / resume (SURVEY.md section 5.4, extended to the bridge)
+    # ------------------------------------------------------------------ #
+
+    def save(self, path: str) -> None:
+        """Persist the swarm configuration plus the bridge's real-member
+        plane (which slots are owned by external processes, and their
+        metadata). Parked join responses are deliberately NOT persisted -- a
+        restarted gateway, like a restarted Rapid process, makes in-flight
+        joiners retry (Cluster.java:313-344's retry loop handles it)."""
+        import pickle
+
+        real_slots = np.array(sorted(self._real.values()), dtype=np.int64)
+        blob = pickle.dumps({"metadata": dict(self._metadata)})
+        self.sim.save_configuration(
+            path,
+            extra={
+                "real_slots": real_slots,
+                "bridge_blob": np.frombuffer(blob, dtype=np.uint8),
+            },
+        )
+
+    @classmethod
+    def restore(
+        cls,
+        network,
+        path: str,
+        config_overrides: Optional[dict] = None,
+    ) -> "TpuSimMessaging":
+        """Rebuild a bridge swarm from a snapshot: same configuration id,
+        same real-member slot ownership. Live real members keep their seats
+        (their processes sense nothing but a transport blip); dead ones are
+        detected and cut by the restored simulated FDs as usual.
+
+        SimConfig fields the snapshot does not persist (fd_policy/fd_window,
+        rounds_per_interval, delivery-group faults, ...) reset to defaults;
+        pass ``config_overrides`` to re-apply them. extern_proposals defaults
+        to 4 (the bridge needs extern rows for real members' votes)."""
+        import pickle
+
+        overrides = {"extern_proposals": 4}
+        overrides.update(config_overrides or {})
+        sim = Simulator.from_configuration(path, config_overrides=overrides)
+        with np.load(path) as data:
+            real_slots = [int(s) for s in data["extra_real_slots"]]
+            blob = pickle.loads(data["extra_bridge_blob"].tobytes())
+
+        bridge = cls.__new__(cls)
+        bridge.sim = sim
+        bridge.network = network
+        network.attach_handler(bridge)
+        capacity = sim.config.capacity
+        # map ONLY currently-seated endpoints: active slots plus real
+        # members' seats. Mapping every capacity slot would resurrect stale
+        # endpoint->slot entries for previously-cut members and never-seated
+        # spares -- a rejoining agent would then be found "already seated"
+        # and never re-enter _real (votes dropped, liveness unmonitored),
+        # while its slot simultaneously sat in the free list
+        real_set = {int(s) for s in real_slots}
+        bridge._slot_of = {}
+        for slot in range(capacity):
+            if sim.active[slot] or slot in real_set:
+                host, port = sim.endpoint_of(slot)
+                bridge._slot_of[Endpoint(host, port)] = slot
+        bridge._real = {
+            bridge._endpoint(slot): slot for slot in real_slots
+        }
+        for slot in real_slots:
+            sim.set_auto_vote(slot, False)
+        bridge._free_slots = deque(
+            s for s in range(capacity)
+            if not sim.active[s] and s not in real_set
+        )
+        bridge._parked = {}
+        bridge._metadata = dict(blob["metadata"])
+        bridge._informed_config = None
+        return bridge
+
+    # ------------------------------------------------------------------ #
     # identity helpers
     # ------------------------------------------------------------------ #
 

@@ -913,15 +913,20 @@ class Simulator:
     # Checkpoint / resume
     # ------------------------------------------------------------------ #
 
-    def save_configuration(self, path: str) -> None:
+    def save_configuration(self, path: str, extra: Optional[dict] = None) -> None:
         """Persist the configuration snapshot -- the same information a real
         Rapid node needs to bootstrap an identical view (MembershipView
         Configuration, MembershipView.java:517-548): node identities, current
         membership, the append-only identifiersSeen set, and the clock.
         Per-round device state is deliberately NOT persisted; a restarted
-        simulator, like a restarted process, starts a fresh configuration."""
+        simulator, like a restarted process, starts a fresh configuration.
+
+        ``extra``: additional arrays merged into the archive under
+        ``extra_``-prefixed keys (the bridge persists its real-member plane
+        this way); ignored by from_configuration."""
         np.savez_compressed(
             path,
+            **{f"extra_{k}": v for k, v in (extra or {}).items()},
             hostnames=self.cluster.hostnames,
             host_lengths=self.cluster.host_lengths,
             ports=self.cluster.ports,
@@ -942,9 +947,13 @@ class Simulator:
         )
 
     @staticmethod
-    def from_configuration(path: str, mesh=None) -> "Simulator":
+    def from_configuration(
+        path: str, mesh=None, config_overrides: Optional[dict] = None
+    ) -> "Simulator":
         """Rebuild a simulator from a configuration snapshot; the
-        configuration id of the restored instance equals the saved one."""
+        configuration id of the restored instance equals the saved one.
+        ``config_overrides``: SimConfig fields to replace on top of the saved
+        parameters (e.g. extern_proposals for a restored bridge swarm)."""
         with np.load(path) as data:
             params = [int(x) for x in data["params"]]
             (capacity, k, h, l, fd_threshold, fd_interval_ms,
@@ -955,6 +964,8 @@ class Simulator:
                 fd_interval_ms=fd_interval_ms, batching_window_ms=batching_window_ms,
                 groups=groups,
             )
+            if config_overrides:
+                config = dataclasses.replace(config, **config_overrides)
             sim = Simulator.__new__(Simulator)
             sim.config = config
             sim.mesh = mesh
