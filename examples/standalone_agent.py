@@ -40,6 +40,13 @@ def main() -> None:
     )
     parser.add_argument("--fd-interval-ms", type=int, default=1000)
     parser.add_argument(
+        "--fd-policy", choices=("cumulative", "windowed"), default="cumulative",
+        help="cumulative = reference parity (never-reset counter); "
+        "windowed = the paper's '40%% of last N probes' policy",
+    )
+    parser.add_argument("--fd-window", type=int, default=10)
+    parser.add_argument("--fd-window-threshold", type=float, default=0.4)
+    parser.add_argument(
         "--transport", choices=("tcp", "grpc"), default="tcp",
         help="tcp = framed-TCP transport; grpc = wire-compatible with JVM Rapid",
     )
@@ -53,7 +60,12 @@ def main() -> None:
     log = logging.getLogger("agent")
 
     listen = Endpoint.from_string(args.listen_address)
-    settings = Settings(failure_detector_interval_ms=args.fd_interval_ms)
+    settings = Settings(
+        failure_detector_interval_ms=args.fd_interval_ms,
+        fd_policy=args.fd_policy,
+        fd_window=args.fd_window,
+        fd_window_threshold=args.fd_window_threshold,
+    )
     if args.transport == "grpc":
         if args.gateway_address:
             parser.error(

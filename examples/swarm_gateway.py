@@ -52,20 +52,30 @@ def main() -> None:
     from rapid_tpu.messaging.gateway import SwarmGateway
 
     listen = Endpoint.from_string(args.listen_address)
-    gateway = SwarmGateway(
-        listen,
-        n_virtual=args.n_virtual,
-        seed=args.seed,
-        settings=Settings(),
-        pump_interval_ms=args.pump_interval_ms,
-        restore_from=args.restore_from,
-    )
+    if args.restore_from:
+        # identity/config come from the snapshot; n_virtual/seed must not be
+        # passed alongside (SwarmGateway rejects the combination)
+        gateway = SwarmGateway(
+            listen,
+            settings=Settings(),
+            pump_interval_ms=args.pump_interval_ms,
+            restore_from=args.restore_from,
+        )
+    else:
+        gateway = SwarmGateway(
+            listen,
+            n_virtual=args.n_virtual,
+            seed=args.seed,
+            settings=Settings(),
+            pump_interval_ms=args.pump_interval_ms,
+        )
     gateway.start()
     seed_ep = gateway.seed_endpoint()
     log.info(
-        "gateway up at %s hosting %d virtual nodes; seed endpoint %s",
+        "gateway up at %s hosting %d members (%s); seed endpoint %s",
         listen,
-        args.n_virtual,
+        gateway.membership_size(),
+        f"restored from {args.restore_from}" if args.restore_from else "fresh",
         seed_ep,
     )
     print(f"SEED {seed_ep}", flush=True)
