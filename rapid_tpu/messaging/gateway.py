@@ -37,6 +37,7 @@ import socket
 import struct
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..runtime.futures import Promise
@@ -129,9 +130,10 @@ class GatewayRoutedClient(IMessagingClient):
         try:
             conn = self._connection()
             request_no = self._next_request_no()
-            with conn.lock:
+            frame = encode_routed(request_no, remote, msg)
+            with conn.lock:  # no interleaved partial frames among senders
                 conn.outstanding[request_no] = out
-            _write_frame(conn.sock, encode_routed(request_no, remote, msg))
+                _write_frame(conn.sock, frame)
         except OSError as e:
             if not out.done():
                 out.set_exception(e)
@@ -203,6 +205,13 @@ class _GatewayNetwork:
         self._out = out_client
         self._handlers: List[object] = []
         self._probe_ok: Dict[Endpoint, float] = {}
+        # one delivery worker: sends (whose connect can block for the full
+        # message timeout on an unreachable member) run OFF the protocol
+        # thread, so probes/joins from healthy agents are never queued behind
+        # a dead member's dials; a single worker keeps per-member frame order
+        self._delivery = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="gateway-delivery"
+        )
 
     def attach_handler(self, handler) -> None:
         self._handlers.append(handler)
@@ -230,8 +239,31 @@ class _GatewayNetwork:
     def deliver(
         self, src: Endpoint, dst: Endpoint, msg: RapidMessage, timeout_ms: int
     ) -> Promise:
-        # src rides inside the message payload, as on every rapid transport
-        return self._out.send_message_best_effort(dst, msg)
+        # src rides inside the message payload, as on every rapid transport.
+        # Retried (send_message, not best-effort): decision packets are the
+        # member's only way to learn a view change, and a transient socket
+        # failure must not strand it on the old configuration
+        out: Promise = Promise()
+
+        def send() -> None:
+            try:
+                self._out.send_message(dst, msg).add_callback(
+                    lambda p: out.done()
+                    or (
+                        out.set_exception(p.exception())
+                        if p.exception() is not None
+                        else out.try_set_result(p._result)  # noqa: SLF001
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                if not out.done():
+                    out.set_exception(e)
+
+        self._delivery.submit(send)
+        return out
+
+    def shutdown(self) -> None:
+        self._delivery.shutdown(wait=False)
 
 
 class SwarmGateway:
@@ -256,6 +288,7 @@ class SwarmGateway:
         pump_max_rounds: int = 32,
         restore_from: Optional[str] = None,
         restore_config_overrides: Optional[dict] = None,
+        mesh=None,
     ) -> None:
         from ..sim.bridge import TpuSimMessaging
 
@@ -276,6 +309,7 @@ class SwarmGateway:
             self.bridge = TpuSimMessaging.restore(
                 self.network, restore_from,
                 config_overrides=restore_config_overrides,
+                mesh=mesh,
             )
         else:
             if n_virtual <= 0:
@@ -286,6 +320,7 @@ class SwarmGateway:
                 capacity=capacity,
                 config=config,
                 seed=seed,
+                mesh=mesh,
             )
         self._pump_interval_s = pump_interval_ms / 1000.0
         self._pump_max_rounds = pump_max_rounds
@@ -350,6 +385,7 @@ class SwarmGateway:
         self._running = False
         self._framed.shutdown()
         self._tasks.put(None)
+        self.network.shutdown()
         self._out.shutdown()
         self._scheduler.shutdown()
 

@@ -280,9 +280,13 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
         try:
             conn = self._connection(remote)
             request_no = next(self._request_no)
+            frame = encode(request_no, msg)
+            # frame writes hold the connection lock: concurrent senders
+            # (protocol thread, retry timers, delivery workers) must not
+            # interleave partial frames on one socket
             with conn.lock:
                 conn.outstanding[request_no] = out
-            _write_frame(conn.sock, encode(request_no, msg))
+                _write_frame(conn.sock, frame)
         except OSError as e:
             if not out.done():
                 out.set_exception(e)

@@ -113,18 +113,32 @@ class TpuSimMessaging:
         capacity: Optional[int] = None,
         config: Optional[SimConfig] = None,
         seed: int = 0,
+        mesh=None,
     ) -> None:
-        capacity = capacity if capacity is not None else n_virtual + 16
+        """``mesh``: a jax.sharding.Mesh to host the swarm sharded over
+        multiple devices (shard.engine) -- the full composition: external
+        protocol-plane members against a mesh-sharded device swarm. The
+        capacity must divide evenly over the mesh's devices."""
+        import dataclasses
+
+        if capacity is None:
+            capacity = config.capacity if config is not None else n_virtual + 16
+        if mesh is not None:
+            # row-sharded state must divide evenly over the mesh's devices
+            n_dev = int(np.prod(list(mesh.shape.values())))
+            capacity = ((capacity + n_dev - 1) // n_dev) * n_dev
         if config is None:
             config = SimConfig(capacity=capacity)
+        elif config.capacity != capacity:
+            config = dataclasses.replace(config, capacity=capacity)
         if config.extern_proposals == 0:
             # extern rows so real members' votes can be interned as proposal
             # values (register_extern_vote); 4 covers the common regimes --
             # real members agreeing with the swarm pool into one row
-            import dataclasses
-
             config = dataclasses.replace(config, extern_proposals=4)
-        self.sim = Simulator(n_virtual, capacity=capacity, config=config, seed=seed)
+        self.sim = Simulator(
+            n_virtual, capacity=capacity, config=config, seed=seed, mesh=mesh
+        )
         self.network = network
         network.attach_handler(self)
         self._slot_of: Dict[Endpoint, int] = {}
@@ -138,6 +152,14 @@ class TpuSimMessaging:
         # configuration id whose announced proposal was already broadcast to
         # real members (pump phase B runs once per configuration)
         self._informed_config: Optional[int] = None
+        # last decision packet, for catching up members whose delivery was
+        # lost (they reveal themselves by sending traffic stamped with the
+        # pre-decision configuration id); _replay_counts bounds replays per
+        # member per decision; _prior_configs identifies members stale beyond
+        # what a replay can fix (they get cut, like any faulty member)
+        self._last_decision: Optional[tuple] = None
+        self._replay_counts: Dict[Endpoint, int] = {}
+        self._prior_configs: Deque[int] = deque(maxlen=8)
 
     # ------------------------------------------------------------------ #
     # checkpoint / resume (SURVEY.md section 5.4, extended to the bridge)
@@ -167,6 +189,7 @@ class TpuSimMessaging:
         network,
         path: str,
         config_overrides: Optional[dict] = None,
+        mesh=None,
     ) -> "TpuSimMessaging":
         """Rebuild a bridge swarm from a snapshot: same configuration id,
         same real-member slot ownership. Live real members keep their seats
@@ -181,7 +204,9 @@ class TpuSimMessaging:
 
         overrides = {"extern_proposals": 4}
         overrides.update(config_overrides or {})
-        sim = Simulator.from_configuration(path, config_overrides=overrides)
+        sim = Simulator.from_configuration(
+            path, mesh=mesh, config_overrides=overrides
+        )
         with np.load(path) as data:
             real_slots = [int(s) for s in data["extra_real_slots"]]
             blob = pickle.loads(data["extra_bridge_blob"].tobytes())
@@ -215,6 +240,9 @@ class TpuSimMessaging:
         bridge._parked = {}
         bridge._metadata = dict(blob["metadata"])
         bridge._informed_config = None
+        bridge._last_decision = None
+        bridge._replay_counts = {}
+        bridge._prior_configs = deque(maxlen=8)
         return bridge
 
     # ------------------------------------------------------------------ #
@@ -259,9 +287,14 @@ class TpuSimMessaging:
         if isinstance(msg, JoinMessage):
             return self._handle_join(dst, msg)
         if isinstance(msg, BatchedAlertMessage):
+            if msg.messages:
+                self._maybe_catch_up(
+                    msg.sender, msg.messages[0].configuration_id
+                )
             self._absorb_alerts(msg)
             return Promise.completed(Response())
         if isinstance(msg, FastRoundPhase2bMessage):
+            self._maybe_catch_up(msg.sender, msg.configuration_id)
             self._register_real_vote(msg)
             return Promise.completed(ConsensusResponse())
         if isinstance(msg, _CONSENSUS_TYPES):
@@ -417,6 +450,52 @@ class TpuSimMessaging:
             return
         self.sim.register_extern_vote(sender_slot, np.array(cut_slots))
 
+    _MAX_REPLAYS = 3
+
+    def _maybe_catch_up(self, sender: Endpoint, config_id: int) -> None:
+        """Keep lagging members from being stranded. A member stuck exactly
+        one decision behind (its delivery was lost) gets the decision packet
+        replayed -- up to _MAX_REPLAYS times per decision, since a replay can
+        be lost too; the replay is idempotent on the member's side (votes
+        dedup per sender, FastPaxos.java:134-141, stale alerts are filtered).
+        A member stale beyond the last decision cannot be repaired by votes
+        (each FastPaxos instance is per-configuration), so it is cut like any
+        faulty member -- Rapid's answer to a node that falls behind is
+        removal and rejoin."""
+        packet = self._last_decision
+        if packet is None or sender not in self._real:
+            return
+        if config_id == packet[0]:
+            count = self._replay_counts.get(sender, 0)
+            if count >= self._MAX_REPLAYS:
+                return
+            self._replay_counts[sender] = count + 1
+            config_before, alerts, cut_eps, voters = packet
+            LOG.info(
+                "replaying decision %d to lagging member %s (attempt %d)",
+                config_before, sender, count + 1,
+            )
+            self._deliver(voters[0], sender, BatchedAlertMessage(voters[0], alerts))
+            for voter in voters:
+                self._deliver(
+                    voter,
+                    sender,
+                    FastRoundPhase2bMessage(
+                        sender=voter,
+                        configuration_id=config_before,
+                        endpoints=tuple(cut_eps),
+                    ),
+                )
+        elif config_id in self._prior_configs:
+            slot = self._real[sender]
+            if self.sim.active[slot] and self.sim.alive[slot]:
+                LOG.warning(
+                    "member %s is stale beyond the last decision; cutting it "
+                    "(rejoin required)",
+                    sender,
+                )
+                self.sim.crash(np.array([slot]))
+
     # ------------------------------------------------------------------ #
     # alerts from real members
     # ------------------------------------------------------------------ #
@@ -563,6 +642,13 @@ class TpuSimMessaging:
                             endpoints=tuple(cut_eps),
                         ),
                     )
+            # keep the packet: a member whose delivery was lost will keep
+            # sending traffic stamped with config_before, and gets a replay
+            self._last_decision = (
+                config_before, alerts, tuple(cut_eps), tuple(voters[:quorum])
+            )
+            self._replay_counts = {}
+            self._prior_configs.append(config_before)
         # unblock admitted joiners (respondToJoiners, MembershipService.java:708-733)
         for joiner in list(self._parked):
             slot = self._slot_of.get(joiner)

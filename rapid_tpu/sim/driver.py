@@ -968,6 +968,12 @@ class Simulator:
                 config = dataclasses.replace(config, **config_overrides)
             sim = Simulator.__new__(Simulator)
             sim.config = config
+            if mesh is not None:
+                n_dev = int(np.prod(list(mesh.shape.values())))
+                assert config.capacity % n_dev == 0, (
+                    f"snapshot capacity {config.capacity} must divide evenly "
+                    f"over the mesh's {n_dev} devices"
+                )
             sim.mesh = mesh
             sim.cluster = VirtualCluster(
                 hostnames=data["hostnames"],

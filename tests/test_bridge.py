@@ -345,3 +345,36 @@ def test_real_members_conflicting_vote_forces_classic_fallback():
     assert rec is not None and rec.via_classic_round
     assert sorted(rec.cut) == [1, 2, 3]
     assert h.swarm.sim.membership_size == 13
+
+
+def test_lagging_member_caught_up_after_lost_decision():
+    """A member whose decision delivery was lost must not stay behind
+    forever: its next alert traffic is stamped with the pre-decision
+    configuration id, and the bridge replays the decision packet
+    (alerts + quorum votes) to it."""
+    h = BridgeHarness(n_virtual=24, seed=10)
+    cluster, _ = h.join_real_node("real-1")
+    member = cluster.listen_address
+    slot = h.swarm._real[member]
+    # crash three of the member's own monitored subjects, so its FDs will
+    # later produce DOWN alerts (config-stamped traffic) about them
+    subjects = np.asarray(h.swarm.sim.state.subjects)[slot]
+    victims = np.unique(subjects)[:3]
+    config_before = cluster.get_current_configuration_id()
+
+    # lose every swarm->member delivery while the decision happens
+    lift = h.network.add_filter(lambda s, d, m: d != member)
+    h.swarm.sim.crash(victims)
+    rec = h.swarm.pump(max_rounds=32)
+    assert rec is not None and sorted(rec.cut) == sorted(int(v) for v in victims)
+    h.scheduler.run_for(300)
+    assert cluster.get_membership_size() == 25  # still on the old view
+    assert cluster.get_current_configuration_id() == config_before
+
+    # heal the link; the member's own FD crosses threshold on its dead
+    # subjects and broadcasts DOWN alerts stamped with the old config id,
+    # which triggers the replay
+    lift()
+    h.scheduler.run_for(15_000)
+    assert cluster.get_membership_size() == 22
+    assert cluster.get_current_configuration_id() == h.swarm.sim.configuration_id()

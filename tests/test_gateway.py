@@ -250,3 +250,64 @@ def test_rejoin_same_address_after_gateway_restore(tmp_path):
         assert len(ids) == 1
     finally:
         h.shutdown()
+
+
+@pytest.mark.slow
+def test_socket_agents_against_mesh_sharded_swarm():
+    """The full composition: external protocol-plane agents over real
+    sockets against a swarm sharded over the 8-device mesh -- joins, votes,
+    and cut observation all flow through the mesh round loop's early-exit
+    dispatch, with configuration-id parity across the wire."""
+    from rapid_tpu.shard.engine import make_mesh
+
+    base = random.randint(20000, 29000)
+    settings = Settings(
+        failure_detector_interval_ms=100,
+        batching_window_ms=50,
+        consensus_fallback_base_delay_ms=1000,
+    )
+    gateway = SwarmGateway(
+        Endpoint.from_parts("127.0.0.1", base),
+        n_virtual=48,
+        seed=16,
+        settings=settings,
+        pump_interval_ms=50,
+        mesh=make_mesh(8),
+    )
+    gateway.start()
+    agents = []
+    try:
+        for i in (1, 2):
+            addr = Endpoint.from_parts("127.0.0.1", base + i)
+            transport = TcpClientServer(addr, settings)
+            client = GatewayRoutedClient(addr, gateway.address, transport, settings)
+            agents.append(
+                ClusterBuilder(addr)
+                .use_settings(settings)
+                .set_messaging_client_and_server(client, transport)
+                .join(gateway.seed_endpoint(), timeout=90)
+            )
+        deadline = time.time() + 90
+        while time.time() < deadline and not all(
+            a.get_membership_size() == 50 for a in agents
+        ):
+            time.sleep(0.1)
+        assert all(a.get_membership_size() == 50 for a in agents)
+        ids = {a.get_current_configuration_id() for a in agents}
+        ids.add(gateway.configuration_id())
+        assert len(ids) == 1
+
+        gateway.bridge.sim.crash(np.array([7, 23]))
+        deadline = time.time() + 90
+        while time.time() < deadline and not all(
+            a.get_membership_size() == 48 for a in agents
+        ):
+            time.sleep(0.1)
+        assert all(a.get_membership_size() == 48 for a in agents)
+        ids = {a.get_current_configuration_id() for a in agents}
+        ids.add(gateway.configuration_id())
+        assert len(ids) == 1
+    finally:
+        for a in agents:
+            a.shutdown()
+        gateway.shutdown()
