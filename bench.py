@@ -23,25 +23,30 @@ FAIL_FRACTION = 0.01
 BASELINE_MS = 5000.0  # north-star budget (BASELINE.json)
 
 
-def main() -> None:
+def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
+    """The single definition of the warmed measurement (shared with
+    experiments/scaling_sweep.py so the published sweep can never drift from
+    the headline): compile on an identical-shape run, then time a fresh
+    simulator from fault injection to the decided view, asserting cut-set
+    parity. Returns (wall_ms, record, build_s, warmup_wall_s)."""
     from rapid_tpu.sim.driver import Simulator
 
-    rng = np.random.default_rng(1234)
+    rng = np.random.default_rng(seed)
+    n_fail = max(1, int(n_nodes * fail_fraction))
+
     t_build0 = time.perf_counter()
-    sim = Simulator(N_NODES, seed=1234)
+    sim = Simulator(n_nodes, seed=seed)
     build_s = time.perf_counter() - t_build0
 
-    victims = rng.choice(N_NODES, size=int(N_NODES * FAIL_FRACTION), replace=False)
-
-    # Warm the jit cache on an identical-shape run, then reset.
+    victims = rng.choice(n_nodes, size=n_fail, replace=False)
     sim.crash(victims)
     warm = sim.run_until_decision(max_rounds=16, batch=16)
     assert warm is not None and set(warm.cut) == set(victims), "warmup parity failed"
     warm_wall = warm.wall_time_s
 
-    sim2 = Simulator(N_NODES, seed=5678)
+    sim2 = Simulator(n_nodes, seed=seed + 4444)
     sim2.ready()  # drain construction from the device queue
-    victims2 = rng.choice(N_NODES, size=int(N_NODES * FAIL_FRACTION), replace=False)
+    victims2 = rng.choice(n_nodes, size=n_fail, replace=False)
     sim2.crash(victims2)
     t0 = time.perf_counter()
     record = sim2.run_until_decision(max_rounds=16, batch=16)
@@ -49,7 +54,12 @@ def main() -> None:
 
     assert record is not None, "no decision reached"
     assert set(record.cut) == set(victims2), "cut-set parity violated"
-    assert record.membership_size == N_NODES - len(victims2)
+    assert record.membership_size == n_nodes - len(victims2)
+    return wall_ms, record, build_s, warm_wall
+
+
+def main() -> None:
+    wall_ms, record, build_s, warm_wall = warmed_run(N_NODES, seed=1234)
 
     print(
         json.dumps(
@@ -62,7 +72,7 @@ def main() -> None:
         )
     )
     print(
-        f"# membership=100000->{record.membership_size} cut={len(record.cut)} nodes "
+        f"# membership={N_NODES}->{record.membership_size} cut={len(record.cut)} nodes "
         f"virtual_time={record.virtual_time_ms}ms config_id={record.configuration_id} "
         f"build={build_s:.1f}s warmup_wall={warm_wall:.1f}s",
         file=sys.stderr,

@@ -1,0 +1,57 @@
+"""Warmed-wall scaling sweep: time-to-stable-view vs cluster size.
+
+BASELINE.md's main table reports wall time including per-scenario jit
+compilation; this sweep isolates the *warmed* decision cost -- what a
+long-running deployment actually pays per view change -- across the scale
+axis (SURVEY.md section 5.7: cluster size N is this framework's scale
+dimension). One compile per capacity, then a fresh same-shape simulator is
+timed from fault injection to the decided view, exactly like bench.py.
+
+Run: python experiments/scaling_sweep.py            (real TPU or CPU)
+     python experiments/scaling_sweep.py --sizes 1000,10000
+
+Prints one JSON line per size:
+  {"n", "fail_fraction", "warmed_wall_ms", "virtual_ms", "cut_ok"}
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import FAIL_FRACTION, warmed_run  # noqa: E402
+
+DEFAULT_SIZES = (1_000, 10_000, 100_000, 1_000_000)
+
+
+def run_size(n: int, seed: int) -> dict:
+    """One measurement through bench.py's warmed_run -- the single
+    definition of the warmed harness, so this sweep can never drift from
+    the headline benchmark. warmed_run asserts cut-set parity internally
+    (an inexact cut raises rather than printing cut_ok: false)."""
+    wall_ms, record, _build_s, _warm_wall = warmed_run(n, seed=seed)
+    return {
+        "n": n,
+        "fail_fraction": FAIL_FRACTION,
+        "warmed_wall_ms": round(wall_ms, 1),
+        "virtual_ms": record.virtual_time_ms,
+        "cut_ok": True,  # asserted by warmed_run before returning
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--sizes", default=",".join(str(s) for s in DEFAULT_SIZES),
+        help="comma-separated cluster sizes",
+    )
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args()
+    for n in (int(s) for s in args.sizes.split(",")):
+        print(json.dumps(run_size(n, args.seed)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
