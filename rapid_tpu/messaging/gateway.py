@@ -31,6 +31,7 @@ rather than queuing behind it.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import socket
@@ -47,7 +48,13 @@ from ..types import Endpoint, RapidMessage
 from .base import IMessagingClient
 from .codec import ENVELOPE, decode, encode
 from .retries import call_with_retries
-from .tcp import FramedTcpServer, TcpClientServer, _Connection, _write_frame
+from .tcp import (
+    FramedTcpServer,
+    TcpClientServer,
+    _Connection,
+    _write_frame,
+    send_framed,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -104,8 +111,7 @@ class GatewayRoutedClient(IMessagingClient):
             else set(DEFAULT_DIRECT_HOSTS)
         )
         self._direct_hosts.add(address.hostname)
-        self._request_no_lock = threading.Lock()
-        self._request_no = 0
+        self._request_no = itertools.count(1)
         self._conn: Optional[_Connection] = None
         self._conn_lock = threading.Lock()
 
@@ -120,42 +126,16 @@ class GatewayRoutedClient(IMessagingClient):
                 )
             return self._conn
 
-    def _next_request_no(self) -> int:
-        with self._request_no_lock:
-            self._request_no += 1
-            return self._request_no
-
     def _send_routed_once(self, remote: Endpoint, msg: RapidMessage) -> Promise:
-        out: Promise = Promise()
         try:
             conn = self._connection()
-            request_no = self._next_request_no()
-            frame = encode_routed(request_no, remote, msg)
-            with conn.lock:  # no interleaved partial frames among senders
-                conn.outstanding[request_no] = out
-                _write_frame(conn.sock, frame)
         except OSError as e:
-            if not out.done():
-                out.set_exception(e)
-            return out
-        timeout_s = self._settings.timeout_for(msg) / 1000.0
-        timer = threading.Timer(
-            timeout_s,
-            lambda: out.done()
-            or out.set_exception(TimeoutError(f"no response from {remote}")),
+            return Promise.failed(e)
+        request_no = next(self._request_no)
+        return send_framed(
+            conn, request_no, encode_routed(request_no, remote, msg),
+            self._settings.timeout_for(msg) / 1000.0, remote,
         )
-        timer.daemon = True
-        timer.start()
-
-        # on completion without a response frame (the gateway deliberately
-        # stays silent for dropped/unowned destinations) the correlation entry
-        # must not accumulate on this process-lifetime connection
-        def on_complete(_p: Promise, c=conn, rn=request_no) -> None:
-            timer.cancel()
-            c.forget(rn)
-
-        out.add_callback(on_complete)
-        return out
 
     def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
         if self._is_direct(remote):

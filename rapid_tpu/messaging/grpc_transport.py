@@ -434,6 +434,11 @@ class GrpcClient(IMessagingClient):
             self._last_used[remote] = now
             return stub
 
+    def _sweep_retired_locked(self, now: float) -> None:
+        while self._retired and now - self._retired[0][0] > self.RETIRE_CLOSE_S:
+            _, channel = self._retired.pop(0)
+            channel.close()
+
     def _evict_idle_locked(self, now: float) -> None:
         for ep in [
             ep
@@ -445,9 +450,7 @@ class GrpcClient(IMessagingClient):
             self._last_used.pop(ep, None)
             if channel is not None:
                 self._retired.append((now, channel))
-        while self._retired and now - self._retired[0][0] > self.RETIRE_CLOSE_S:
-            _, channel = self._retired.pop(0)
-            channel.close()
+        self._sweep_retired_locked(now)
 
     def invalidate(self, remote: T.Endpoint) -> None:
         """Drop the cached channel so the next attempt dials fresh
@@ -464,9 +467,7 @@ class GrpcClient(IMessagingClient):
                 self._retired.append((now, channel))
             # sweep here too: a client that stops dialing new stubs must not
             # hold retired channels' sockets past the drain window
-            while self._retired and now - self._retired[0][0] > self.RETIRE_CLOSE_S:
-                _, old = self._retired.pop(0)
-                old.close()
+            self._sweep_retired_locked(now)
 
     def _send_once(self, remote: T.Endpoint, msg: T.RapidMessage) -> Promise:
         out: Promise = Promise()

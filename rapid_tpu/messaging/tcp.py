@@ -213,6 +213,38 @@ class FramedTcpServer:
                 pass
 
 
+def send_framed(conn: _Connection, request_no: int, frame: bytes,
+                timeout_s: float, remote: Endpoint) -> Promise:
+    """One framed request over a correlated connection: register the entry,
+    write the frame (under the connection lock -- concurrent senders must not
+    interleave partial frames), arm the deadline, and reap the correlation
+    entry on completion. Shared by the node transport and the gateway-routed
+    client so the scaffolding cannot drift between them."""
+    out: Promise = Promise()
+    try:
+        with conn.lock:
+            conn.outstanding[request_no] = out
+            _write_frame(conn.sock, frame)
+    except OSError as e:
+        if not out.done():
+            out.set_exception(e)
+        return out
+    timer = threading.Timer(
+        timeout_s,
+        lambda: out.done()
+        or out.set_exception(TimeoutError(f"no response from {remote}")),
+    )
+    timer.daemon = True
+    timer.start()
+
+    def on_complete(_p: Promise, c=conn, rn=request_no) -> None:
+        timer.cancel()
+        c.forget(rn)
+
+    out.add_callback(on_complete)
+    return out
+
+
 class TcpClientServer(IMessagingClient, IMessagingServer):
     """Both halves of the transport in one object, like the reference's
     NettyClientServer."""
@@ -276,36 +308,15 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
             return conn
 
     def _send_once(self, remote: Endpoint, msg: RapidMessage) -> Promise:
-        out: Promise = Promise()
         try:
             conn = self._connection(remote)
-            request_no = next(self._request_no)
-            frame = encode(request_no, msg)
-            # frame writes hold the connection lock: concurrent senders
-            # (protocol thread, retry timers, delivery workers) must not
-            # interleave partial frames on one socket
-            with conn.lock:
-                conn.outstanding[request_no] = out
-                _write_frame(conn.sock, frame)
         except OSError as e:
-            if not out.done():
-                out.set_exception(e)
-            return out
-        timeout_s = self._settings.timeout_for(msg) / 1000.0
-        timer = threading.Timer(
-            timeout_s,
-            lambda: out.done()
-            or out.set_exception(TimeoutError(f"no response from {remote}")),
+            return Promise.failed(e)
+        request_no = next(self._request_no)
+        return send_framed(
+            conn, request_no, encode(request_no, msg),
+            self._settings.timeout_for(msg) / 1000.0, remote,
         )
-        timer.daemon = True
-        timer.start()
-
-        def on_complete(_p: Promise, c=conn, rn=request_no) -> None:
-            timer.cancel()
-            c.forget(rn)
-
-        out.add_callback(on_complete)
-        return out
 
     def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
         return call_with_retries(

@@ -51,7 +51,9 @@ How each protocol interaction crosses the bridge:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import pickle
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -119,8 +121,6 @@ class TpuSimMessaging:
         multiple devices (shard.engine) -- the full composition: external
         protocol-plane members against a mesh-sharded device swarm. The
         capacity must divide evenly over the mesh's devices."""
-        import dataclasses
-
         if capacity is None:
             capacity = config.capacity if config is not None else n_virtual + 16
         if mesh is not None:
@@ -200,8 +200,6 @@ class TpuSimMessaging:
         rounds_per_interval, delivery-group faults, ...) reset to defaults;
         pass ``config_overrides`` to re-apply them. extern_proposals defaults
         to 4 (the bridge needs extern rows for real members' votes)."""
-        import pickle
-
         overrides = {"extern_proposals": 4}
         overrides.update(config_overrides or {})
         sim = Simulator.from_configuration(
