@@ -1,109 +1,15 @@
-"""Socket-hosted TPU swarm gateway.
-
-Hosts N virtual nodes (their rings, failure detectors, cut detection, and
-fast-round tallies living as device arrays in the TPU simulator) behind one
-real TCP socket. External agent processes join through any virtual seed
-endpoint using ``standalone_agent.py --gateway-address`` (the reference's
-plugin-seam design hosted on a real wire: IMessagingServer.java:24-41).
+"""Socket-hosted TPU swarm gateway (see rapid_tpu/cli/gateway.py for the
+implementation; this shim keeps the reference's examples/ layout).
 
     python examples/swarm_gateway.py --listen-address 127.0.0.1:4000 \
         --n-virtual 1000
-
-Prints the seed endpoint on startup and one status line per second:
-``swarm size=N config=C`` plus a line per decided view change.
 """
 
-import argparse
-import logging
 import sys
-import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
-
-def main() -> None:
-    parser = argparse.ArgumentParser(description="rapid-tpu swarm gateway")
-    parser.add_argument("--listen-address", required=True, help="host:port to bind")
-    parser.add_argument("--n-virtual", type=int, default=100)
-    parser.add_argument("--seed", type=int, default=0, help="simulator RNG seed")
-    parser.add_argument("--pump-interval-ms", type=int, default=100)
-    parser.add_argument("--platform", help="force a jax platform (e.g. cpu)")
-    parser.add_argument(
-        "--restore-from", help="resume from a swarm snapshot (same config id)"
-    )
-    parser.add_argument(
-        "--snapshot", help="checkpoint the swarm to this path on Ctrl-C"
-    )
-    parser.add_argument("--verbose", action="store_true")
-    args = parser.parse_args()
-
-    if args.platform:
-        import jax
-
-        jax.config.update("jax_platforms", args.platform)
-
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
-    log = logging.getLogger("gateway")
-
-    from rapid_tpu import Endpoint, Settings
-    from rapid_tpu.messaging.gateway import SwarmGateway
-
-    listen = Endpoint.from_string(args.listen_address)
-    if args.restore_from:
-        # identity/config come from the snapshot; n_virtual/seed must not be
-        # passed alongside (SwarmGateway rejects the combination)
-        gateway = SwarmGateway(
-            listen,
-            settings=Settings(),
-            pump_interval_ms=args.pump_interval_ms,
-            restore_from=args.restore_from,
-        )
-    else:
-        gateway = SwarmGateway(
-            listen,
-            n_virtual=args.n_virtual,
-            seed=args.seed,
-            settings=Settings(),
-            pump_interval_ms=args.pump_interval_ms,
-        )
-    gateway.start()
-    seed_ep = gateway.seed_endpoint()
-    log.info(
-        "gateway up at %s hosting %d members (%s); seed endpoint %s",
-        listen,
-        gateway.membership_size(),
-        f"restored from {args.restore_from}" if args.restore_from else "fresh",
-        seed_ep,
-    )
-    print(f"SEED {seed_ep}", flush=True)
-
-    seen_decisions = 0
-    try:
-        while True:
-            time.sleep(1)
-            decisions = gateway.decisions()
-            for rec in decisions[seen_decisions:]:
-                log.info(
-                    "view change: cut=%d added=%d removed=%d",
-                    len(rec.cut),
-                    len(rec.added),
-                    len(rec.removed),
-                )
-            seen_decisions = len(decisions)
-            log.info(
-                "swarm size=%d config=%d",
-                gateway.membership_size(),
-                gateway.configuration_id(),
-            )
-    except KeyboardInterrupt:
-        if args.snapshot:
-            gateway.save(args.snapshot)
-            log.info("snapshot written to %s", args.snapshot)
-        gateway.shutdown()
-
+from rapid_tpu.cli.gateway import main
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,3 @@
+"""Command-line entry points: the standalone agent and the swarm gateway
+(the runnable analogues of the reference's examples/ shaded jars,
+StandaloneAgent.java:94-116, examples/pom.xml:60-89)."""

@@ -1,0 +1,126 @@
+"""Standalone cluster agent over the real TCP transport.
+
+Equivalent of the reference's CLI agent (StandaloneAgent.java:94-116): start a
+seed with --listen-address only, or join via --seed-address; subscribes to the
+cluster events and prints the membership once per second.
+
+    python examples/standalone_agent.py --listen-address 127.0.0.1:1234
+    python examples/standalone_agent.py --listen-address 127.0.0.1:1235 \
+        --seed-address 127.0.0.1:1234
+"""
+
+import argparse
+import logging
+import time
+
+from rapid_tpu import ClusterBuilder, ClusterEvents, Endpoint, Settings
+from rapid_tpu.messaging.tcp import TcpClientServer
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="rapid-tpu standalone agent")
+    parser.add_argument("--listen-address", required=True, help="host:port to listen on")
+    parser.add_argument("--seed-address", help="host:port of a seed to join")
+    parser.add_argument(
+        "--gateway-address",
+        help="host:port of a SwarmGateway; destinations whose hostname is not "
+        "in the direct set (the swarm's virtual endpoints) ride this connection",
+    )
+    parser.add_argument(
+        "--direct-host",
+        action="append",
+        default=[],
+        help="additional hostname reached directly rather than via the "
+        "gateway (repeatable; loopback and this agent's own hostname are "
+        "always direct). Required for multi-host deployments so peer agents "
+        "on other machines are not misrouted to the gateway",
+    )
+    parser.add_argument("--fd-interval-ms", type=int, default=1000)
+    parser.add_argument(
+        "--fd-policy", choices=("cumulative", "windowed"), default="cumulative",
+        help="cumulative = reference parity (never-reset counter); "
+        "windowed = the paper's '40%% of last N probes' policy",
+    )
+    parser.add_argument("--fd-window", type=int, default=10)
+    parser.add_argument("--fd-window-threshold", type=float, default=0.4)
+    parser.add_argument(
+        "--transport", choices=("tcp", "grpc"), default="tcp",
+        help="tcp = framed-TCP transport; grpc = wire-compatible with JVM Rapid",
+    )
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    log = logging.getLogger("agent")
+
+    listen = Endpoint.from_string(args.listen_address)
+    settings = Settings(
+        failure_detector_interval_ms=args.fd_interval_ms,
+        fd_policy=args.fd_policy,
+        fd_window=args.fd_window,
+        fd_window_threshold=args.fd_window_threshold,
+    )
+    if args.transport == "grpc":
+        if args.gateway_address:
+            parser.error(
+                "--gateway-address requires the tcp transport: the gateway "
+                "delivers swarm traffic over framed TCP to the agent's server"
+            )
+        from rapid_tpu.messaging.grpc_transport import GrpcClient, GrpcServer
+
+        client, server = GrpcClient(listen, settings), GrpcServer(listen)
+    else:
+        client = server = TcpClientServer(listen, settings)
+    if args.gateway_address:
+        from rapid_tpu.messaging.gateway import (
+            DEFAULT_DIRECT_HOSTS,
+            GatewayRoutedClient,
+        )
+
+        direct = set(DEFAULT_DIRECT_HOSTS)
+        direct.update(h.encode() for h in args.direct_host)
+        client = GatewayRoutedClient(
+            listen, Endpoint.from_string(args.gateway_address), client, settings,
+            direct_hosts=direct,
+        )
+
+    def on_event(name):
+        def callback(configuration_id, changes):
+            log.info("%s config=%d changes=%s", name, configuration_id,
+                     [str(c) for c in changes])
+
+        return callback
+
+    builder = (
+        ClusterBuilder(listen)
+        .use_settings(settings)
+        .set_messaging_client_and_server(client, server)
+        .add_subscription(ClusterEvents.VIEW_CHANGE_PROPOSAL, on_event("VIEW_CHANGE_PROPOSAL"))
+        .add_subscription(ClusterEvents.VIEW_CHANGE, on_event("VIEW_CHANGE"))
+        .add_subscription(ClusterEvents.KICKED, on_event("KICKED"))
+    )
+    if args.seed_address:
+        cluster = builder.join(Endpoint.from_string(args.seed_address))
+    else:
+        cluster = builder.start()
+    log.info("agent started at %s", listen)
+
+    try:
+        while True:
+            time.sleep(1)
+            members = cluster.get_memberlist()
+            log.info(
+                "membership size=%d config=%d members=%s",
+                len(members),
+                cluster.get_current_configuration_id(),
+                [str(m) for m in members] if len(members) <= 32 else "...",
+            )
+    except KeyboardInterrupt:
+        cluster.leave_gracefully()
+
+
+if __name__ == "__main__":
+    main()

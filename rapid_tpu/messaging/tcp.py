@@ -229,10 +229,13 @@ def send_framed(conn: _Connection, request_no: int, frame: bytes,
         if not out.done():
             out.set_exception(e)
         return out
+    # non-strict: a response arriving at exactly the deadline must win the
+    # race, not crash the timer thread
     timer = threading.Timer(
         timeout_s,
-        lambda: out.done()
-        or out.set_exception(TimeoutError(f"no response from {remote}")),
+        lambda: out.try_set_exception(
+            TimeoutError(f"no response from {remote}")
+        ),
     )
     timer.daemon = True
     timer.start()

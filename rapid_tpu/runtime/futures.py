@@ -42,6 +42,11 @@ class Promise(Generic[T]):
     def try_set_result(self, value: T) -> bool:
         return self._complete(result=value, strict=False)
 
+    def try_set_exception(self, exc: BaseException) -> bool:
+        """Non-strict failure: False if already completed (for deadline
+        timers racing a response that arrives at the same instant)."""
+        return self._complete(exception=exc, strict=False)
+
     def _complete(self, result: Any = None, exception: Optional[BaseException] = None,
                   strict: bool = True) -> bool:
         with self._lock:

@@ -27,7 +27,7 @@ class AgentRunner:
         self.procs = []
 
     def run_node(self, listen: str, seed: str = None, fd_interval_ms: int = 100,
-                 gateway: str = None):
+                 gateway: str = None, transport: str = None):
         log_path = self.tmpdir / f"agent-{listen.replace(':', '-')}.log"
         cmd = [sys.executable, str(AGENT), "--listen-address", listen,
                "--fd-interval-ms", str(fd_interval_ms)]
@@ -35,6 +35,8 @@ class AgentRunner:
             cmd += ["--seed-address", seed]
         if gateway:
             cmd += ["--gateway-address", gateway]
+        if transport:
+            cmd += ["--transport", transport]
         log = open(log_path, "w")
         env = dict(os.environ, PYTHONUNBUFFERED="1")
         proc = subprocess.Popen(
@@ -237,4 +239,33 @@ def test_ten_agents_converge_kill_and_rejoin(runner):
     assert wait_for_size(survivor_logs + [rejoin_log], n - 2, timeout_s=180), \
         rejoin_log.read_text()[-3000:]
     configs = {last_status(p)[1] for p in survivor_logs + [rejoin_log]}
+    assert len(configs) == 1
+
+
+@pytest.mark.slow
+def test_three_agents_converge_over_grpc(runner):
+    """Tier-3 over the wire-compatible gRPC transport (the reference's
+    default): real OS processes speaking rapid.proto bytes converge and
+    recover from a SIGKILL, like the TCP tier does."""
+    pytest.importorskip("grpc")  # declared as the optional [grpc] extra
+    base = random.randint(30000, 39000)
+    seed_addr = f"127.0.0.1:{base}"
+    _, seed_log = runner.run_node(seed_addr, fd_interval_ms=200,
+                                  transport="grpc")
+    assert wait_for_membership(seed_log, 1, 30), seed_log.read_text()[-2000:]
+    logs = [seed_log]
+    for i in (1, 2):
+        _, log = runner.run_node(f"127.0.0.1:{base + i}", seed=seed_addr,
+                                 fd_interval_ms=200, transport="grpc")
+        logs.append(log)
+    assert wait_for_size(logs, 3, timeout_s=120), \
+        "\n".join(p.read_text()[-500:] for p in logs)
+    configs = {last_status(p)[1] for p in logs}
+    assert len(configs) == 1
+
+    victim_proc, _ = runner.procs[-1]
+    victim_proc.send_signal(signal.SIGKILL)
+    victim_proc.wait(timeout=10)
+    assert wait_for_size(logs[:-1], 2, timeout_s=120), seed_log.read_text()[-2000:]
+    configs = {last_status(p)[1] for p in logs[:-1]}
     assert len(configs) == 1
