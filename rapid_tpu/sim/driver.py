@@ -18,6 +18,7 @@ Cluster.java:313-344).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from dataclasses import dataclass
 from typing import List, Optional, Set, Tuple
@@ -32,8 +33,10 @@ from .engine import (
     SimConfig,
     SimState,
     device_initial_state,
+    pack_decision,
     run_rounds_const,
     run_until_decided_const,
+    unpack_decision,
 )
 from .topology import (
     VirtualCluster,
@@ -123,6 +126,10 @@ class Simulator:
         never silently diverge from freshly-constructed ones."""
         capacity = self.config.capacity
         self._sharded_runs: dict = {}
+        # speculative view-change precomputation (see _speculate_view_change):
+        # (new-active bytes, seed, config id, fresh SimState, alive bytes).
+        # Must exist before the first _fresh_state call below.
+        self._spec: Optional[Tuple[bytes, int, int, SimState, bytes]] = None
         self._init_device_caches()
         self.state = self._fresh_state(self.seed)
         self._billed_rounds = 0  # rounds of this configuration already billed
@@ -211,6 +218,17 @@ class Simulator:
         self._alive_dev = None
         self._probe_drop_dev = None  # partition set maps onto new adjacency
         self._down_reports_dev = None  # leave alerts map onto new adjacency
+        spec = self._spec
+        if (
+            spec is not None
+            and spec[0] == self.active.tobytes()
+            and spec[1] == seed
+            # the alive mask the worker baked in must still hold (a revive
+            # or crash between speculation and decision invalidates it)
+            and spec[4] == (self.alive & self.active).tobytes()
+        ):
+            self._spec = None
+            return spec[3]
         state = device_initial_state(
             self.config,
             self._ring_rank_dev,
@@ -285,6 +303,7 @@ class Simulator:
         # burst of seatings pays it once, off the message-handling path
         self._ring_rank_dirty = True
         self._ring_nodes = None
+        self._spec = None  # endpoint hashes / rank table changed
 
     def is_identifier_seen(self, id_high: int, id_low: int) -> bool:
         return (id_high, id_low) in self._seen_set
@@ -344,6 +363,7 @@ class Simulator:
         self.state = dataclasses.replace(
             self.state, group_of=self._rep(group_of)
         )
+        self._spec = None  # speculated fresh state baked in the old groups
 
     def drop_broadcasts(self, receiver_group: int, sender_nodes: np.ndarray) -> None:
         """Group ``receiver_group`` stops hearing broadcasts originating from
@@ -381,6 +401,7 @@ class Simulator:
         self.state = dataclasses.replace(
             self.state, auto_vote=self._rep(self.auto_vote)
         )
+        self._spec = None  # speculated fresh state baked in the old owner
 
     def register_extern_vote(self, slot: int, cut: np.ndarray) -> bool:
         """Count an external member's fast-round vote in the device tally
@@ -622,16 +643,23 @@ class Simulator:
                         bool(self._deliver.all()),
                     )
                 # ONE host<->device round trip syncs the batch and fetches
-                # everything a decision needs, so it never pays a second
-                # transfer latency. The [C]-sized per-node vote arrays are
-                # NOT in this sync -- they are only needed by the rare
-                # classic-fallback branch, which pays its own fetch.
+                # everything a decision needs. Remote-device transports bill
+                # per fetched buffer, so the sync is a single bit-packed
+                # uint32 array (engine.pack_decision), not a tuple of seven.
+                # The [C]-sized per-node vote arrays are NOT in this sync --
+                # they are only needed by the rare classic-fallback branch,
+                # which pays its own fetch. While the fetch blocks, a
+                # speculative worker precomputes the predicted view change's
+                # config id and fresh state (consumed below iff the guess
+                # matches the decision).
+                packed = pack_decision(self.config, self.state)
+                spec_worker = self._speculate_view_change()
+                words = jax.device_get(packed)
+                if spec_worker is not None:
+                    spec_worker.join()
                 (decided, announced_np, announced_round_np, proposal_np,
-                 decided_group, decided_round, round_np) = jax.device_get(
-                    (self.state.decided, self.state.announced,
-                     self.state.announced_round, self.state.proposal,
-                     self.state.decided_group, self.state.decided_round,
-                     self.state.round)
+                 decided_group, decided_round, round_np) = unpack_decision(
+                    self.config, words
                 )
                 announced_any = announced_np.any()
             self.metrics.incr("rounds", n)
@@ -674,6 +702,75 @@ class Simulator:
         self.virtual_ms += rounds_done * self._round_ms
         self._billed_rounds += rounds_done
         return None
+
+    def _speculate_view_change(self) -> Optional[threading.Thread]:
+        """Start a worker that precomputes the view change the fault plane
+        predicts (cut = dead-or-leaving members) while the main thread is
+        blocked in the post-dispatch device fetch -- on remote-device
+        transports that wait is a full network round trip, long enough to
+        hide the configuration-id fold and the fresh-state dispatch behind.
+
+        The prediction is a guess: `_apply_view_change` / `configuration_id`
+        consume the precomputed values only when the decided membership
+        matches them bit-for-bit, so a partial cut, an extern-proposal
+        winner, or any other surprise just falls back to the normal path.
+        Joins are never speculated (admissions mutate the identifier
+        history). All caches the worker reads are warmed here, on the
+        calling thread, so the worker is read-only."""
+        if self._pending_joiners:
+            return None
+        cut_pred = self.active & ~self.alive
+        if self._pending_leavers:
+            cut_pred[list(self._pending_leavers)] = self.active[
+                list(self._pending_leavers)
+            ]
+        if not cut_pred.any():
+            return None
+        new_active = self.active & ~cut_pred
+        key = new_active.tobytes()
+        if self._spec is not None and self._spec[0] == key:
+            return None  # this outcome is already speculated
+        # warm every cache the worker touches (all read-only afterwards)
+        self._sorted_identifiers()
+        self._seen_id_hashes()
+        self.cluster.node_hashes()
+        self.cluster.full_ring_order()
+        if self._ring_rank_dirty:
+            self._ring_rank_dev = jnp.asarray(self.cluster.ring_rank())
+            self._ring_rank_dirty = False
+        seed = self.seed + len(self.view_changes) + 1
+        alive_pred = self.alive & new_active
+
+        def work() -> None:
+            try:
+                _, _, host_h, port_h = self.cluster.node_hashes()
+                order = self._sorted_identifiers()
+                seen_h = self._seen_id_hashes()
+                order0 = ring_order(self.cluster, new_active, 0)
+                cid = config_fold(
+                    seen_h[order, 0], seen_h[order, 1],
+                    host_h[order0], port_h[order0],
+                )
+                state = device_initial_state(
+                    self.config,
+                    self._ring_rank_dev,
+                    jnp.asarray(new_active),
+                    jnp.asarray(alive_pred),
+                    jnp.asarray(self.group_of),
+                    jnp.asarray(self.auto_vote),
+                    jax.random.PRNGKey(seed),
+                )
+                if self.mesh is not None:
+                    from ..shard.engine import place_state
+
+                    state = place_state(state, self.mesh)
+                self._spec = (key, seed, cid, state, alive_pred.tobytes())
+            except Exception:  # a failed guess must never break the run
+                self._spec = None
+
+        worker = threading.Thread(target=work, daemon=True)
+        worker.start()
+        return worker
 
     @property
     def last_announcement(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
@@ -836,6 +933,10 @@ class Simulator:
         # new configuration: rebuild adjacency, reset per-config state;
         # crashes persist across configurations
         self.state = self._fresh_state(self.seed + len(self.view_changes))
+        # a speculation is valid for exactly one view change: the identifier
+        # history can grow afterwards, which changes the config-id fold even
+        # for an identical active mask
+        self._spec = None
         return record
 
     # ------------------------------------------------------------------ #
@@ -845,7 +946,10 @@ class Simulator:
 
         Element hashes are cached (endpoint hashes on the cluster, identifier
         hashes on the append-only history); only the fold over the current
-        ordering runs per view change."""
+        ordering runs per view change -- and when the speculative worker
+        already folded this exact membership, not even that."""
+        if self._spec is not None and self._spec[0] == self.active.tobytes():
+            return self._spec[2]
         _, _, host_h, port_h = self.cluster.node_hashes()
         order = self._sorted_identifiers()
         seen_h = self._seen_id_hashes()

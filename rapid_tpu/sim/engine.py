@@ -762,6 +762,84 @@ def device_initial_state(
     )
 
 
+# --------------------------------------------------------------------- #
+# Packed decision summary
+# --------------------------------------------------------------------- #
+# Remote-device transports (the TPU tunnel) pay roughly one round-trip
+# latency PER BUFFER fetched, so the driver's post-dispatch sync packs
+# everything a decision needs into ONE uint32 word stream and fetches that
+# single array. Layout: 5 header words (decided, decided_group,
+# decided_round, round, announced_round), then ceil(P/32) words of
+# announced bits, then P * ceil(C/32) words of proposal bits (row-major,
+# LSB-first within each word).
+
+_SUMMARY_HEADER = 5
+
+
+def _words_per(n: int) -> int:
+    return (n + 31) // 32
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def pack_decision(config: SimConfig, state: SimState) -> jax.Array:
+    """Bit-pack the decision-relevant slice of ``state`` into one uint32
+    array (see layout note above). Dispatch is async; the caller fetches the
+    result with a single ``jax.device_get``, paying the host<->device
+    round trip exactly once per protocol batch."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    def bits_to_words(bits: jax.Array) -> jax.Array:
+        n = bits.shape[-1]
+        pad = (-n) % 32
+        if pad:
+            bits = jnp.pad(bits, [(0, 0)] * (bits.ndim - 1) + [(0, pad)])
+        w = bits.reshape(bits.shape[:-1] + (-1, 32)).astype(jnp.uint32) << shifts
+        return w.sum(axis=-1, dtype=jnp.uint32)
+
+    header = jnp.stack(
+        [
+            state.decided.astype(jnp.uint32),
+            state.decided_group.astype(jnp.uint32),
+            state.decided_round.astype(jnp.uint32),
+            state.round.astype(jnp.uint32),
+            state.announced_round.astype(jnp.uint32),
+        ]
+    )
+    announced = bits_to_words(state.announced)  # [ceil(P/32)]
+    proposal = bits_to_words(state.proposal)  # [P, ceil(C/32)]
+    return jnp.concatenate([header, announced, proposal.reshape(-1)])
+
+
+def unpack_decision(
+    config: SimConfig, words: np.ndarray
+) -> Tuple[bool, np.ndarray, int, np.ndarray, int, int, int]:
+    """Host-side inverse of ``pack_decision``. Returns ``(decided,
+    announced[P], announced_round, proposal[P, C], decided_group,
+    decided_round, round)``."""
+    p, c = config.proposal_rows, config.capacity
+    words = np.asarray(words, dtype=np.uint32)
+    pw, cw = _words_per(p), _words_per(c)
+
+    def words_to_bits(w: np.ndarray, n: int) -> np.ndarray:
+        bits = ((w[..., None] >> np.arange(32, dtype=np.uint32)) & 1).astype(bool)
+        return bits.reshape(w.shape[:-1] + (-1,))[..., :n]
+
+    off = _SUMMARY_HEADER
+    announced = words_to_bits(words[off : off + pw], p)
+    proposal = words_to_bits(
+        words[off + pw : off + pw + p * cw].reshape(p, cw), c
+    )
+    return (
+        bool(words[0]),
+        announced,
+        int(np.int32(words[4])),
+        proposal,
+        int(np.int32(words[1])),
+        int(np.int32(words[2])),
+        int(np.int32(words[3])),
+    )
+
+
 def const_inputs(
     config: SimConfig,
     alive: np.ndarray,
