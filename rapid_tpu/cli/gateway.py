@@ -75,7 +75,7 @@ def main() -> None:
         f"restored from {args.restore_from}" if args.restore_from else "fresh",
         seed_ep,
     )
-    print(f"SEED {seed_ep}", flush=True)
+    print(f"SEED {seed_ep}", flush=True)  # noqa: print-in-lib
 
     seen_decisions = 0
     try:

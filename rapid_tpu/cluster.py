@@ -16,10 +16,10 @@ drives both the real-time scheduler and the deterministic virtual-time one;
 from __future__ import annotations
 
 import random
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .cut_detector import MultiNodeCutDetector
-from .events import ClusterEvents, NodeStatusChange
+from .events import ClusterEvents
 from .membership import MembershipView
 from .messaging.base import IMessagingClient, IMessagingServer
 from .metadata import FrozenMetadata

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import math
 import random
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set
 
 from .messaging.base import IBroadcaster, IMessagingClient
 from .paxos import Paxos, Proposal

@@ -15,7 +15,7 @@ import asyncio
 import logging
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import grpc
 import grpc.aio

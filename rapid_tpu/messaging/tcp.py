@@ -18,9 +18,8 @@ from __future__ import annotations
 import itertools
 import logging
 import socket
-import struct
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional
 
 from ..runtime.futures import Promise
 from ..settings import Settings

@@ -12,7 +12,6 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import sys
 from typing import Optional, Tuple
 
 import numpy as np
@@ -141,5 +140,5 @@ def config_fold(xs: np.ndarray) -> Optional[int]:
 
 if __name__ == "__main__":
     path = build()
-    print(f"built {path}")
-    print("loadable:", available())
+    print(f"built {path}")  # noqa: print-in-lib
+    print("loadable:", available())  # noqa: print-in-lib

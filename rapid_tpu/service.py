@@ -35,7 +35,6 @@ from .types import (
     AlertMessage,
     BatchedAlertMessage,
     CONSENSUS_MESSAGE_TYPES,
-    ConsensusResponse,
     EdgeStatus,
     Endpoint,
     JoinMessage,

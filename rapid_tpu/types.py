@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import enum
 import uuid as _uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 

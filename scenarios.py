@@ -23,9 +23,8 @@ import numpy as np
 
 def scenario_10_node_cross_plane():
     """10-node ring, 1 crash-stop: protocol plane vs simulation plane."""
-    import random
-
-    from rapid_tpu import ClusterBuilder, Endpoint
+    
+    from rapid_tpu import Endpoint
     from rapid_tpu.membership import MembershipView
     from rapid_tpu.sim.driver import Simulator
     from rapid_tpu.types import NodeId

@@ -15,7 +15,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if os.environ.get("RAPID_TPU_PALLAS_HW"):
     # opt-in hardware runs (test_pallas_kernels.py::test_hardware_*) keep the
     # real accelerator visible
-    import jax  # noqa: F401
+    import jax  # noqa: unused-import
 else:
     from __graft_entry__ import _force_cpu_mesh
 

@@ -9,10 +9,9 @@ because timers run on the shared VirtualScheduler instead of wall clock.
 from __future__ import annotations
 
 import random
-from typing import Callable, Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from rapid_tpu import ClusterBuilder, Cluster, Endpoint, Settings
-from rapid_tpu.events import ClusterEvents
 from rapid_tpu.messaging.inprocess import (
     InProcessClient,
     InProcessNetwork,

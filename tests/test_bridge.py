@@ -10,7 +10,6 @@ itself detected and removed by the simulated failure detectors when it dies.
 import random
 
 import numpy as np
-import pytest
 
 from rapid_tpu import ClusterBuilder, Endpoint, Settings
 from rapid_tpu.events import ClusterEvents

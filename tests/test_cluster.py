@@ -6,7 +6,7 @@ in-process on deterministic virtual time.
 
 import pytest
 
-from rapid_tpu import ClusterEvents, Endpoint
+
 from rapid_tpu.monitoring.pingpong import PingPongFailureDetectorFactory
 from rapid_tpu.types import JoinMessage, PreJoinMessage, ProbeMessage
 

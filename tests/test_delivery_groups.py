@@ -7,7 +7,6 @@ the scenario Rapid's H/L filter + 3/4 supermajority exist to survive
 """
 
 import numpy as np
-import pytest
 
 from rapid_tpu.sim.driver import Simulator
 from rapid_tpu.sim.engine import SimConfig
@@ -106,7 +105,7 @@ def test_two_groups_identical_views_pool_votes():
 def test_grouped_sharded_matches_single_device():
     """The sharded engine agrees with the single-device engine under
     heterogeneous delivery."""
-    import jax
+
 
     from rapid_tpu.shard.engine import (
         make_mesh,

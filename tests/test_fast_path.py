@@ -17,7 +17,6 @@ from rapid_tpu.sim.engine import (
     SimConfig,
     const_inputs,
     device_initial_state,
-    initial_state,
     run_rounds_const,
     run_until_decided_const,
 )

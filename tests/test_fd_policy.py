@@ -8,7 +8,6 @@ last 10" vs the reference code's cumulative counter).
 """
 
 import numpy as np
-import pytest
 
 from rapid_tpu import ClusterBuilder, Endpoint, Settings
 from rapid_tpu.monitoring.pingpong import (

@@ -10,7 +10,6 @@ of the wire.
 """
 
 import random
-import threading
 import time
 
 import numpy as np

@@ -31,7 +31,6 @@ import json
 from pathlib import Path
 
 import numpy as np
-import pytest
 
 from rapid_tpu.hashing import endpoint_hash, xxh64
 from rapid_tpu.membership import MembershipView

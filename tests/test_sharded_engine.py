@@ -7,12 +7,10 @@ import numpy as np
 import pytest
 
 from rapid_tpu.shard.engine import (
-    input_shardings,
     make_mesh,
     make_sharded_run,
     place_inputs,
     place_state,
-    state_shardings,
 )
 from rapid_tpu.sim.engine import SimConfig, const_inputs, initial_state, run_rounds_const
 from rapid_tpu.sim.topology import VirtualCluster
