@@ -72,13 +72,18 @@ class Simulator:
         config: Optional[SimConfig] = None,
         seed: int = 0,
         mesh=None,
+        speculate: bool = True,
     ) -> None:
         """``mesh``: a jax.sharding.Mesh (from shard.engine.make_mesh) to run
         the round loop sharded over multiple devices -- per-edge state
         row-sharded over every mesh axis, alert fan-out as a psum over
         ICI/DCN. The whole fault/join/leave API and view-change machinery is
         identical in both modes; sharded dispatches use the scan path (the
-        early-exit closed form is single-device)."""
+        early-exit closed form is single-device).
+
+        ``speculate``: overlap view-change precomputation with the decision
+        fetch (_speculate_view_change). Semantically invisible; the flag
+        exists so differential tests can pin that invisibility."""
         capacity = capacity if capacity is not None else n_nodes
         assert n_nodes <= capacity
         self.config = config if config is not None else SimConfig(capacity=capacity)
@@ -116,6 +121,7 @@ class Simulator:
         }
         self._seen_hashes: Optional[np.ndarray] = None  # [M, 2] uint64
         self.seed = seed
+        self.speculate = speculate
         self.virtual_ms = 0
         self._init_runtime_state()
 
@@ -228,6 +234,7 @@ class Simulator:
             and spec[4] == (self.alive & self.active).tobytes()
         ):
             self._spec = None
+            self.metrics.incr("speculation_hits_fresh_state")
             return spec[3]
         state = device_initial_state(
             self.config,
@@ -717,7 +724,7 @@ class Simulator:
         Joins are never speculated (admissions mutate the identifier
         history). All caches the worker reads are warmed here, on the
         calling thread, so the worker is read-only."""
-        if self._pending_joiners:
+        if not self.speculate or self._pending_joiners:
             return None
         cut_pred = self.active & ~self.alive
         if self._pending_leavers:
@@ -949,6 +956,7 @@ class Simulator:
         ordering runs per view change -- and when the speculative worker
         already folded this exact membership, not even that."""
         if self._spec is not None and self._spec[0] == self.active.tobytes():
+            self.metrics.incr("speculation_hits_config_id")
             return self._spec[2]
         _, _, host_h, port_h = self.cluster.node_hashes()
         order = self._sorted_identifiers()
@@ -1072,6 +1080,7 @@ class Simulator:
                 config = dataclasses.replace(config, **config_overrides)
             sim = Simulator.__new__(Simulator)
             sim.config = config
+            sim.speculate = True
             if mesh is not None:
                 n_dev = int(np.prod(list(mesh.shape.values())))
                 assert config.capacity % n_dev == 0, (

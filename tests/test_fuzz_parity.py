@@ -24,10 +24,14 @@ STEPS = 8
 BATCH = 6
 
 
-def generate_and_run(fuzz_seed: int, mesh=None, script=None):
+def generate_and_run(fuzz_seed: int, mesh=None, script=None, speculate=True):
     """Run a fault schedule; if ``script`` is None, generate it adaptively
-    (choices constrained by the live protocol state) and return it."""
-    sim = Simulator(N_START, capacity=CAPACITY, seed=fuzz_seed, mesh=mesh)
+    (choices constrained by the live protocol state) and return it.
+    Returns (script, history, simulator)."""
+    sim = Simulator(
+        N_START, capacity=CAPACITY, seed=fuzz_seed, mesh=mesh,
+        speculate=speculate,
+    )
     rng = random.Random(fuzz_seed * 7919)
     recording = script is None
     ops = [] if recording else list(script)
@@ -95,22 +99,44 @@ def generate_and_run(fuzz_seed: int, mesh=None, script=None):
                     rec.virtual_time_ms,
                 )
             )
-    return ops, history
+    return ops, history, sim
 
 
 @pytest.mark.parametrize("fuzz_seed", [1, 2, 3])
 def test_fuzzed_schedule_identical_on_mesh(fuzz_seed):
-    script, single_history = generate_and_run(fuzz_seed)
+    script, single_history, _ = generate_and_run(fuzz_seed)
     assert single_history, f"schedule decided nothing: {script}"
     mesh = make_mesh(8)
-    _, mesh_history = generate_and_run(fuzz_seed, mesh=mesh, script=script)
+    _, mesh_history, _ = generate_and_run(fuzz_seed, mesh=mesh, script=script)
     assert mesh_history == single_history, f"schedule: {script}"
 
 
 def test_fuzzed_schedule_deterministic():
-    script, history_a = generate_and_run(5)
-    _, history_b = generate_and_run(5, script=script)
+    script, history_a, _ = generate_and_run(5)
+    _, history_b, _ = generate_and_run(5, script=script)
     assert history_a == history_b
+
+
+@pytest.mark.parametrize("fuzz_seed", [13, 14, 17, 18])
+def test_fuzzed_schedule_identical_without_speculation(fuzz_seed):
+    """The speculative view-change precompute must be invisible under
+    arbitrary fault interleavings (crash/revive/leave/join between short
+    batches -- exactly the regime where predictions go stale)."""
+    script, spec_history, spec_sim = generate_and_run(fuzz_seed)
+    assert spec_history, f"schedule decided nothing: {script}"
+    _, plain_history, plain_sim = generate_and_run(
+        fuzz_seed, script=script, speculate=False
+    )
+    assert spec_history == plain_history, f"schedule: {script}"
+    # the comparison must not be vacuous: the speculated run really consumed
+    # precomputed results, the plain run never did
+    spec_hits = (
+        spec_sim.metrics.get("speculation_hits_config_id")
+        + spec_sim.metrics.get("speculation_hits_fresh_state")
+    )
+    assert spec_hits > 0, f"speculation never consumed; schedule: {script}"
+    assert plain_sim.metrics.get("speculation_hits_config_id") == 0
+    assert plain_sim.metrics.get("speculation_hits_fresh_state") == 0
 
 
 # --------------------------------------------------------------------------- #

@@ -451,9 +451,7 @@ def test_speculative_view_change_matches_unspeculated_run():
     the decision fetch blocks) must be invisible: records, config ids, and
     follow-on view changes identical to a run with speculation disabled."""
     def run(speculate: bool):
-        sim = Simulator(60, seed=21)
-        if not speculate:
-            sim._speculate_view_change = lambda: None
+        sim = Simulator(60, seed=21, speculate=speculate)
         recs = []
         sim.crash([3, 7, 11])
         recs.append(sim.run_until_decision(max_rounds=32, batch=8))
@@ -476,9 +474,7 @@ def test_speculation_discarded_when_prediction_wrong():
     """A revive between speculation and the next batch invalidates the
     speculated alive mask; the run must fall back and stay correct."""
     def run(speculate: bool):
-        sim = Simulator(60, seed=22)
-        if not speculate:
-            sim._speculate_view_change = lambda: None
+        sim = Simulator(60, seed=22, speculate=speculate)
         sim.crash([5, 6])
         # first batch too short to decide: speculation happens, then the
         # world changes under it
