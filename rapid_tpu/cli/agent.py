@@ -44,8 +44,10 @@ def main() -> None:
     parser.add_argument("--fd-window", type=int, default=10)
     parser.add_argument("--fd-window-threshold", type=float, default=0.4)
     parser.add_argument(
-        "--transport", choices=("tcp", "grpc"), default="tcp",
-        help="tcp = framed-TCP transport; grpc = wire-compatible with JVM Rapid",
+        "--transport", choices=("tcp", "native-tcp", "grpc"), default="tcp",
+        help="tcp = framed-TCP transport; native-tcp = same wire format with "
+        "the C++ epoll server half (native/rapid_io.cpp); grpc = "
+        "wire-compatible with JVM Rapid",
     )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
@@ -72,6 +74,10 @@ def main() -> None:
         from rapid_tpu.messaging.grpc_transport import GrpcClient, GrpcServer
 
         client, server = GrpcClient(listen, settings), GrpcServer(listen)
+    elif args.transport == "native-tcp":
+        from rapid_tpu.messaging.native_tcp import NativeTcpClientServer
+
+        client = server = NativeTcpClientServer(listen, settings)
     else:
         client = server = TcpClientServer(listen, settings)
     if args.gateway_address:

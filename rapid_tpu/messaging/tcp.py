@@ -332,6 +332,11 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
 
     def shutdown(self) -> None:
         self._framed.shutdown()
+        self._shutdown_client_half()
+
+    def _shutdown_client_half(self) -> None:
+        """Close every cached outbound connection (shared with subclasses
+        that replace the server half, e.g. the native-reactor transport)."""
         with self._conn_lock:
             connections = list(self._connections.values())
             self._connections.clear()

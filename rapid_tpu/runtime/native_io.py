@@ -1,0 +1,127 @@
+"""ctypes binding for the native framed-TCP reactor (native/rapid_io.cpp).
+
+The runtime-IO analogue of the reference's shared Netty event-loop group
+(SharedResources.java:48-67, NettyClientServer.java:65): a single epoll
+thread in C++ multiplexes every accepted connection of a server, replacing
+the Python transport's thread-per-connection readers. Frames cross the
+boundary through a poll()-style event queue; payload parsing (request-no,
+type tag, msgpack body) stays in rapid_tpu.messaging.codec.
+
+``load()`` returns None when the shared library cannot be built/loaded
+(no toolchain); callers fall back to the pure-Python FramedTcpServer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "librapid_io.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+# poll() event types (contract in rapid_io.cpp)
+EV_NONE = 0
+EV_FRAME = 1
+EV_CLOSED = 2
+EV_SHUTDOWN = -1
+
+
+def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        if not auto_build:
+            return None
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "librapid_io.so"],
+                check=True, capture_output=True,
+            )
+        except Exception:  # noqa: BLE001 -- no toolchain: Python fallback
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i64 = ctypes.c_int64
+    lib.rapid_io_server_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rapid_io_server_create.restype = i64
+    lib.rapid_io_server_port.argtypes = [i64]
+    lib.rapid_io_server_port.restype = ctypes.c_int
+    lib.rapid_io_server_poll.argtypes = [
+        i64, ctypes.POINTER(i64), u8p, i64, ctypes.POINTER(i64), ctypes.c_int
+    ]
+    lib.rapid_io_server_poll.restype = ctypes.c_int
+    lib.rapid_io_server_send.argtypes = [i64, i64, u8p, i64]
+    lib.rapid_io_server_send.restype = ctypes.c_int
+    lib.rapid_io_server_shutdown.argtypes = [i64]
+    lib.rapid_io_server_shutdown.restype = None
+    _lib = lib
+    return lib
+
+
+def available(auto_build: bool = True) -> bool:
+    return load(auto_build) is not None
+
+
+class NativeReactor:
+    """One native server: epoll accept/read loop plus a framed send path.
+
+    Events are drained with :meth:`poll`; replies go out with :meth:`send`.
+    ``conn_id`` is the reactor's identity for an accepted connection and is
+    the reply address for its frames.
+    """
+
+    def __init__(self, host: str, port: int) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native reactor unavailable (librapid_io.so)")
+        self._lib = lib
+        handle = lib.rapid_io_server_create(host.encode(), port)
+        if handle < 0:
+            raise OSError(-handle, os.strerror(-handle))
+        self._handle = handle
+        self.port = lib.rapid_io_server_port(handle)
+        self._buf = np.empty(1 << 20, dtype=np.uint8)  # grows on demand
+
+    def poll(self, timeout_ms: int = 500):
+        """Next event as ``(type, conn_id, payload-or-None)``; type is one of
+        the EV_* constants (EV_NONE on timeout, EV_SHUTDOWN after shutdown)."""
+        conn_id = ctypes.c_int64()
+        length = ctypes.c_int64()
+        ev = self._lib.rapid_io_server_poll(
+            self._handle, ctypes.byref(conn_id), self._buf,
+            self._buf.shape[0], ctypes.byref(length), timeout_ms,
+        )
+        if ev == EV_FRAME:
+            if length.value > self._buf.shape[0]:
+                # frame larger than the buffer: the event stayed queued
+                self._buf = np.empty(int(length.value), dtype=np.uint8)
+                return self.poll(timeout_ms)
+            payload = bytes(self._buf[: length.value])
+            return EV_FRAME, conn_id.value, payload
+        return ev, conn_id.value, None
+
+    def send(self, conn_id: int, frame: bytes) -> bool:
+        arr = np.frombuffer(frame, dtype=np.uint8)
+        return (
+            self._lib.rapid_io_server_send(
+                self._handle, conn_id, arr, arr.shape[0]
+            )
+            == 0
+        )
+
+    def shutdown(self) -> None:
+        self._lib.rapid_io_server_shutdown(self._handle)

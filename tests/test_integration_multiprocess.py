@@ -269,3 +269,35 @@ def test_three_agents_converge_over_grpc(runner):
     assert wait_for_size(logs[:-1], 2, timeout_s=120), seed_log.read_text()[-2000:]
     configs = {last_status(p)[1] for p in logs[:-1]}
     assert len(configs) == 1
+
+
+@pytest.mark.slow
+def test_three_agents_converge_over_native_tcp(runner):
+    """Tier-3 over the native epoll transport: real OS processes whose
+    server half is the C++ reactor (native/rapid_io.cpp) converge and
+    recover from a SIGKILL, like the pure-Python TCP tier does."""
+    from rapid_tpu.runtime.native_io import available
+
+    if not available():
+        pytest.skip("librapid_io.so unavailable (no toolchain)")
+    base = random.randint(30000, 39000)
+    seed_addr = f"127.0.0.1:{base}"
+    _, seed_log = runner.run_node(seed_addr, fd_interval_ms=200,
+                                  transport="native-tcp")
+    assert wait_for_membership(seed_log, 1, 30), seed_log.read_text()[-2000:]
+    logs = [seed_log]
+    for i in (1, 2):
+        _, log = runner.run_node(f"127.0.0.1:{base + i}", seed=seed_addr,
+                                 fd_interval_ms=200, transport="native-tcp")
+        logs.append(log)
+    assert wait_for_size(logs, 3, timeout_s=120), \
+        "\n".join(p.read_text()[-500:] for p in logs)
+    configs = {last_status(p)[1] for p in logs}
+    assert len(configs) == 1
+
+    victim_proc, _ = runner.procs[-1]
+    victim_proc.send_signal(signal.SIGKILL)
+    victim_proc.wait(timeout=10)
+    assert wait_for_size(logs[:-1], 2, timeout_s=120), seed_log.read_text()[-2000:]
+    configs = {last_status(p)[1] for p in logs[:-1]}
+    assert len(configs) == 1

@@ -1,0 +1,261 @@
+"""Native epoll transport (native/rapid_io.cpp via NativeTcpClientServer):
+wire interop with the pure-Python transport, load behavior, BOOTSTRAPPING
+semantics, and a live real-time cluster running entirely on the native
+server half -- the runtime-IO parity surface for the reference's Netty
+event-loop transport (NettyClientServer.java, SharedResources.java:63-67).
+"""
+
+import threading
+import time
+
+import pytest
+
+from rapid_tpu import ClusterBuilder, Endpoint, NodeId, Settings
+from rapid_tpu.messaging.native_tcp import (
+    NativeTcpClientServer,
+    native_io_available,
+)
+from rapid_tpu.messaging.tcp import TcpClientServer
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.types import (
+    NodeStatus,
+    PreJoinMessage,
+    ProbeMessage,
+    ProbeResponse,
+    Response,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_io_available(), reason="librapid_io.so unavailable (no toolchain)"
+)
+
+NID = NodeId(424242, -171717)
+
+
+@pytest.fixture
+def port_base():
+    import random
+
+    return random.randint(20000, 50000)
+
+
+class EchoService:
+    def __init__(self):
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def handle_message(self, msg):
+        with self.lock:
+            self.count += 1
+        if isinstance(msg, ProbeMessage):
+            return Promise.completed(ProbeResponse(NodeStatus.OK))
+        return Promise.completed(Response())
+
+
+def test_python_clients_against_native_server(port_base):
+    """Wire interop: 20 pure-Python clients x 5 requests against one native
+    server (NettyClientServerTest.java:41-81 at the same load)."""
+    server_addr = Endpoint.from_parts("127.0.0.1", port_base)
+    server = NativeTcpClientServer(server_addr)
+    service = EchoService()
+    server.set_membership_service(service)
+    server.start()
+    try:
+        clients = [
+            TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 1 + i))
+            for i in range(20)
+        ]
+        promises = [
+            c.send_message(server_addr, ProbeMessage(sender=c.address))
+            for c in clients
+            for _ in range(5)
+        ]
+        for p in promises:
+            assert p.result(10) == ProbeResponse(NodeStatus.OK)
+        assert service.count == 100
+        for c in clients:
+            c.shutdown()
+    finally:
+        server.shutdown()
+
+
+def test_native_client_against_python_server(port_base):
+    """The inherited client half interoperates with the Python server."""
+    server_addr = Endpoint.from_parts("127.0.0.1", port_base)
+    server = TcpClientServer(server_addr)
+    server.set_membership_service(EchoService())
+    server.start()
+    native = NativeTcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 1))
+    native.start()
+    try:
+        p = native.send_message(server_addr, ProbeMessage(sender=native.address))
+        assert p.result(10) == ProbeResponse(NodeStatus.OK)
+    finally:
+        native.shutdown()
+        server.shutdown()
+
+
+def test_bootstrapping_before_service_wired_native(port_base):
+    """GrpcServer.java:83-95 semantics on the native server: probes answered
+    BOOTSTRAPPING before set_membership_service, everything else dropped."""
+    addr = Endpoint.from_parts("127.0.0.1", port_base)
+    server = NativeTcpClientServer(addr)
+    server.start()
+    client = TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 1))
+    try:
+        p = client.send_message_best_effort(addr, ProbeMessage(sender=client.address))
+        assert p.result(10) == ProbeResponse(NodeStatus.BOOTSTRAPPING)
+        settings = Settings(message_timeout_ms=200)
+        fast_client = TcpClientServer(
+            Endpoint.from_parts("127.0.0.1", port_base + 2), settings
+        )
+        p2 = fast_client.send_message_best_effort(
+            addr, PreJoinMessage(sender=fast_client.address, node_id=NID)
+        )
+        with pytest.raises(TimeoutError):
+            p2.result(5)
+        fast_client.shutdown()
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_ephemeral_port_adopted(port_base):
+    """Binding port 0 adopts the kernel-assigned port into the address."""
+    server = NativeTcpClientServer(Endpoint.from_parts("127.0.0.1", 0))
+    server.set_membership_service(EchoService())
+    server.start()
+    try:
+        assert server.address.port > 0
+        client = TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base))
+        p = client.send_message(server.address, ProbeMessage(sender=client.address))
+        assert p.result(10) == ProbeResponse(NodeStatus.OK)
+        client.shutdown()
+    finally:
+        server.shutdown()
+
+
+def test_peer_senses_native_shutdown_by_eof(port_base):
+    """shutdown() FINs accepted connections (the shutdown-before-close dance):
+    a client's reader thread sees EOF promptly and fails its outstanding
+    requests instead of hanging until the deadline."""
+    addr = Endpoint.from_parts("127.0.0.1", port_base)
+    server = NativeTcpClientServer(addr)
+    server.set_membership_service(EchoService())
+    server.start()
+    client = TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 1))
+    try:
+        p = client.send_message(addr, ProbeMessage(sender=client.address))
+        assert p.result(10) == ProbeResponse(NodeStatus.OK)
+        conn = client._connection(addr)  # noqa: SLF001 -- liveness probe
+        server.shutdown()
+        deadline = time.time() + 5
+        while time.time() < deadline and not conn.closed:
+            time.sleep(0.02)
+        assert conn.closed, "client never observed the server's FIN"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_real_time_cluster_on_native_transport(port_base):
+    """A live 3-node cluster entirely on the native transport: join,
+    converge, crash one, converge again (tier-3 shape over epoll)."""
+    blacklist = set()
+    settings = Settings(
+        failure_detector_interval_ms=30,
+        batching_window_ms=10,
+        consensus_fallback_base_delay_ms=200,
+    )
+
+    def build(i, seed=None):
+        addr = Endpoint.from_parts("127.0.0.1", port_base + i)
+        transport = NativeTcpClientServer(addr, settings)
+        builder = (
+            ClusterBuilder(addr)
+            .use_settings(settings)
+            .set_messaging_client_and_server(transport, transport)
+            .set_edge_failure_detector_factory(StaticFailureDetectorFactory(blacklist))
+        )
+        if seed is None:
+            return builder.start()
+        return builder.join(seed, timeout=30)
+
+    seed = build(0)
+    c1 = build(1, seed.listen_address)
+    c2 = build(2, seed.listen_address)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if (
+                seed.get_membership_size()
+                == c1.get_membership_size()
+                == c2.get_membership_size()
+                == 3
+            ):
+                break
+            time.sleep(0.05)
+        assert seed.get_membership_size() == 3
+        assert seed.get_memberlist() == c1.get_memberlist() == c2.get_memberlist()
+
+        blacklist.add(c2.listen_address)
+        c2.shutdown()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if seed.get_membership_size() == 2 and c1.get_membership_size() == 2:
+                break
+            time.sleep(0.05)
+        assert seed.get_membership_size() == 2
+        assert c1.get_membership_size() == 2
+    finally:
+        seed.shutdown()
+        c1.shutdown()
+
+
+def test_send_never_blocks_on_stalled_peer(port_base):
+    """A peer that stops reading must not block send(): bytes queue in the
+    reactor and flush on EPOLLOUT once the peer drains -- intact and in
+    order. (The Python server isolates slow peers with a thread per
+    connection; the reactor must preserve that property on one thread.)"""
+    import socket as pysocket
+    import struct
+
+    from rapid_tpu.runtime.native_io import NativeReactor
+
+    reactor = NativeReactor("127.0.0.1", 0)
+    try:
+        sock = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_STREAM)
+        sock.setsockopt(pysocket.SOL_SOCKET, pysocket.SO_RCVBUF, 4096)
+        sock.connect(("127.0.0.1", reactor.port))
+        # announce the connection to the reactor by sending one tiny frame
+        sock.sendall(struct.pack("!I", 3) + b"hi!")
+        ev, conn_id, payload = reactor.poll(timeout_ms=5000)
+        assert ev == 1 and payload == b"hi!"
+
+        # 200 x 64KiB responses (~12.8 MB) into a peer that is not reading:
+        # every send must return promptly (the stall budget here is the test
+        # timeout, not a per-send block)
+        chunk = bytes(range(256)) * 256  # 64 KiB
+        t0 = time.time()
+        for _ in range(200):
+            assert reactor.send(conn_id, chunk)
+        assert time.time() - t0 < 5.0, "send() blocked on a stalled peer"
+
+        # now drain: all 200 frames arrive intact and in order
+        def read_exactly(n):
+            buf = bytearray()
+            while len(buf) < n:
+                got = sock.recv(n - len(buf))
+                assert got, "connection died mid-drain"
+                buf.extend(got)
+            return bytes(buf)
+
+        sock.settimeout(30)
+        for i in range(200):
+            (length,) = struct.unpack("!I", read_exactly(4))
+            assert length == len(chunk), f"frame {i} length {length}"
+            assert read_exactly(length) == chunk, f"frame {i} corrupted"
+        sock.close()
+    finally:
+        reactor.shutdown()
