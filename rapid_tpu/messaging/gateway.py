@@ -269,7 +269,12 @@ class SwarmGateway:
         restore_from: Optional[str] = None,
         restore_config_overrides: Optional[dict] = None,
         mesh=None,
+        native_server: bool = False,
     ) -> None:
+        """``native_server``: accept/read routed frames on the C++ epoll
+        reactor (native/rapid_io.cpp) instead of the thread-per-connection
+        Python server; the wire format and everything above it (routing,
+        parking, the pump) is identical."""
         from ..sim.bridge import TpuSimMessaging
 
         self.address = listen_address
@@ -304,7 +309,13 @@ class SwarmGateway:
             )
         self._pump_interval_s = pump_interval_ms / 1000.0
         self._pump_max_rounds = pump_max_rounds
-        self._framed = FramedTcpServer(listen_address, self._on_frame, "gateway")
+        self._native_server = native_server
+        self._reactor = None
+        self._framed = (
+            None
+            if native_server
+            else FramedTcpServer(listen_address, self._on_frame, "gateway")
+        )
         self._threads: List[threading.Thread] = []
         self._running = False
         self._decisions: List[object] = []
@@ -352,18 +363,41 @@ class SwarmGateway:
 
     def start(self) -> None:
         self._running = True
-        self._framed.start()
-        for target, name in (
+        threads = [
             (self._protocol_loop, "gateway-protocol"),
             (self._pump_loop, "gateway-pump"),
-        ):
+        ]
+        if self._native_server:
+            from ..runtime.native_io import NativeReactor
+
+            self._reactor = NativeReactor(
+                self.address.hostname.decode(), self.address.port
+            )
+            threads.append((self._native_dispatch_loop, "gateway-reactor"))
+        else:
+            self._framed.start()
+        for target, name in threads:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
 
+    def _native_dispatch_loop(self) -> None:
+        from ..runtime.native_io import EV_FRAME, EV_SHUTDOWN
+
+        reactor = self._reactor
+        while self._running:
+            ev, conn_id, payload = reactor.poll(timeout_ms=500)
+            if ev == EV_SHUTDOWN:
+                return
+            if ev == EV_FRAME:
+                self._on_native_frame(conn_id, payload)  # decode guarded inside
+
     def shutdown(self) -> None:
         self._running = False
-        self._framed.shutdown()
+        if self._reactor is not None:
+            self._reactor.shutdown()
+        if self._framed is not None:
+            self._framed.shutdown()
         self._tasks.put(None)
         self.network.shutdown()
         self._out.shutdown()
@@ -429,17 +463,39 @@ class SwarmGateway:
 
     def _on_frame(self, sock: socket.socket, write_lock: threading.Lock,
                   frame: bytes) -> None:
-        request_no, dst, msg = decode_routed(frame)
+        def reply_send(data: bytes) -> None:
+            try:
+                with write_lock:
+                    _write_frame(sock, data)
+            except OSError:
+                pass
+
+        self._enqueue_routed(reply_send, frame)
+
+    def _on_native_frame(self, conn_id: int, frame: bytes) -> None:
+        reactor = self._reactor
+
+        def reply_send(data: bytes) -> None:
+            if reactor is not None:
+                reactor.send(conn_id, data)
+
+        self._enqueue_routed(reply_send, frame)
+
+    def _enqueue_routed(self, reply_send, frame: bytes) -> None:
+        try:
+            request_no, dst, msg = decode_routed(frame)
+        except Exception:  # noqa: BLE001 -- a bad frame must not kill either
+            LOG.warning("undecodable routed frame dropped")  # front door
+            return
         self._tasks.put(
-            lambda rn=request_no, d=dst, m=msg: self._handle_one(
-                sock, write_lock, rn, d, m
+            lambda rs=reply_send, rn=request_no, d=dst, m=msg: self._handle_one(
+                rs, rn, d, m
             )
         )
 
     def _handle_one(
         self,
-        sock: socket.socket,
-        write_lock: threading.Lock,
+        reply_send,  # Callable[[bytes], None]: framed write to the requester
         request_no: int,
         dst: Endpoint,
         msg: RapidMessage,
@@ -470,10 +526,6 @@ class SwarmGateway:
             response = p._result  # noqa: SLF001
             if response is None:
                 return
-            try:
-                with write_lock:
-                    _write_frame(sock, encode(request_no, response))
-            except OSError:
-                pass
+            reply_send(encode(request_no, response))
 
         promise.add_callback(reply)

@@ -1,0 +1,23 @@
+"""The published experiment harnesses stay runnable: each one's measurement
+function executes end-to-end at small scale with its internal parity
+assertions armed (the sweeps in BASELINE.md are these same functions at
+full scale on TPU)."""
+
+from experiments.join_wave import run_size as join_wave_size
+from experiments.scaling_sweep import run_size as scaling_size
+
+
+def test_join_wave_single_view_change():
+    out = join_wave_size(300, 0.01, seed=7)
+    assert out["admitted_ok"] and out["wave"] == 3
+    # a whole wave lands in ONE view change: join reports arrive in round 1,
+    # the vote-delivery hop is round 2, plus the batching window -- the
+    # protocol time is size-independent (the bootstrap-batching headline,
+    # paper Fig. 5)
+    assert out["virtual_ms"] == 2 * 1000 + 100
+
+
+def test_scaling_sweep_point():
+    out = scaling_size(300, seed=7)
+    assert out["cut_ok"]
+    assert out["virtual_ms"] == 11 * 1000 + 100

@@ -42,7 +42,7 @@ def test_routed_frame_roundtrip():
 class GatewayHarness:
     """A socket-hosted swarm plus real agents, all on loopback."""
 
-    def __init__(self, n_virtual=32, seed=11):
+    def __init__(self, n_virtual=32, seed=11, native_server=False):
         self.base = random.randint(20000, 29000)
         self.settings = Settings(
             failure_detector_interval_ms=100,
@@ -55,6 +55,7 @@ class GatewayHarness:
             seed=seed,
             settings=self.settings,
             pump_interval_ms=50,
+            native_server=native_server,
         )
         self.gateway.start()
         self.agents = []
@@ -310,3 +311,30 @@ def test_socket_agents_against_mesh_sharded_swarm():
         for a in agents:
             a.shutdown()
         gateway.shutdown()
+
+
+@pytest.mark.slow
+def test_agents_join_swarm_through_native_reactor():
+    """The gateway's socket front door on the C++ epoll reactor
+    (native_server=True): agents join, observe a virtual cut, and converge
+    to the same config id -- everything above the accept/read loop
+    unchanged."""
+    from rapid_tpu.runtime.native_io import available
+
+    if not available():
+        pytest.skip("librapid_io.so unavailable (no toolchain)")
+    h = GatewayHarness(n_virtual=24, seed=13, native_server=True)
+    try:
+        a1 = h.join_agent(1)
+        a2 = h.join_agent(2)
+        assert h.wait_converged(26)
+        victims = [5, 9]
+        h.gateway.bridge.sim.crash(np.array(victims))
+        assert h.wait_converged(24)
+        assert (
+            a1.get_current_configuration_id()
+            == a2.get_current_configuration_id()
+            == h.gateway.configuration_id()
+        )
+    finally:
+        h.shutdown()
