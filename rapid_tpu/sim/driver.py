@@ -626,9 +626,10 @@ class Simulator:
             inputs = self._const_inputs(join_reports)
             n = min(batch, max_rounds - rounds_done)
             random_loss = bool((self._drop_prob > 0).any())
-            # the windowed FD policy's sliding history has no closed form;
-            # it runs on the general scan path
-            use_scan = random_loss or self.config.fd_policy == "windowed"
+            # both FD policies have closed forms under a deterministic
+            # constant plane (the windowed recurrence saturates after W
+            # probes); only random ingress loss forces the scan path
+            use_scan = random_loss
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
                 if self.mesh is not None:
                     # inputs are already placed under their dispatch shardings;

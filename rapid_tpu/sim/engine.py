@@ -107,6 +107,10 @@ class SimConfig:
             f"fd_policy must be 'cumulative' or 'windowed', got "
             f"{self.fd_policy!r}"
         )
+        assert 1 <= self.fd_window <= 16, (
+            f"fd_window must be in [1, 16] (window bitmask is uint16), got "
+            f"{self.fd_window}"
+        )
 
     @property
     def proposal_rows(self) -> int:
@@ -573,21 +577,22 @@ def run_until_decided_const(
     plane in ONE device dispatch, exiting as soon as consensus decides.
 
     With the fault plane fixed for the whole dispatch and no random ingress
-    loss, the probe phase is closed-form: each monitoring edge either fails
-    every round or never, so the round at which its cumulative counter crosses
-    the threshold (PingPongFailureDetector.java:69-77) is computable up front.
-    The while-loop body is then pure elementwise arithmetic -- no per-round
-    gathers -- and rounds after the decision are never executed at all,
-    unlike the scan path's masked no-ops. Produces bit-identical state to
-    scanning ``step`` with ``random_loss=False`` over the same inputs, with
-    one exception: ``rng_key`` is not advanced (this path draws no random
-    numbers, whereas the scan path splits the key every round).
-
-    Cumulative FD policy only: the windowed policy's sliding history has no
-    closed form over carried-over state, so the driver routes it to the scan
-    path.
+    loss, the probe phase is closed-form: each monitoring edge's probe
+    outcome is the same every probing round, so the round at which it fires
+    is computable up front -- for the cumulative policy, when the counter
+    crosses the threshold (PingPongFailureDetector.java:69-77); for the
+    windowed policy, by stepping the (<= fd_window)-step window recurrence
+    at trace time until it saturates (after W recorded probes with a
+    constant outcome the window is in steady state, so the first firing
+    probe index is always <= W). The while-loop body is then pure
+    elementwise arithmetic -- no per-round gathers -- and rounds after the
+    decision are never executed at all, unlike the scan path's masked
+    no-ops. Produces bit-identical state to scanning ``step`` with
+    ``random_loss=False`` over the same inputs, with one exception:
+    ``rng_key`` is not advanced (this path draws no random numbers, whereas
+    the scan path splits the key every round).
     """
-    assert config.fd_policy == "cumulative"
+    assert config.fd_policy in ("cumulative", "windowed")
     c, k = config.capacity, config.k
     active = state.active
     alive = inputs.alive & active
@@ -598,21 +603,48 @@ def run_until_decided_const(
     probe_ok = target_up & ~inputs.probe_drop
     fail_event = edge_live & observer_up & ~probe_ok  # constant per round
 
-    # Round (1-based within this dispatch) at which each observer-indexed edge
-    # crosses the cumulative threshold; never fires here otherwise. An edge
-    # already at/over threshold but unalerted fires on the next failed probe.
-    # With staggered phases an observer probes only at relative rounds
-    # p_rel+1, p_rel+1+rpi, ... where p_rel re-bases its fixed phase onto this
-    # dispatch's starting round.
+    # Probe index (1-based) at which each observer-indexed edge fires; never
+    # fires here otherwise. An edge already at/over threshold but unalerted
+    # fires on its next qualifying probe. With staggered phases an observer
+    # probes only at relative rounds p_rel+1, p_rel+1+rpi, ... where p_rel
+    # re-bases its fixed phase onto this dispatch's starting round.
     never = jnp.int32(0x7FFFFFFF)
     rpi = config.rounds_per_interval
-    rem = jnp.maximum(config.fd_threshold - state.fd_fail, 1)
     if rpi > 1:
         p_rel = (probe_phases(config) - state.round) % rpi  # [C]
-        fire_round = p_rel[:, None] + 1 + (rem - 1) * rpi
+    if config.fd_policy == "windowed":
+        # step the window recurrence W times at trace time (W <= 16 cheap
+        # elementwise ops over [C, K], once per dispatch): record the first
+        # probe index at which a full window holds >= t failures. probed
+        # edges shift their constant outcome in; by probe W the window is
+        # entirely new bits, so later probes cannot produce a first firing.
+        probed = edge_live & observer_up
+        f16 = (probed & ~probe_ok).astype(jnp.uint16)
+        w = config.fd_window
+        t = int(np.ceil(config.fd_window_threshold * w))
+        maskw = jnp.uint16((1 << w) - 1)
+        hist, seen = state.fd_hist, state.fd_seen
+        fire_probe = jnp.full((c, k), never, jnp.int32)
+        for j in range(1, w + 1):
+            hist = ((hist << jnp.uint16(1)) | f16) & maskw
+            seen = jnp.minimum(seen + 1, w)
+            crossed = (
+                probed
+                & (seen >= w)
+                & (jax.lax.population_count(hist).astype(jnp.int32) >= t)
+            )
+            fire_probe = jnp.where(
+                crossed & (fire_probe == never), jnp.int32(j), fire_probe
+            )
+        fires = (fire_probe != never) & ~state.alerted
     else:
-        fire_round = rem
-    fire = jnp.where(fail_event & ~state.alerted, fire_round, never)
+        fire_probe = jnp.maximum(config.fd_threshold - state.fd_fail, 1)
+        fires = fail_event & ~state.alerted
+    if rpi > 1:
+        fire_round = p_rel[:, None] + 1 + (fire_probe - 1) * rpi
+    else:
+        fire_round = fire_probe
+    fire = jnp.where(fires, fire_round, never)
     cols = jnp.arange(k, dtype=jnp.int32)[None, :]
     # dst-indexed arrival round (see the gather-not-scatter note in ``step``).
     # Proactive DOWN reports (graceful leave) arrive in the first round; the
@@ -682,8 +714,25 @@ def run_until_decided_const(
         probes = jnp.maximum(0, (r_exec - 1 - p_rel) // rpi + 1)[:, None]
     else:
         probes = r_exec
-    fd_fail = state.fd_fail + probes * fail_event.astype(jnp.int32)
     alerted = state.alerted | (fire <= r_exec)
+    if config.fd_policy == "windowed":
+        # hist after p recorded probes of constant outcome f:
+        # (hist0 << p | f * (2^p - 1)) masked -- only min(p, W) matters
+        # (shift in uint32: uint16 shifts by >= 16 are undefined)
+        p_eff = jnp.minimum(probes, w).astype(jnp.uint32)
+        h32 = state.fd_hist.astype(jnp.uint32) << p_eff
+        fills = jnp.where(
+            f16.astype(bool), (jnp.uint32(1) << p_eff) - 1, jnp.uint32(0)
+        )
+        hist_new = ((h32 | fills) & jnp.uint32(maskw)).astype(jnp.uint16)
+        fd_hist = jnp.where(probed, hist_new, state.fd_hist)
+        fd_seen = jnp.where(
+            probed, jnp.minimum(state.fd_seen + probes, w), state.fd_seen
+        )
+        return dataclasses.replace(
+            final, fd_hist=fd_hist, fd_seen=fd_seen, alerted=alerted
+        )
+    fd_fail = state.fd_fail + probes * fail_event.astype(jnp.int32)
     return dataclasses.replace(final, fd_fail=fd_fail, alerted=alerted)
 
 

@@ -217,3 +217,76 @@ def test_staggered_phases_multi_dispatch_resume():
                 int(state_a.round) - int(state_b.round), False,
             )
         _assert_states_equal(state_a, state_b)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_windowed_policy_matches_scan_path(seed):
+    """The windowed closed form (window recurrence stepped at trace time)
+    must be bit-identical to scanning the windowed step."""
+    rng = np.random.default_rng(seed)
+    config = SimConfig(
+        capacity=48, k=6, h=5, l=2, fd_policy="windowed",
+        fd_window=6, fd_window_threshold=0.5,
+    )
+    sim = Simulator(48, capacity=48, config=config, seed=seed)
+    victims = rng.choice(48, size=3, replace=False)
+    sim.crash(victims)
+    inputs = const_inputs(config, sim.alive)
+    scan, fast = _run_both(config, sim.state, inputs, 14)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 14))
+
+
+def test_windowed_policy_carried_window_matches_scan_path():
+    """Carried-over window contents (a crash, some rounds, then a revive and
+    a different crash) must reconstruct identically: the closed form starts
+    from a half-full, partly-failed window, not a fresh one."""
+    config = SimConfig(
+        capacity=40, k=5, h=4, l=2, fd_policy="windowed",
+        fd_window=8, fd_window_threshold=0.4,
+    )
+    sim = Simulator(40, capacity=40, config=config, seed=3)
+    sim.crash(np.array([7]))
+    # run 3 rounds on the scan path so fd_hist/fd_seen carry partial state
+    inputs = const_inputs(config, sim.alive)
+    state = run_rounds_const(config, sim.state, inputs, 3, False)
+    sim.state = state
+    sim.revive(np.array([7]))
+    sim.crash(np.array([11, 12]))
+    inputs2 = const_inputs(config, sim.alive)
+    scan, fast = _run_both(config, sim.state, inputs2, 16)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs2, 16))
+
+
+def test_windowed_policy_staggered_phases_matches_scan_path():
+    """Windowed + rounds_per_interval > 1: probe scheduling by phase and the
+    probe-index -> round mapping must agree with the scan path exactly."""
+    config = SimConfig(
+        capacity=32, k=4, h=3, l=2, fd_policy="windowed",
+        fd_window=5, fd_window_threshold=0.4, rounds_per_interval=4,
+    )
+    sim = Simulator(32, capacity=32, config=config, seed=9)
+    sim.crash(np.array([5, 21]))
+    inputs = const_inputs(config, sim.alive)
+    scan, fast = _run_both(config, sim.state, inputs, 40)
+    _assert_states_equal(scan, _equalize_rounds(config, fast, inputs, 40))
+
+
+def test_windowed_driver_fast_path_decides_with_exact_timing():
+    """Driver-level: a windowed-policy run with no random loss takes the
+    single-dispatch closed-form path (scan is only for random ingress loss)
+    and decides with the exact protocol timing."""
+    config = SimConfig(
+        capacity=50, fd_policy="windowed", fd_window=10,
+        fd_window_threshold=0.4,
+    )
+    sim = Simulator(50, capacity=50, config=config, seed=4)
+    sim.crash(np.array([8, 9]))
+    rec = sim.run_until_decision(max_rounds=64, batch=64,
+                                 classic_fallback_after_rounds=None)
+    assert rec is not None and set(rec.cut) == {8, 9}
+    # windowed detection requires a FULL window (10 probes) before firing,
+    # regardless of the 0.4 threshold: decision = 10 rounds + the
+    # vote-delivery hop + the batching window
+    assert rec.virtual_time_ms == 11 * 1000 + 100
+    # one device dispatch settles it (the early-exit while_loop)
+    assert sim.metrics.get("device_dispatches") == 1
