@@ -13,7 +13,9 @@ Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -21,6 +23,28 @@ import numpy as np
 N_NODES = 100_000
 FAIL_FRACTION = 0.01
 BASELINE_MS = 5000.0  # north-star budget (BASELINE.json)
+
+# Fail fast instead of hanging forever when the accelerator is unreachable
+# (the remote-TPU tunnel blocks indefinitely inside device init when its
+# upstream is down): a warmed 100k run takes ~1 min end to end, so if the
+# watchdog fires something is broken, and a loud error beats a silent hang.
+WATCHDOG_S = 15 * 60
+
+
+def _arm_watchdog() -> None:
+    def fire() -> None:
+        print(
+            f"bench.py watchdog: no result after {WATCHDOG_S}s -- the "
+            "accelerator is likely unreachable (device init hangs when the "
+            "TPU tunnel's upstream is down). No measurement was produced.",
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(17)
+
+    timer = threading.Timer(WATCHDOG_S, fire)
+    timer.daemon = True
+    timer.start()
 
 
 def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
@@ -59,6 +83,7 @@ def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
 
 
 def main() -> None:
+    _arm_watchdog()
     wall_ms, record, build_s, warm_wall = warmed_run(N_NODES, seed=1234)
 
     print(

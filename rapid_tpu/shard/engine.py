@@ -74,6 +74,52 @@ def make_mesh(
     return Mesh(np.array(devices), (NODES_AXIS,))
 
 
+def make_multihost_mesh(
+    chips_per_host: int | None = None,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> Mesh:
+    """The multi-host deployment entry: a global ``("dcn", "ici")`` mesh over
+    every chip of every host.
+
+    On a TPU pod slice, run one process per host and pass the coordinator's
+    ``host:port`` plus this process's rank -- ``jax.distributed.initialize``
+    wires the cross-host runtime, after which ``jax.devices()`` is the
+    *global* device set and the returned mesh rows are hosts (DCN axis) and
+    columns are each host's chips (ICI axis). The sharded round step then
+    needs no further changes: its single ``pmax`` names both axes, and XLA
+    decomposes it into an intra-host ICI reduction plus a cross-host DCN
+    exchange (the hierarchy SURVEY.md §5.8 maps the reference's gRPC fan-out
+    onto). Single-process callers (or tests on the forced CPU backend) get
+    the degenerate 1-host mesh with identical program semantics.
+    """
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    # group devices by owning process so mesh ROWS really are hosts -- a flat
+    # prefix slice would put one host's chips across several "dcn" rows when
+    # chips_per_host is smaller than the hosts' actual chip count
+    by_process: dict = {}
+    for d in jax.devices():
+        by_process.setdefault(d.process_index, []).append(d)
+    rows = []
+    for proc in sorted(by_process):
+        host_devices = sorted(by_process[proc], key=lambda d: d.id)
+        per_host = (
+            chips_per_host if chips_per_host is not None else len(host_devices)
+        )
+        assert per_host <= len(host_devices), (
+            f"chips_per_host={per_host} exceeds process {proc}'s "
+            f"{len(host_devices)} devices"
+        )
+        rows.append(host_devices[:per_host])
+    return Mesh(np.array(rows), ("dcn", "ici"))
+
+
 def state_shardings(mesh: Mesh) -> SimState:
     """The sharding pytree for SimState: per-edge arrays row-sharded by
     observer over every mesh axis, everything else replicated."""

@@ -626,10 +626,6 @@ class Simulator:
             inputs = self._const_inputs(join_reports)
             n = min(batch, max_rounds - rounds_done)
             random_loss = bool((self._drop_prob > 0).any())
-            # both FD policies have closed forms under a deterministic
-            # constant plane (the windowed recurrence saturates after W
-            # probes); only random ingress loss forces the scan path
-            use_scan = random_loss
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
                 if self.mesh is not None:
                     # inputs are already placed under their dispatch shardings;
@@ -639,8 +635,10 @@ class Simulator:
                     self.state = self._sharded_run_until(random_loss)(
                         self.state, inputs, jnp.int32(n)
                     )
-                elif use_scan:
-                    # per-round (possibly RNG-consuming) scan path
+                elif random_loss:
+                    # the per-round RNG-consuming scan path: random ingress
+                    # loss is the one fault with no closed form (both FD
+                    # policies have one under a deterministic constant plane)
                     self.state = run_rounds_const(
                         self.config, self.state, inputs, n, random_loss
                     )

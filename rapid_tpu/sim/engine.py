@@ -425,30 +425,54 @@ def probe_phases(config: SimConfig) -> jnp.ndarray:
     return ((idx * jnp.uint32(2654435761)) % jnp.uint32(rpi)).astype(jnp.int32)
 
 
+def _window_params(config: SimConfig) -> Tuple[int, int, jnp.ndarray]:
+    """(window size W, firing threshold t, uint16 bitmask) for the windowed
+    policy -- the single source of the rounding and mask rules."""
+    w = config.fd_window
+    t = int(np.ceil(config.fd_window_threshold * w))
+    return w, t, jnp.uint16((1 << w) - 1)
+
+
+def window_step(
+    config: SimConfig,
+    hist: jax.Array,  # uint16[., K] last-W probe outcomes
+    seen: jax.Array,  # int32[., K] probes recorded, saturating at W
+    probed: jax.Array,  # bool[., K] a probe was recorded on this edge
+    fail_event: jax.Array,  # bool[., K] the recorded probe failed
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One recorded-probe update of the sliding window, returning
+    (hist, seen, crossed). This is THE definition of the paper policy's
+    firing rule (atc-2018 paper section 6: an edge is faulty when >=
+    fd_window_threshold of its last fd_window recorded probes failed, once a
+    full window has been recorded) -- shared by the scan step, the sharded
+    step, and the closed-form fast path, so the semantics cannot drift
+    between lowerings."""
+    w, t, mask = _window_params(config)
+    shifted = ((hist << 1) | fail_event.astype(jnp.uint16)) & mask
+    hist = jnp.where(probed, shifted, hist)
+    seen = jnp.where(probed, jnp.minimum(seen + 1, w), seen)
+    crossed = (
+        probed
+        & (seen >= w)
+        & (jax.lax.population_count(hist).astype(jnp.int32) >= t)
+    )
+    return hist, seen, crossed
+
+
 def windowed_fd_phase(
     config: SimConfig,
     state: SimState,
     probed: jax.Array,  # bool[., K] a probe was recorded on this edge
     fail_event: jax.Array,  # bool[., K] the recorded probe failed
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """The paper's FD policy (atc-2018 paper section 6): an edge is faulty
-    when >= fd_window_threshold of its last fd_window recorded probes failed,
-    once a full window has been recorded (the object-model
-    WindowedPingPongFailureDetector requires a full window too). Shared by
-    the single-device and sharded steps; the cumulative fd_fail counter is
-    not touched (windowed detection never reads it).
-
-    Returns (fd_hist, fd_seen, new_down)."""
-    assert config.fd_window <= 16, "window bitmask is uint16"
-    w = config.fd_window
-    t = int(np.ceil(config.fd_window_threshold * w))
-    mask = jnp.uint16((1 << w) - 1)
-    shifted = ((state.fd_hist << 1) | fail_event.astype(jnp.uint16)) & mask
-    fd_hist = jnp.where(probed, shifted, state.fd_hist)
-    fd_seen = jnp.where(probed, jnp.minimum(state.fd_seen + 1, w), state.fd_seen)
-    failed = jax.lax.population_count(fd_hist) >= t
-    new_down = probed & (fd_seen >= w) & failed & ~state.alerted
-    return fd_hist, fd_seen, new_down
+    """The per-round windowed-FD phase over SimState: ``window_step`` plus
+    the one-shot alert latch. The cumulative fd_fail counter is not touched
+    (windowed detection never reads it). Returns (fd_hist, fd_seen,
+    new_down)."""
+    fd_hist, fd_seen, crossed = window_step(
+        config, state.fd_hist, state.fd_seen, probed, fail_event
+    )
+    return fd_hist, fd_seen, crossed & ~state.alerted
 
 
 def step(config: SimConfig, state: SimState, inputs: RoundInputs,
@@ -615,24 +639,16 @@ def run_until_decided_const(
     if config.fd_policy == "windowed":
         # step the window recurrence W times at trace time (W <= 16 cheap
         # elementwise ops over [C, K], once per dispatch): record the first
-        # probe index at which a full window holds >= t failures. probed
-        # edges shift their constant outcome in; by probe W the window is
-        # entirely new bits, so later probes cannot produce a first firing.
+        # probe index at which window_step reports a crossing. Probed edges
+        # shift their constant outcome in; by probe W the window is entirely
+        # new bits, so later probes cannot produce a first firing.
         probed = edge_live & observer_up
-        f16 = (probed & ~probe_ok).astype(jnp.uint16)
-        w = config.fd_window
-        t = int(np.ceil(config.fd_window_threshold * w))
-        maskw = jnp.uint16((1 << w) - 1)
+        fail = probed & ~probe_ok
+        w, _, maskw = _window_params(config)
         hist, seen = state.fd_hist, state.fd_seen
         fire_probe = jnp.full((c, k), never, jnp.int32)
         for j in range(1, w + 1):
-            hist = ((hist << jnp.uint16(1)) | f16) & maskw
-            seen = jnp.minimum(seen + 1, w)
-            crossed = (
-                probed
-                & (seen >= w)
-                & (jax.lax.population_count(hist).astype(jnp.int32) >= t)
-            )
+            hist, seen, crossed = window_step(config, hist, seen, probed, fail)
             fire_probe = jnp.where(
                 crossed & (fire_probe == never), jnp.int32(j), fire_probe
             )
@@ -721,9 +737,7 @@ def run_until_decided_const(
         # (shift in uint32: uint16 shifts by >= 16 are undefined)
         p_eff = jnp.minimum(probes, w).astype(jnp.uint32)
         h32 = state.fd_hist.astype(jnp.uint32) << p_eff
-        fills = jnp.where(
-            f16.astype(bool), (jnp.uint32(1) << p_eff) - 1, jnp.uint32(0)
-        )
+        fills = jnp.where(fail, (jnp.uint32(1) << p_eff) - 1, jnp.uint32(0))
         hist_new = ((h32 | fills) & jnp.uint32(maskw)).astype(jnp.uint16)
         fd_hist = jnp.where(probed, hist_new, state.fd_hist)
         fd_seen = jnp.where(

@@ -137,3 +137,25 @@ def test_sharded_driver_2d_dcn_ici_mesh():
     assert sorted(a.cut) == sorted(b.cut) == [3, 99]
     assert a.configuration_id == b.configuration_id
     assert a.virtual_time_ms == b.virtual_time_ms
+
+
+def test_multihost_mesh_entry_degenerate_single_process():
+    """make_multihost_mesh without a coordinator: the degenerate 1-host
+    ("dcn", "ici") mesh over local devices runs the full sharded decision
+    path (on a pod slice the same call site gets hosts x chips; the step
+    program is identical)."""
+    from rapid_tpu.shard.engine import make_multihost_mesh
+
+    mesh = make_multihost_mesh(chips_per_host=4)
+    assert mesh.axis_names == ("dcn", "ici")
+    assert mesh.shape["dcn"] == 1 and mesh.shape["ici"] == 4
+    sim = Simulator(36, capacity=36, seed=31, mesh=mesh)
+    sim.crash(np.array([4, 17]))
+    rec = sim.run_until_decision(max_rounds=32, batch=8)
+    assert rec is not None and set(rec.cut) == {4, 17}
+    # identical outcome to the single-device driver
+    ref = Simulator(36, capacity=36, seed=31)
+    ref.crash(np.array([4, 17]))
+    ref_rec = ref.run_until_decision(max_rounds=32, batch=8)
+    assert ref_rec.configuration_id == rec.configuration_id
+    assert ref_rec.virtual_time_ms == rec.virtual_time_ms
