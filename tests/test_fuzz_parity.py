@@ -24,12 +24,23 @@ STEPS = 8
 BATCH = 6
 
 
-def generate_and_run(fuzz_seed: int, mesh=None, script=None, speculate=True):
+def generate_and_run(fuzz_seed: int, mesh=None, script=None, speculate=True,
+                     fd_policy="cumulative"):
     """Run a fault schedule; if ``script`` is None, generate it adaptively
     (choices constrained by the live protocol state) and return it.
     Returns (script, history, simulator)."""
+    from rapid_tpu.sim.engine import SimConfig
+
+    # reference-default parameters for the cumulative (parity) runs; the
+    # windowed runs use a short window so schedules decide within STEPS
+    config = (
+        SimConfig(capacity=CAPACITY, fd_policy="windowed", fd_threshold=5,
+                  fd_window=8, fd_window_threshold=0.5)
+        if fd_policy == "windowed"
+        else SimConfig(capacity=CAPACITY)
+    )
     sim = Simulator(
-        N_START, capacity=CAPACITY, seed=fuzz_seed, mesh=mesh,
+        N_START, capacity=CAPACITY, config=config, seed=fuzz_seed, mesh=mesh,
         speculate=speculate,
     )
     rng = random.Random(fuzz_seed * 7919)
@@ -212,3 +223,34 @@ def run_cross_plane_schedule(fuzz_seed: int, n_start: int = 10, steps: int = 5):
 def test_cross_plane_fuzzed_schedule(fuzz_seed):
     schedule = run_cross_plane_schedule(fuzz_seed)
     assert schedule
+
+
+@pytest.mark.parametrize("fuzz_seed", [31, 32])
+def test_fuzzed_windowed_schedule_identical_on_mesh(fuzz_seed):
+    """The windowed policy under random churn: single-device closed form vs
+    the mesh scan lowering, history-identical (the firing rule is shared,
+    engine.window_step; this pins the surrounding plumbing too)."""
+    script, single_history, _ = generate_and_run(fuzz_seed, fd_policy="windowed")
+    assert single_history, f"schedule decided nothing: {script}"
+    mesh = make_mesh(8)
+    _, mesh_history, _ = generate_and_run(
+        fuzz_seed, mesh=mesh, script=script, fd_policy="windowed"
+    )
+    assert mesh_history == single_history, f"schedule: {script}"
+
+
+@pytest.mark.parametrize("fuzz_seed", [33, 34])
+def test_fuzzed_windowed_schedule_identical_without_speculation(fuzz_seed):
+    script, spec_history, spec_sim = generate_and_run(fuzz_seed, fd_policy="windowed")
+    assert spec_history, f"schedule decided nothing: {script}"
+    _, plain_history, plain_sim = generate_and_run(
+        fuzz_seed, script=script, speculate=False, fd_policy="windowed"
+    )
+    assert spec_history == plain_history, f"schedule: {script}"
+    spec_hits = (
+        spec_sim.metrics.get("speculation_hits_config_id")
+        + spec_sim.metrics.get("speculation_hits_fresh_state")
+    )
+    assert spec_hits > 0, f"speculation never consumed; schedule: {script}"
+    assert plain_sim.metrics.get("speculation_hits_config_id") == 0
+    assert plain_sim.metrics.get("speculation_hits_fresh_state") == 0
