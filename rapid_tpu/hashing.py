@@ -278,6 +278,22 @@ def endpoint_hash_batch(
         return host_h * _U64(31) + port_h
 
 
+def xxh64_batch_auto(
+    data: np.ndarray, lengths: np.ndarray, seed: int = 0
+) -> np.ndarray:
+    """``xxh64_batch`` through the native library when it is loadable, the
+    vectorized-numpy implementation otherwise (identical outputs; the two
+    are cross-validated in tests/test_hashing.py). Use this on hot
+    construction paths -- the native lane loop is several times faster at
+    million-row batches."""
+    from . import native
+
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    out = native.xxh64_batch(data, lengths, seed)
+    return out if out is not None else xxh64_batch(data, lengths, seed)
+
+
 def pack_hostnames(hostnames: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
     """Pack variable-length hostname byte strings into a padded uint8 matrix."""
     max_len = max((len(h) for h in hostnames), default=1)

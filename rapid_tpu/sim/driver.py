@@ -116,9 +116,10 @@ class Simulator:
         self._seen_ids = np.stack(
             [self.cluster.id_high[slots], self.cluster.id_low[slots]], axis=1
         )  # [M, 2] int64, admission order
-        self._seen_set: Set[Tuple[int, int]] = {
-            (int(h), int(l)) for h, l in self._seen_ids
-        }
+        # the membership test over the history is built lazily: it is only
+        # consulted on identity admissions (joins), and materializing a
+        # million-tuple set up front is a real construction cost
+        self._seen_set: Optional[Set[Tuple[int, int]]] = None
         self._seen_hashes: Optional[np.ndarray] = None  # [M, 2] uint64
         self.seed = seed
         self.speculate = speculate
@@ -303,7 +304,9 @@ class Simulator:
         not, exactly as the reference rejects seen UUIDs
         (MembershipView.java:101-116)."""
         assert not self.active[slot] and slot not in self._pending_joiners
-        assert (id_high, id_low) not in self._seen_set, "identifier reuse"
+        assert (id_high, id_low) not in self._seen_identifier_set(), (
+            "identifier reuse"
+        )
         self.cluster.assign_identity(slot, hostname, port, id_high, id_low)
         # the device rank table is only consumed at the next configuration
         # rebuild (_fresh_state); defer the argsort + upload until then so a
@@ -312,13 +315,21 @@ class Simulator:
         self._ring_nodes = None
         self._spec = None  # endpoint hashes / rank table changed
 
+    def _seen_identifier_set(self) -> Set[Tuple[int, int]]:
+        """Membership test over the identifier history, materialized on first
+        admission-path use (joins); the append-only array form is the source
+        of truth."""
+        if self._seen_set is None:
+            self._seen_set = {(int(h), int(l)) for h, l in self._seen_ids}
+        return self._seen_set
+
     def is_identifier_seen(self, id_high: int, id_low: int) -> bool:
-        return (id_high, id_low) in self._seen_set
+        return (id_high, id_low) in self._seen_identifier_set()
 
     @property
     def identifiers_seen(self) -> Set[Tuple[int, int]]:
         """The append-only identifier history, as (high, low) values."""
-        return set(self._seen_set)
+        return set(self._seen_identifier_set())
 
     @property
     def pending_joiners(self) -> Set[int]:
@@ -541,7 +552,9 @@ class Simulator:
             node = int(node)
             assert not self.active[node], f"node {node} already a member"
             nid = (int(self.cluster.id_high[node]), int(self.cluster.id_low[node]))
-            assert nid not in self._seen_set, f"identifier reuse at {node}"
+            assert nid not in self._seen_identifier_set(), (
+                f"identifier reuse at {node}"
+            )
             self._pending_joiners.add(node)
         self._join_reports_armed = False
 
@@ -898,7 +911,8 @@ class Simulator:
                 [self.cluster.id_high[added], self.cluster.id_low[added]], axis=1
             )
             self._seen_ids = np.concatenate([self._seen_ids, new_ids])
-            self._seen_set.update((int(h), int(l)) for h, l in new_ids)
+            if self._seen_set is not None:
+                self._seen_set.update((int(h), int(l)) for h, l in new_ids)
             if self._seen_hashes is not None:
                 high_h, low_h, _, _ = self.cluster.node_hashes()
                 self._seen_hashes = np.concatenate(
@@ -985,17 +999,17 @@ class Simulator:
         computed from the values themselves (slot-independent) and maintained
         incrementally at admissions."""
         if self._seen_hashes is None or len(self._seen_hashes) != len(self._seen_ids):
-            from ..hashing import xxh64_batch
+            from ..hashing import xxh64_batch_auto
             from .topology import _int64_le_bytes
 
             m = len(self._seen_ids)
             eight = np.full(m, 8, dtype=np.int64)
             self._seen_hashes = np.stack(
                 [
-                    xxh64_batch(
+                    xxh64_batch_auto(
                         _int64_le_bytes(self._seen_ids[:, 0]), eight, 0
                     ),
-                    xxh64_batch(
+                    xxh64_batch_auto(
                         _int64_le_bytes(self._seen_ids[:, 1]), eight, 0
                     ),
                 ],
@@ -1106,7 +1120,7 @@ class Simulator:
                     axis=1,
                 )
             sim._seen_ids = seen.copy()
-            sim._seen_set = {(int(h), int(l)) for h, l in sim._seen_ids}
+            sim._seen_set = None  # rebuilt lazily from the restored history
             sim._seen_hashes = None
             sim.seed = seed
             sim.virtual_ms = int(data["virtual_ms"])

@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from ..hashing import endpoint_hash_batch, pack_hostnames, xxh64_batch
+from ..hashing import endpoint_hash_batch, xxh64_batch
 
 _U64 = np.uint64
 
@@ -96,23 +96,17 @@ class VirtualCluster:
         fold (MembershipView.java:535-547) hashes each element independently
         before folding, so per-node hashes are membership-invariant."""
         if self._node_hashes is None:
-            from .. import native
+            from ..hashing import xxh64_batch_auto
 
             n = self.capacity
             eight = np.full(n, 8, dtype=np.int64)
-            high_bytes = _int64_le_bytes(self.id_high)
-            low_bytes = _int64_le_bytes(self.id_low)
-            port_bytes = _port_le_bytes(self.ports)
-
-            def h(data, lengths):
-                out = native.xxh64_batch(data, lengths, 0)
-                return out if out is not None else xxh64_batch(data, lengths, 0)
-
             self._node_hashes = (
-                h(high_bytes, eight),
-                h(low_bytes, eight),
-                h(self.hostnames, self.host_lengths),
-                h(port_bytes, np.full(n, 4, dtype=np.int64)),
+                xxh64_batch_auto(_int64_le_bytes(self.id_high), eight),
+                xxh64_batch_auto(_int64_le_bytes(self.id_low), eight),
+                xxh64_batch_auto(self.hostnames, self.host_lengths),
+                xxh64_batch_auto(
+                    _port_le_bytes(self.ports), np.full(n, 4, dtype=np.int64)
+                ),
             )
         return self._node_hashes
 
@@ -159,11 +153,19 @@ class VirtualCluster:
         """Synthetic but *realistic* identities: distinct host:port strings and
         UUID-style node ids, hashed exactly as the JVM would."""
         rng = np.random.default_rng(seed)
-        hostnames = [
-            f"10.{i >> 16 & 0xFF}.{i >> 8 & 0xFF}.{i & 0xFF}".encode()
-            for i in range(capacity)
-        ]
-        data, lengths = pack_hostnames(hostnames)
+        # vectorized "10.a.b.c" construction (np.char.mod is a C-level
+        # sprintf; a Python f-string loop over 1M rows costs whole seconds):
+        # the <S14 bytes view is zero-padded exactly like pack_hostnames
+        idx = np.arange(capacity, dtype=np.int64)
+        octet = [np.char.mod("%d", (idx >> s) & 0xFF) for s in (16, 8, 0)]
+        dotted = np.char.add("10", np.char.add(".", octet[0]))
+        for part in octet[1:]:
+            dotted = np.char.add(dotted, np.char.add(".", part))
+        packed = dotted.astype("S")
+        lengths = np.char.str_len(packed).astype(np.int64)
+        data = np.ascontiguousarray(packed.view(np.uint8)).reshape(
+            capacity, packed.dtype.itemsize
+        )
         ports = np.full(capacity, 5000, dtype=np.int64) + (
             np.arange(capacity, dtype=np.int64) % 1000
         )
