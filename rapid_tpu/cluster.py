@@ -132,6 +132,7 @@ class ClusterBuilder:
         self._server: Optional[IMessagingServer] = None
         self._scheduler: Optional[Scheduler] = None
         self._rng: Optional[random.Random] = None
+        self._broadcaster_factory = None
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -170,6 +171,36 @@ class ClusterBuilder:
         shuffles, consensus jitter)."""
         self._rng = rng
         return self
+
+    def set_broadcaster_factory(self, factory) -> "ClusterBuilder":
+        """Swap the dissemination strategy: ``factory(client, rng)`` returns
+        the IBroadcaster this node's service uses (default:
+        UnicastToAllBroadcaster; e.g. messaging.gossip.GossipBroadcaster for
+        epidemic relay -- the alternative IBroadcaster.java:24-26 names)."""
+        self._broadcaster_factory = factory
+        return self
+
+    def _broadcaster(self, client: IMessagingClient, rng: random.Random):
+        if self._broadcaster_factory is None:
+            return None  # service defaults to UnicastToAllBroadcaster
+        broadcaster = self._broadcaster_factory(client, rng)
+        if getattr(broadcaster, "receive", None) is not None:
+            # gossip-style broadcasters wrap messages in GossipEnvelope,
+            # which the JVM-wire-compatible gRPC transport cannot carry
+            # (rapid.proto has no such message); best-effort sends would
+            # fail silently and the cluster would never converge, so refuse
+            # the pairing at build time
+            try:
+                from .messaging.grpc_transport import GrpcClient
+            except Exception:  # noqa: BLE001 -- grpc extra not installed
+                return broadcaster
+            if isinstance(client, GrpcClient):
+                raise JoinException(
+                    "gossip-style broadcasters need a native-codec transport "
+                    "(tcp / native-tcp / in-process); the gRPC wire has no "
+                    "GossipEnvelope message"
+                )
+        return broadcaster
 
     # ------------------------------------------------------------------ #
 
@@ -220,6 +251,7 @@ class ClusterBuilder:
             metadata_map=metadata_map,
             subscriptions=self._subscriptions,
             rng=rng,
+            broadcaster=self._broadcaster(client, rng),
         )
         server.set_membership_service(service)
         server.start()
@@ -339,6 +371,7 @@ class ClusterBuilder:
                 metadata_map=metadata_map,
                 subscriptions=self._subscriptions,
                 rng=rng,
+                broadcaster=self._broadcaster(client, rng),
             )
             server.set_membership_service(service)
             result.set_result(

@@ -35,6 +35,7 @@ _TYPES: Tuple[Type, ...] = (
     T.LeaveMessage,  # 12
     T.Response,  # 13
     T.ConsensusResponse,  # 14
+    T.GossipEnvelope,  # 15
 )
 _TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
 
@@ -54,7 +55,17 @@ def _enc(obj: Any) -> Any:
     if isinstance(obj, tuple):
         return [_enc(x) for x in obj]
     if isinstance(obj, T.AlertMessage):
+        # predates the generic "__msg" form; kept for wire stability of
+        # BatchedAlertMessage frames across versions
         return {"__al": {k: _enc(v) for k, v in _fields_of(obj).items()}}
+    if type(obj) in _TAG_OF:
+        # a message carried as a field value (e.g. a GossipEnvelope payload)
+        return {
+            "__msg": [
+                _TAG_OF[type(obj)],
+                {k: _enc(v) for k, v in _fields_of(obj).items()},
+            ]
+        }
     if isinstance(obj, dict):
         return {k: _enc(v) for k, v in obj.items()}
     return obj
@@ -78,6 +89,11 @@ def _dec(obj: Any) -> Any:
             return _ENUMS[name](value)
         if "__al" in obj:
             return T.AlertMessage(**{k: _tupled(_dec(v)) for k, v in obj["__al"].items()})
+        if "__msg" in obj:
+            tag, fields = obj["__msg"]
+            return _TYPES[tag](
+                **{k: _tupled(_dec(v)) for k, v in fields.items()}
+            )
         return {k: _dec(v) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_dec(x) for x in obj]

@@ -37,6 +37,7 @@ from .types import (
     CONSENSUS_MESSAGE_TYPES,
     EdgeStatus,
     Endpoint,
+    GossipEnvelope,
     JoinMessage,
     JoinResponse,
     JoinStatusCode,
@@ -146,7 +147,32 @@ class MembershipService:
                 msg.sender, self._view.get_current_configuration_id()
             )
             return Promise.completed(Response())
+        if isinstance(msg, GossipEnvelope):
+            return self._handle_gossip(msg)
         raise TypeError(f"unidentified request type {type(msg).__name__}")
+
+    def _handle_gossip(self, env: GossipEnvelope) -> Promise:
+        """Epidemic relay plane: hand the envelope to a gossip-aware
+        broadcaster (dedup + re-relay), then dispatch a first-seen payload
+        like any directly-received message. Nodes running a non-gossip
+        broadcaster acknowledge and drop -- mixed clusters degrade to the
+        origin's direct fanout. Serialized on the protocol executor like
+        every other substantive handler: the broadcaster's sighting counter
+        and rng are not thread-safe, and transport threads deliver
+        concurrently."""
+        receive = getattr(self._broadcaster, "receive", None)
+        if receive is None:
+            return Promise.completed(Response())
+        future: Promise = Promise()
+
+        def task() -> None:
+            payload = receive(env)
+            if payload is not None:
+                self.handle_message(payload)
+            future.set_result(Response())
+
+        self._resources.protocol_executor.execute(task)
+        return future
 
     # ------------------------------------------------------------------ #
     # Join protocol, server side

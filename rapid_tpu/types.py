@@ -241,6 +241,23 @@ class ConsensusResponse:
     """Empty consensus acknowledgement (rapid.proto:146-147)."""
 
 
+@dataclass(frozen=True)
+class GossipEnvelope:
+    """Epidemic-relay wrapper around any protocol message.
+
+    The gossip dissemination alternative the reference's broadcaster seam
+    explicitly anticipates but never implements (IBroadcaster.java:24-26).
+    ``gossip_id`` dedups relays cluster-wide; ``ttl`` bounds propagation
+    depth. Carried by the native codec transports (tcp / in-process /
+    native-tcp); the JVM-wire-compatible gRPC transport cannot carry it
+    (rapid.proto has no such message)."""
+
+    sender: "Endpoint"
+    gossip_id: NodeId
+    ttl: int
+    payload: object  # any RapidMessage
+
+
 # Any protocol request/response, for type annotations.
 RapidMessage = object
 

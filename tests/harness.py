@@ -36,6 +36,8 @@ class ClusterHarness:
         self.use_static_fd = use_static_fd
         self.instances: Dict[Endpoint, Cluster] = {}
         self.servers: Dict[Endpoint, InProcessServer] = {}
+        # optional dissemination swap: factory(client, rng) -> IBroadcaster
+        self.broadcaster_factory = None
 
     def addr(self, i: int) -> Endpoint:
         return Endpoint.from_parts("127.0.0.1", BASE_PORT + i)
@@ -55,6 +57,8 @@ class ClusterHarness:
             .use_settings(self.settings)
             .use_rng(random.Random(self.rng.getrandbits(64)))
         )
+        if self.broadcaster_factory is not None:
+            builder.set_broadcaster_factory(self.broadcaster_factory)
         if fd is not None:
             builder.set_edge_failure_detector_factory(fd)
         elif self.use_static_fd:

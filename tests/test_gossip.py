@@ -1,0 +1,138 @@
+"""Gossip (epidemic) broadcaster: the IBroadcaster alternative the
+reference names but never ships (IBroadcaster.java:24-26). Unit semantics
+(dedup, TTL, fanout) plus full protocol convergence -- alert batches and
+consensus votes riding epidemic relay instead of unicast-to-all."""
+
+import random
+
+from harness import ClusterHarness
+from rapid_tpu import Endpoint
+from rapid_tpu.messaging import codec
+from rapid_tpu.messaging.gossip import GossipBroadcaster
+from rapid_tpu.types import GossipEnvelope, NodeId, ProbeMessage
+
+
+class RecordingClient:
+    def __init__(self):
+        self.sent = []
+        self.address = Endpoint.from_parts("127.0.0.1", 9)
+
+    def send_message_best_effort(self, remote, msg):
+        self.sent.append((remote, msg))
+
+    send_message = send_message_best_effort
+
+
+def members(n):
+    return [Endpoint.from_parts("127.0.0.1", 1000 + i) for i in range(n)]
+
+
+def test_broadcast_sends_to_self_plus_fanout():
+    client = RecordingClient()
+    me = Endpoint.from_parts("127.0.0.1", 1000)
+    g = GossipBroadcaster(client, me, fanout=3, rng=random.Random(1))
+    g.set_membership(members(20))
+    g.broadcast(ProbeMessage(sender=me))
+    targets = [t for t, _ in client.sent]
+    assert targets[0] == me  # self-delivery through the transport
+    assert len(targets) == 4 and len(set(targets)) == 4
+    assert all(isinstance(m, GossipEnvelope) for _, m in client.sent)
+    # TTL ~ log2(20) + 2
+    assert client.sent[0][1].ttl == 7
+
+
+def test_receive_dedups_and_relays_with_decremented_ttl():
+    client = RecordingClient()
+    me = Endpoint.from_parts("127.0.0.1", 1001)
+    g = GossipBroadcaster(client, me, fanout=2, rng=random.Random(2))
+    g.set_membership(members(10))
+    env = GossipEnvelope(
+        sender=members(10)[5], gossip_id=NodeId(7, 8), ttl=3,
+        payload=ProbeMessage(sender=members(10)[5]),
+    )
+    payload = g.receive(env)
+    assert isinstance(payload, ProbeMessage)
+    assert len(client.sent) == 2
+    assert all(m.ttl == 2 and m.gossip_id == NodeId(7, 8) for _, m in client.sent)
+    assert all(t != me for t, _ in client.sent)  # no self-relay
+    # second sighting: payload NOT re-delivered, but still relayed (blind
+    # counter, relay_budget=2)
+    assert g.receive(env) is None
+    assert len(client.sent) == 4
+    # third sighting: budget exhausted, no relay
+    assert g.receive(env) is None
+    assert len(client.sent) == 4
+
+
+def test_receive_ttl_zero_delivers_without_relay():
+    client = RecordingClient()
+    me = Endpoint.from_parts("127.0.0.1", 1002)
+    g = GossipBroadcaster(client, me, fanout=2, rng=random.Random(3))
+    g.set_membership(members(10))
+    env = GossipEnvelope(
+        sender=members(10)[3], gossip_id=NodeId(1, 2), ttl=0,
+        payload=ProbeMessage(sender=members(10)[3]),
+    )
+    assert isinstance(g.receive(env), ProbeMessage)
+    assert client.sent == []
+
+
+def test_envelope_codec_roundtrip():
+    """GossipEnvelope crosses the framed wire with its nested payload."""
+    env = GossipEnvelope(
+        sender=Endpoint.from_parts("10.0.0.1", 5001),
+        gossip_id=NodeId(-3, 99),
+        ttl=5,
+        payload=ProbeMessage(sender=Endpoint.from_parts("10.0.0.2", 5002)),
+    )
+    request_no, decoded = codec.decode(codec.encode(42, env))
+    assert request_no == 42
+    assert decoded == env
+
+
+def _gossip_factory(client, rng):
+    return GossipBroadcaster(client, client.address, fanout=4, rng=rng)
+
+
+def test_cluster_converges_on_gossip_broadcaster():
+    """Full protocol over epidemic dissemination: 16 nodes join, two crash,
+    the cut decides, and every instance converges to the same view."""
+    h = ClusterHarness(seed=77)
+    h.broadcaster_factory = _gossip_factory
+    h.create_cluster(16, parallel=False)
+    h.wait_and_verify_agreement(16)
+    victims = [h.addr(6), h.addr(11)]
+    h.fail_nodes(victims)
+    h.wait_and_verify_agreement(14)
+    configs = {
+        c.get_current_configuration_id() for c in h.instances.values()
+    }
+    assert len(configs) == 1
+
+
+def test_gossip_join_wave_converges():
+    """Parallel joins through one seed with gossip dissemination."""
+    h = ClusterHarness(seed=78)
+    h.broadcaster_factory = _gossip_factory
+    h.create_cluster(12, parallel=True)
+    h.wait_and_verify_agreement(12)
+
+
+def test_gossip_refused_on_jvm_wire_transport():
+    """Build-time rejection of the gossip + gRPC pairing: the JVM wire has
+    no GossipEnvelope, so best-effort dissemination would fail silently."""
+    import pytest
+
+    pytest.importorskip("grpc")
+    from rapid_tpu.cluster import ClusterBuilder, JoinException
+    from rapid_tpu.messaging.grpc_transport import GrpcClient, GrpcServer
+
+    addr = Endpoint.from_parts("127.0.0.1", 45991)
+    client, server = GrpcClient(addr), GrpcServer(addr)
+    builder = (
+        ClusterBuilder(addr)
+        .set_messaging_client_and_server(client, server)
+        .set_broadcaster_factory(_gossip_factory)
+    )
+    with pytest.raises(JoinException, match="native-codec transport"):
+        builder.start()
