@@ -21,3 +21,20 @@ def test_scaling_sweep_point():
     out = scaling_size(300, seed=7)
     assert out["cut_ok"]
     assert out["virtual_ms"] == 11 * 1000 + 100
+
+
+def test_message_load_strategies_agree_on_protocol_work():
+    from experiments.message_load import run_strategy
+
+    uni = run_strategy("unicast", n=16, crash=1, seed=5)
+    gos = run_strategy("gossip", n=16, crash=1, seed=5)
+    # the dissemination fabric must not change the protocol work performed
+    assert uni["per_type_totals"]["BatchedAlertMessage"] == \
+        gos["per_type_totals"]["BatchedAlertMessage"]
+    assert uni["per_type_totals"]["FastRoundPhase2bMessage"] == \
+        gos["per_type_totals"]["FastRoundPhase2bMessage"]
+    # unicast delivers each broadcast exactly once per process; gossip pays
+    # the epidemic redundancy on top
+    assert "GossipEnvelope" not in uni["per_type_totals"]
+    assert gos["per_type_totals"]["GossipEnvelope"] > 0
+    assert gos["mean_msgs"] > uni["mean_msgs"]
