@@ -50,12 +50,19 @@ def run_strategy(strategy: str, n: int, crash: int, seed: int) -> dict:
         h.broadcaster_factory = lambda client, rng: GossipBroadcaster(
             client, client.address, fanout=4, rng=rng
         )
+    try:
+        return _measure(h, strategy, n, crash)
+    finally:
+        h.shutdown()
+
+
+def _measure(h, strategy: str, n: int, crash: int) -> dict:
     h.create_cluster(n, parallel=False)
     h.wait_and_verify_agreement(n)
     # zero the counters after bootstrap so the measurement is the crash
     # experiment itself, like the paper's steady-state window
     for inst in h.instances.values():
-        inst._membership_service.metrics._counters.clear()  # noqa: SLF001
+        inst._membership_service.metrics.reset()  # noqa: SLF001
     victims = [h.addr(i) for i in range(2, 2 + crash)]
     h.fail_nodes(victims)
     h.wait_and_verify_agreement(n - crash)
