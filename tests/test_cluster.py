@@ -229,3 +229,18 @@ def test_hundred_node_parallel_join_and_crash(harness):
     harness.wait_and_verify_agreement(88)
     for cluster in harness.instances.values():
         assert not set(cluster.get_memberlist()) & set(failing)
+
+
+def test_crash_beyond_fast_paxos_quorum(harness):
+    """ClusterTest.java:276-315's 16/50 case: with 32% of members crashed,
+    the 34 survivors cannot reach the fast-round supermajority
+    (50 - (49//4) = 38), so convergence MUST ride the classic Paxos
+    fallback (majority 26 <= 34) -- no message interference needed."""
+    harness.create_cluster(50, parallel=True)
+    harness.wait_and_verify_agreement(50)
+    failing = [harness.addr(i) for i in range(34, 50)]
+    harness.fail_nodes(failing)
+    # classic rounds start after the expovariate fallback delay (mean N s)
+    harness.wait_and_verify_agreement(34, timeout_ms=1_200_000)
+    for cluster in harness.instances.values():
+        assert not set(cluster.get_memberlist()) & set(failing)

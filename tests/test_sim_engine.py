@@ -495,3 +495,25 @@ def test_speculation_discarded_when_prediction_wrong():
         np.testing.assert_array_equal(a.cut, b.cut)
         assert a.configuration_id == b.configuration_id
         assert a.virtual_time_ms == b.virtual_time_ms
+
+
+def test_sim_crash_beyond_fast_quorum_decides_via_classic():
+    """The 16/50 boundary on the sim plane: survivors (34) < fast quorum
+    (38), so the device tally can never decide; the host's classic recovery
+    (majority 26) must -- and the resulting configuration id must match the
+    object model's."""
+    sim = Simulator(50, seed=44)
+    victims = np.arange(34, 50)
+    sim.crash(victims)
+    rec = sim.run_until_decision(
+        max_rounds=64, batch=8, classic_fallback_after_rounds=8
+    )
+    assert rec is not None and rec.via_classic_round
+    assert set(rec.cut) == set(int(v) for v in victims)
+    # identifiersSeen covers everyone ever admitted: build the full view,
+    # then delete the cut (MembershipView.java:51)
+    view = view_of(sim.cluster, range(50))
+    eps = endpoints_of(sim.cluster)
+    for v in victims:
+        view.ring_delete(eps[v])
+    assert rec.configuration_id == view.get_current_configuration_id()
