@@ -49,6 +49,12 @@ def main() -> None:
         "the C++ epoll server half (native/rapid_io.cpp); grpc = "
         "wire-compatible with JVM Rapid",
     )
+    parser.add_argument(
+        "--broadcaster", choices=("unicast", "gossip"), default="unicast",
+        help="unicast = reference-parity unicast-to-all; gossip = epidemic "
+        "relay (needs a native-codec transport, not grpc)",
+    )
+    parser.add_argument("--gossip-fanout", type=int, default=4)
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
 
@@ -108,6 +114,16 @@ def main() -> None:
         .add_subscription(ClusterEvents.VIEW_CHANGE, on_event("VIEW_CHANGE"))
         .add_subscription(ClusterEvents.KICKED, on_event("KICKED"))
     )
+    if args.broadcaster == "gossip":
+        if args.gossip_fanout < 1:
+            parser.error("--gossip-fanout must be >= 1")
+        from rapid_tpu.messaging.gossip import GossipBroadcaster
+
+        builder.set_broadcaster_factory(
+            lambda c, rng: GossipBroadcaster(
+                c, listen, fanout=args.gossip_fanout, rng=rng
+            )
+        )
     if args.seed_address:
         cluster = builder.join(Endpoint.from_string(args.seed_address))
     else:

@@ -27,7 +27,8 @@ class AgentRunner:
         self.procs = []
 
     def run_node(self, listen: str, seed: str = None, fd_interval_ms: int = 100,
-                 gateway: str = None, transport: str = None):
+                 gateway: str = None, transport: str = None,
+                 broadcaster: str = None):
         log_path = self.tmpdir / f"agent-{listen.replace(':', '-')}.log"
         cmd = [sys.executable, str(AGENT), "--listen-address", listen,
                "--fd-interval-ms", str(fd_interval_ms)]
@@ -37,6 +38,8 @@ class AgentRunner:
             cmd += ["--gateway-address", gateway]
         if transport:
             cmd += ["--transport", transport]
+        if broadcaster:
+            cmd += ["--broadcaster", broadcaster]
         log = open(log_path, "w")
         env = dict(os.environ, PYTHONUNBUFFERED="1")
         proc = subprocess.Popen(
@@ -299,5 +302,34 @@ def test_three_agents_converge_over_native_tcp(runner):
     victim_proc.send_signal(signal.SIGKILL)
     victim_proc.wait(timeout=10)
     assert wait_for_size(logs[:-1], 2, timeout_s=120), seed_log.read_text()[-2000:]
+    configs = {last_status(p)[1] for p in logs[:-1]}
+    assert len(configs) == 1
+
+
+@pytest.mark.slow
+def test_five_agents_converge_over_gossip(runner):
+    """Tier-3 with epidemic dissemination: real OS processes over TCP with
+    --broadcaster gossip converge on joins and on a SIGKILL cut -- alert
+    batches and consensus votes riding gossip relay over real sockets."""
+    base = random.randint(30000, 39000)
+    seed_addr = f"127.0.0.1:{base}"
+    _, seed_log = runner.run_node(seed_addr, fd_interval_ms=200,
+                                  broadcaster="gossip")
+    assert wait_for_membership(seed_log, 1, 30), seed_log.read_text()[-2000:]
+    logs = [seed_log]
+    for i in range(1, 5):
+        _, log = runner.run_node(f"127.0.0.1:{base + i}", seed=seed_addr,
+                                 fd_interval_ms=200, broadcaster="gossip")
+        logs.append(log)
+    assert wait_for_size(logs, 5, timeout_s=120), \
+        "\n".join(p.read_text()[-500:] for p in logs)
+    configs = {last_status(p)[1] for p in logs}
+    assert len(configs) == 1
+
+    victim_proc, _ = runner.procs[-1]
+    victim_proc.send_signal(signal.SIGKILL)
+    victim_proc.wait(timeout=10)
+    assert wait_for_size(logs[:-1], 4, timeout_s=120), \
+        "\n".join(p.read_text()[-500:] for p in logs[:-1])
     configs = {last_status(p)[1] for p in logs[:-1]}
     assert len(configs) == 1
