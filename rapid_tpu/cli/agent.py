@@ -87,6 +87,11 @@ def main() -> None:
     else:
         client = server = TcpClientServer(listen, settings)
     if args.gateway_address:
+        if args.broadcaster == "gossip":
+            parser.error(
+                "--broadcaster gossip cannot ride a gateway (the swarm has "
+                "no gossip relay); gateway mode uses the swarm broadcaster"
+            )
         from rapid_tpu.messaging.gateway import (
             DEFAULT_DIRECT_HOSTS,
             GatewayRoutedClient,
@@ -123,6 +128,14 @@ def main() -> None:
             lambda c, rng: GossipBroadcaster(
                 c, listen, fanout=args.gossip_fanout, rng=rng
             )
+        )
+    elif args.gateway_address:
+        # swarm-bound broadcast fan-out collapses to one wildcard frame;
+        # unicast-to-all through one socket does not scale to large swarms
+        from rapid_tpu.messaging.gateway import GatewaySwarmBroadcaster
+
+        builder.set_broadcaster_factory(
+            lambda c, rng, routed=client: GatewaySwarmBroadcaster(routed)
         )
     if args.seed_address:
         cluster = builder.join(Endpoint.from_string(args.seed_address))

@@ -74,6 +74,8 @@ def main() -> None:
             native_server=args.native_server,
         )
     gateway.start()
+    log.info("warming the swarm engine (first jit compile)...")
+    gateway.warm()
     seed_ep = gateway.seed_endpoint()
     log.info(
         "gateway up at %s hosting %d members (%s); seed endpoint %s",

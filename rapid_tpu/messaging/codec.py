@@ -36,6 +36,7 @@ _TYPES: Tuple[Type, ...] = (
     T.Response,  # 13
     T.ConsensusResponse,  # 14
     T.GossipEnvelope,  # 15
+    T.FastRoundVoteBatch,  # 16
 )
 _TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
 

@@ -45,7 +45,7 @@ from ..runtime.futures import Promise
 from ..runtime.scheduler import RealScheduler
 from ..settings import Settings
 from ..types import Endpoint, RapidMessage
-from .base import IMessagingClient
+from .base import IBroadcaster, IMessagingClient
 from .codec import ENVELOPE, decode, encode
 from .retries import call_with_retries
 from .tcp import (
@@ -158,6 +158,48 @@ class GatewayRoutedClient(IMessagingClient):
                 self._conn = None
 
 
+# wildcard destination: one routed frame that the gateway ingests once on
+# behalf of every virtual member (see GatewaySwarmBroadcaster)
+SWARM_BROADCAST = Endpoint(b"*", 0)
+
+
+class GatewaySwarmBroadcaster(IBroadcaster):
+    """Broadcaster for members running behind a gateway.
+
+    Unicast-to-all through a gateway is pathological at swarm scale: a
+    broadcast to N members becomes N identical frames ground through ONE
+    socket (at 10k virtual nodes a single vote broadcast takes tens of
+    seconds and floods the gateway's protocol queue). But every
+    swarm-bound copy is redundant -- the bridge ingests alert batches and
+    votes once per sender and the device delivers them to every virtual
+    member as array work -- so this broadcaster collapses them into ONE
+    wildcard frame (``SWARM_BROADCAST``; TpuSimMessaging.handle_broadcast),
+    while direct (real-member) recipients keep the reference's
+    per-recipient best-effort unicast."""
+
+    def __init__(self, routed: "GatewayRoutedClient") -> None:
+        self._routed = routed
+        self._direct_recipients: List[Endpoint] = []
+        self._any_swarm = False
+
+    def set_membership(self, recipients: List[Endpoint]) -> None:
+        self._direct_recipients = [
+            r for r in recipients if self._routed._is_direct(r)  # noqa: SLF001
+        ]
+        self._any_swarm = len(self._direct_recipients) < len(recipients)
+
+    def broadcast(self, msg: RapidMessage) -> List[Promise]:
+        promises = [
+            self._routed.send_message_best_effort(r, msg)
+            for r in self._direct_recipients
+        ]
+        if self._any_swarm:
+            promises.append(
+                self._routed._send_routed_once(SWARM_BROADCAST, msg)  # noqa: SLF001
+            )
+        return promises
+
+
 class _GatewayScheduler(RealScheduler):
     """RealScheduler plus ``run_for``: the bridge's clock advance drains the
     gateway's protocol queue for the window, so inbound votes are processed
@@ -180,11 +222,16 @@ class _GatewayNetwork:
     PROBE_TIMEOUT_S = 0.25
     PROBE_CACHE_S = 1.0
 
+    # ambiguous dial failures (timeouts under load) tolerated before a
+    # member is reported gone; a refused connection is definitive death
+    DIAL_TIMEOUTS_TO_FAIL = 3
+
     def __init__(self, out_client: TcpClientServer, scheduler: RealScheduler) -> None:
         self.scheduler = scheduler
         self._out = out_client
         self._handlers: List[object] = []
         self._probe_ok: Dict[Endpoint, float] = {}
+        self._dial_timeouts: Dict[Endpoint, int] = {}
         # one delivery worker: sends (whose connect can block for the full
         # message timeout on an unreachable member) run OFF the protocol
         # thread, so probes/joins from healthy agents are never queued behind
@@ -211,8 +258,24 @@ class _GatewayNetwork:
             )
             probe.close()
             self._probe_ok[address] = now
+            self._dial_timeouts.pop(address, None)
             return True
+        except ConnectionRefusedError:
+            # the port actively refused: the process is gone -- definitive
+            self._probe_ok.pop(address, None)
+            self._dial_timeouts.pop(address, None)
+            return False
         except OSError:
+            # timeout or transient error: a loaded host can miss a dial
+            # without being dead, and declaring a live member gone starts a
+            # cut/rejoin cascade -- tolerate consecutive ambiguous misses
+            misses = self._dial_timeouts.get(address, 0) + 1
+            self._dial_timeouts[address] = misses
+            if misses < self.DIAL_TIMEOUTS_TO_FAIL:
+                return True
+            # declared gone: reset the budget so a rejoin at this address
+            # gets the full tolerance again
+            self._dial_timeouts.pop(address, None)
             self._probe_ok.pop(address, None)
             return False
 
@@ -361,6 +424,37 @@ class SwarmGateway:
         if error:
             raise error[0]
 
+    def warm(self, timeout: float = 600.0) -> None:
+        """Compile-warm the swarm engine (one no-fault decision probe on the
+        protocol thread). Call between start() and advertising the seed:
+        at large capacities the first jit compile can exceed a joining
+        agent's retry budget, so agents should find a warmed swarm."""
+        done = threading.Event()
+        error: list = []
+
+        def task() -> None:
+            try:
+                # compile BOTH decision executables: the plain one and the
+                # announcement-stop variant the pump's phase A uses once a
+                # real member exists (a different static jit arg -- leaving
+                # it cold would recompile on the second join, the exact
+                # retry-budget blowout this warm-up prevents)
+                self.bridge.sim.run_until_decision(max_rounds=1, batch=1)
+                self.bridge.sim.run_until_decision(
+                    max_rounds=1, batch=1, stop_when_announced=True
+                )
+                self.bridge.sim.ready()
+            except Exception as e:  # noqa: BLE001
+                error.append(e)
+            finally:
+                done.set()
+
+        self._tasks.put(task)
+        if not done.wait(timeout):
+            raise TimeoutError("gateway warm-up did not complete")
+        if error:
+            raise error[0]
+
     def start(self) -> None:
         self._running = True
         threads = [
@@ -500,6 +594,16 @@ class SwarmGateway:
         dst: Endpoint,
         msg: RapidMessage,
     ) -> None:
+        if dst == SWARM_BROADCAST:
+            # one frame standing for a broadcast to every virtual member
+            # (GatewaySwarmBroadcaster); ingested exactly once
+            try:
+                promise = self.bridge.handle_broadcast(msg)
+            except Exception:  # noqa: BLE001
+                LOG.exception("handle_broadcast failed")
+                return
+            self._attach_reply(reply_send, request_no, promise)
+            return
         if not self.bridge.owns(dst):
             # a real member's address, or an unknown endpoint: there is no
             # virtual node here; the sender's deadline handles it. Warn once
@@ -519,7 +623,10 @@ class SwarmGateway:
         except Exception:  # noqa: BLE001
             LOG.exception("bridge.handle failed for %s", dst)
             return
+        self._attach_reply(reply_send, request_no, promise)
 
+    @staticmethod
+    def _attach_reply(reply_send, request_no: int, promise: Promise) -> None:
         def reply(p: Promise) -> None:
             if p.exception() is not None:
                 return  # no response; the sender's deadline expires

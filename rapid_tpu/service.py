@@ -35,8 +35,11 @@ from .types import (
     AlertMessage,
     BatchedAlertMessage,
     CONSENSUS_MESSAGE_TYPES,
+    ConsensusResponse,
     EdgeStatus,
     Endpoint,
+    FastRoundPhase2bMessage,
+    FastRoundVoteBatch,
     GossipEnvelope,
     JoinMessage,
     JoinResponse,
@@ -142,6 +145,8 @@ class MembershipService:
             return Promise.completed(ProbeResponse())
         if isinstance(msg, CONSENSUS_MESSAGE_TYPES):
             return self._handle_consensus(msg)
+        if isinstance(msg, FastRoundVoteBatch):
+            return self._handle_vote_batch(msg)
         if isinstance(msg, LeaveMessage):
             self._edge_failure_notification(
                 msg.sender, self._view.get_current_configuration_id()
@@ -316,6 +321,26 @@ class MembershipService:
         self._resources.protocol_executor.execute(
             lambda: future.set_result(self._fast_paxos.handle_messages(msg))
         )
+        return future
+
+    def _handle_vote_batch(self, batch: FastRoundVoteBatch) -> Promise:
+        """Unpack a transport-batched quorum of identical-value votes into
+        the per-sender tally, in ONE protocol task (posting thousands of
+        single-vote tasks would serialize through the executor queue)."""
+        future: Promise = Promise()
+
+        def task() -> None:
+            for sender in batch.senders:
+                self._fast_paxos.handle_messages(
+                    FastRoundPhase2bMessage(
+                        sender=sender,
+                        configuration_id=batch.configuration_id,
+                        endpoints=batch.endpoints,
+                    )
+                )
+            future.set_result(ConsensusResponse())
+
+        self._resources.protocol_executor.execute(task)
         return future
 
     # ------------------------------------------------------------------ #

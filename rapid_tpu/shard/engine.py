@@ -305,7 +305,8 @@ def make_sharded_run(
 
 
 def make_sharded_run_until(
-    config: SimConfig, mesh: Mesh, random_loss: bool = True
+    config: SimConfig, mesh: Mesh, random_loss: bool = True,
+    stop_when_announced: bool = False,
 ):
     """One-dispatch mesh decision loop: a while_loop of shard_map'd rounds.
 
@@ -326,7 +327,13 @@ def make_sharded_run_until(
     ) -> SimState:
         def cond(carry):
             st, r = carry
-            return (r < max_rounds) & ~st.decided
+            keep = (r < max_rounds) & ~st.decided
+            if stop_when_announced:
+                # pause at the announcement round (bridge phase A); the
+                # announced latch is replicated, so the trip count stays
+                # uniform across shards
+                keep &= ~jnp.any(st.announced[: config.groups])
+            return keep
 
         def body(carry):
             st, r = carry

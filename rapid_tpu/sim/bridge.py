@@ -68,6 +68,7 @@ from ..types import (
     EdgeStatus,
     Endpoint,
     FastRoundPhase2bMessage,
+    FastRoundVoteBatch,
     JoinMessage,
     JoinResponse,
     JoinStatusCode,
@@ -160,6 +161,10 @@ class TpuSimMessaging:
         self._last_decision: Optional[tuple] = None
         self._replay_counts: Dict[Endpoint, int] = {}
         self._prior_configs: Deque[int] = deque(maxlen=8)
+        # stale-cut tolerance: repeated sightings of one stale config before
+        # a member is declared beyond repair (a single occurrence can be an
+        # in-flight frame racing a pair of quick decisions)
+        self._stale_counts: Dict[Tuple[Endpoint, int], int] = {}
 
     # ------------------------------------------------------------------ #
     # checkpoint / resume (SURVEY.md section 5.4, extended to the bridge)
@@ -240,6 +245,7 @@ class TpuSimMessaging:
         bridge._informed_config = None
         bridge._last_decision = None
         bridge._replay_counts = {}
+        bridge._stale_counts = {}
         bridge._prior_configs = deque(maxlen=8)
         return bridge
 
@@ -275,6 +281,9 @@ class TpuSimMessaging:
         return address in self._slot_of and address not in self._real
 
     def handle(self, dst: Endpoint, msg: RapidMessage) -> Promise:
+        broadcastable = self._handle_broadcastable(msg)
+        if broadcastable is not None:
+            return broadcastable
         slot = self._slot_of[dst]
         if isinstance(msg, ProbeMessage):
             if self.sim.active[slot] and self.sim.alive[slot]:
@@ -284,6 +293,24 @@ class TpuSimMessaging:
             return Promise.completed(self._handle_pre_join(dst, msg))
         if isinstance(msg, JoinMessage):
             return self._handle_join(dst, msg)
+        return _failed(TypeError(f"unexpected message {type(msg).__name__}"))
+
+    def handle_broadcast(self, msg: RapidMessage) -> Promise:
+        """A real member's broadcast collapsed to one frame (the gateway's
+        wildcard destination): ingest the dst-independent traffic exactly
+        once. Semantically identical to the N unicast copies -- alert
+        batches and votes are absorbed per *sender* (the device delivers
+        them to every virtual member as array work), so the copies were
+        redundant. Unicast-only messages (probes, joins) are refused."""
+        broadcastable = self._handle_broadcastable(msg)
+        if broadcastable is not None:
+            return broadcastable
+        return _failed(
+            TypeError(f"{type(msg).__name__} cannot be swarm-broadcast")
+        )
+
+    def _handle_broadcastable(self, msg: RapidMessage) -> Optional[Promise]:
+        """The destination-independent message types (None = not one)."""
         if isinstance(msg, BatchedAlertMessage):
             if msg.messages:
                 self._maybe_catch_up(
@@ -311,7 +338,7 @@ class TpuSimMessaging:
             ):
                 self.sim.leave(np.array([sender_slot]))
             return Promise.completed(Response())
-        return _failed(TypeError(f"unexpected message {type(msg).__name__}"))
+        return None
 
     # ------------------------------------------------------------------ #
     # join protocol (swarm side)
@@ -449,6 +476,7 @@ class TpuSimMessaging:
         self.sim.register_extern_vote(sender_slot, np.array(cut_slots))
 
     _MAX_REPLAYS = 3
+    _STALE_STRIKES_TO_CUT = 3  # repeated sightings of one stale config
 
     def _maybe_catch_up(self, sender: Endpoint, config_id: int) -> None:
         """Keep lagging members from being stranded. A member stuck exactly
@@ -474,17 +502,23 @@ class TpuSimMessaging:
                 config_before, sender, count + 1,
             )
             self._deliver(voters[0], sender, BatchedAlertMessage(voters[0], alerts))
-            for voter in voters:
-                self._deliver(
-                    voter,
-                    sender,
-                    FastRoundPhase2bMessage(
-                        sender=voter,
-                        configuration_id=config_before,
-                        endpoints=tuple(cut_eps),
-                    ),
-                )
+            self._deliver(
+                voters[0],
+                sender,
+                FastRoundVoteBatch(
+                    senders=tuple(voters),
+                    configuration_id=config_before,
+                    endpoints=tuple(cut_eps),
+                ),
+            )
         elif config_id in self._prior_configs:
+            # a single old-config frame can be an in-flight race against two
+            # quick decisions (a join wave); only REPEATED sightings of the
+            # same stale configuration mean the member is truly stranded
+            strikes = self._stale_counts.get((sender, config_id), 0) + 1
+            self._stale_counts[(sender, config_id)] = strikes
+            if strikes < self._STALE_STRIKES_TO_CUT:
+                return
             slot = self._real[sender]
             if self.sim.active[slot] and self.sim.alive[slot]:
                 LOG.warning(
@@ -556,10 +590,13 @@ class TpuSimMessaging:
         rec = None
         rounds_before = sim.metrics.get("rounds")
         if members_before and self._informed_config != config_before:
-            # phase A: run only to the announcement, so real members can vote.
-            # batch=1 so the announcement is observed the round it happens --
-            # with a wider batch, announcement and decision can land inside
-            # one dispatch and the pre-decision broadcast would be skipped
+            # phase A: run only to the announcement, so real members can
+            # vote. On the deterministic (const/mesh) planes the engine's
+            # while_loop pauses at the announcement round in ONE dispatch;
+            # batch=1 covers the scan path, where the announcement must be
+            # observed the round it happens (a wider scan batch could run
+            # announcement and decision inside one dispatch and skip the
+            # pre-decision broadcast)
             rec = sim.run_until_decision(
                 max_rounds=max_rounds, batch=1,
                 classic_fallback_after_rounds=classic_fallback_after_rounds,
@@ -626,20 +663,24 @@ class TpuSimMessaging:
                     len(voters),
                     quorum,
                 )
+            # one alert batch + ONE vote-batch frame per member: the quorum
+            # of identical-value votes (~3N/4 protocol messages) is
+            # transport-batched (FastRoundVoteBatch), or a 10k-member swarm
+            # would grind thousands of frames through the delivery worker
+            # per member per decision and members would fall behind
             for member in members_before:
                 self._deliver(
                     voters[0], member, BatchedAlertMessage(voters[0], alerts)
                 )
-                for voter in voters[:quorum]:
-                    self._deliver(
-                        voter,
-                        member,
-                        FastRoundPhase2bMessage(
-                            sender=voter,
-                            configuration_id=config_before,
-                            endpoints=tuple(cut_eps),
-                        ),
-                    )
+                self._deliver(
+                    voters[0],
+                    member,
+                    FastRoundVoteBatch(
+                        senders=tuple(voters[:quorum]),
+                        configuration_id=config_before,
+                        endpoints=tuple(cut_eps),
+                    ),
+                )
             # keep the packet: a member whose delivery was lost will keep
             # sending traffic stamped with config_before, and gets a replay
             self._last_decision = (
@@ -647,6 +688,16 @@ class TpuSimMessaging:
             )
             self._replay_counts = {}
             self._prior_configs.append(config_before)
+            # prune strikes whose config fell out of the stale window; keep
+            # live ones -- wiping wholesale would let a member stranded many
+            # configs behind linger forever under sustained churn (1-2
+            # sightings per epoch, reset each decision, never reaching the
+            # cut threshold)
+            self._stale_counts = {
+                key: strikes
+                for key, strikes in self._stale_counts.items()
+                if key[1] in self._prior_configs
+            }
         # unblock admitted joiners (respondToJoiners, MembershipService.java:708-733)
         for joiner in list(self._parked):
             slot = self._slot_of.get(joiner)

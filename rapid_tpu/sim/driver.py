@@ -140,6 +140,7 @@ class Simulator:
         self._init_device_caches()
         self.state = self._fresh_state(self.seed)
         self._billed_rounds = 0  # rounds of this configuration already billed
+        self._rounds_executed = 0  # host mirror of state.round (per config)
         self.view_changes: List[ViewChangeRecord] = []
         self.metrics = Metrics()
         self.tracer = Tracer()
@@ -639,15 +640,22 @@ class Simulator:
             inputs = self._const_inputs(join_reports)
             n = min(batch, max_rounds - rounds_done)
             random_loss = bool((self._drop_prob > 0).any())
+            if stop_when_announced and not random_loss:
+                # the const/mesh while_loop pauses at the announcement round
+                # in-engine, so the whole remaining budget rides one dispatch
+                # (the bridge's phase A) instead of a host-driven
+                # round-at-a-time loop; the scan path keeps per-batch stops
+                n = max_rounds - rounds_done
             with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
                 if self.mesh is not None:
                     # inputs are already placed under their dispatch shardings;
-                    # the while_loop runner exits at the decision round and
+                    # the while_loop runner exits at the decision round (and,
+                    # for the bridge's phase A, at the announcement round) and
                     # takes the budget as a dynamic operand (no re-jit when the
                     # batch size changes)
-                    self.state = self._sharded_run_until(random_loss)(
-                        self.state, inputs, jnp.int32(n)
-                    )
+                    self.state = self._sharded_run_until(
+                        random_loss, stop_when_announced
+                    )(self.state, inputs, jnp.int32(n))
                 elif random_loss:
                     # the per-round RNG-consuming scan path: random ingress
                     # loss is the one fault with no closed form (both FD
@@ -656,10 +664,12 @@ class Simulator:
                         self.config, self.state, inputs, n, random_loss
                     )
                 else:
-                    # deterministic constant plane: one early-exiting dispatch
+                    # deterministic constant plane: one early-exiting
+                    # dispatch (pauses at announcements under
+                    # stop_when_announced)
                     self.state = run_until_decided_const(
                         self.config, self.state, inputs, jnp.int32(n),
-                        bool(self._deliver.all()),
+                        bool(self._deliver.all()), stop_when_announced,
                     )
                 # ONE host<->device round trip syncs the batch and fetches
                 # everything a decision needs. Remote-device transports bill
@@ -681,7 +691,14 @@ class Simulator:
                     self.config, words
                 )
                 announced_any = announced_np.any()
-            self.metrics.incr("rounds", n)
+            # bill the rounds metric by what actually executed: early-exit
+            # dispatches (decision / announcement-stop) run fewer rounds
+            # than requested, and the bridge budgets its pump phases off
+            # this counter
+            self.metrics.incr(
+                "rounds", int(round_np) - self._rounds_executed
+            )
+            self._rounds_executed = int(round_np)
             self.metrics.incr("device_dispatches")
             rounds_done += n
             if decided:
@@ -694,8 +711,13 @@ class Simulator:
                 # rows are host-registered real-member votes, not swarm
                 # proposals to inform anyone about
                 if stop_when_announced and announced_np[: self.config.groups].any():
-                    self.virtual_ms += rounds_done * self._round_ms
-                    self._billed_rounds += rounds_done
+                    # bill exactly the rounds this configuration has executed
+                    # (the announcement-stop dispatch may have run fewer than
+                    # the requested budget)
+                    self.virtual_ms += (
+                        int(round_np) - self._billed_rounds
+                    ) * self._round_ms
+                    self._billed_rounds = int(round_np)
                     return None
                 # rounds the announced proposal has actually been stalled --
                 # the fallback timer runs from propose(), not from the start
@@ -816,16 +838,17 @@ class Simulator:
             )
         return self._sharded_runs[key]
 
-    def _sharded_run_until(self, random_loss: bool):
-        """The jitted mesh decision loop, cached per loss-model only: the
-        round budget is a dynamic operand, so every batch size shares one
-        executable (two at most per simulator lifetime)."""
-        key = ("until", random_loss)
+    def _sharded_run_until(self, random_loss: bool,
+                           stop_when_announced: bool = False):
+        """The jitted mesh decision loop, cached per (loss-model,
+        announcement-stop): the round budget is a dynamic operand, so every
+        batch size shares one executable."""
+        key = ("until", random_loss, stop_when_announced)
         if key not in self._sharded_runs:
             from ..shard.engine import make_sharded_run_until
 
             self._sharded_runs[key] = make_sharded_run_until(
-                self.config, self.mesh, random_loss
+                self.config, self.mesh, random_loss, stop_when_announced
             )
         return self._sharded_runs[key]
 
@@ -940,6 +963,7 @@ class Simulator:
             unbilled * self._round_ms + self.config.batching_window_ms
         )
         self._billed_rounds = 0
+        self._rounds_executed = 0  # fresh configuration: state.round resets
         record = ViewChangeRecord(
             cut=np.flatnonzero(cut),
             added=added,

@@ -589,13 +589,14 @@ def run_rounds_const(
     return final
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4))
+@functools.partial(jax.jit, static_argnums=(0, 4, 5))
 def run_until_decided_const(
     config: SimConfig,
     state: SimState,
     inputs: RoundInputs,
     max_rounds: jax.Array,
     uniform_delivery: bool = True,
+    stop_when_announced: bool = False,
 ) -> SimState:
     """Run up to ``max_rounds`` rounds of a *constant, deterministic* fault
     plane in ONE device dispatch, exiting as soon as consensus decides.
@@ -708,7 +709,14 @@ def run_until_decided_const(
 
     def cond(carry):
         st, r = carry
-        return (r < max_rounds) & ~st.decided
+        keep = (r < max_rounds) & ~st.decided
+        if stop_when_announced:
+            # pause the dispatch at the round a group proposal is announced
+            # (extern rows excluded), so the bridge can broadcast the
+            # pre-decision cut to real members before votes tally -- ONE
+            # dispatch instead of a host-driven round-at-a-time loop
+            keep &= ~jnp.any(st.announced[: config.groups])
+        return keep
 
     def body(carry):
         st, r = carry

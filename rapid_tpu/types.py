@@ -242,6 +242,22 @@ class ConsensusResponse:
 
 
 @dataclass(frozen=True)
+class FastRoundVoteBatch:
+    """Transport-level fan-in of identical-value fast-round votes: one frame
+    standing for one ``FastRoundPhase2bMessage`` per listed sender, all
+    carrying the same ``(configuration_id, endpoints)`` value. Pure
+    compression -- the receiver tallies each (sender, value) exactly as it
+    would the individual message, with the same per-sender dedup -- so a
+    swarm's quorum of votes (~3N/4 messages at protocol level) crosses the
+    wire in O(1) frames instead of thousands. Native-codec transports only
+    (rapid.proto has no such message)."""
+
+    senders: Tuple["Endpoint", ...]
+    configuration_id: int
+    endpoints: Tuple["Endpoint", ...]
+
+
+@dataclass(frozen=True)
 class GossipEnvelope:
     """Epidemic-relay wrapper around any protocol message.
 

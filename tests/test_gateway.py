@@ -18,6 +18,7 @@ import pytest
 from rapid_tpu import ClusterBuilder, Endpoint, Settings
 from rapid_tpu.events import ClusterEvents
 from rapid_tpu.messaging.gateway import (
+    GatewaySwarmBroadcaster,
     GatewayRoutedClient,
     SwarmGateway,
     decode_routed,
@@ -70,6 +71,11 @@ class GatewayHarness:
             ClusterBuilder(addr)
             .use_settings(self.settings)
             .set_messaging_client_and_server(client, transport)
+            # swarm-bound broadcasts collapse to one wildcard frame, as the
+            # agent CLI does in gateway mode
+            .set_broadcaster_factory(
+                lambda c, rng, routed=client: GatewaySwarmBroadcaster(routed)
+            )
             .join(self.gateway.seed_endpoint(), timeout=timeout)
         )
         self.agents.append(cluster)

@@ -136,3 +136,16 @@ def test_gossip_refused_on_jvm_wire_transport():
     )
     with pytest.raises(JoinException, match="native-codec transport"):
         builder.start()
+
+
+def test_vote_batch_codec_roundtrip_and_tally():
+    """FastRoundVoteBatch: wire round-trip, and the service tallies it
+    exactly as the equivalent individual votes (reaching a decision)."""
+    from rapid_tpu.types import FastRoundVoteBatch
+
+    eps = members(8)
+    batch = FastRoundVoteBatch(
+        senders=tuple(eps[:6]), configuration_id=-9, endpoints=(eps[7],)
+    )
+    request_no, decoded = codec.decode(codec.encode(5, batch))
+    assert request_no == 5 and decoded == batch

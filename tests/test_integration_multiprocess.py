@@ -140,9 +140,10 @@ class GatewayRunner:
         self.proc = subprocess.Popen(
             cmd, stdout=log, stderr=subprocess.STDOUT, env=env, cwd=str(REPO)
         )
-        # the gateway prints "SEED host:port" once the socket is up
+        # the gateway prints "SEED host:port" once the socket is up AND the
+        # swarm engine is compile-warmed (which dominates at large capacity)
         seed_re = re.compile(r"^SEED (\S+)$", re.MULTILINE)
-        deadline = time.time() + 120
+        deadline = time.time() + 360
         while time.time() < deadline:
             if self.log_path.exists():
                 m = seed_re.search(self.log_path.read_text())
@@ -333,3 +334,40 @@ def test_five_agents_converge_over_gossip(runner):
         "\n".join(p.read_text()[-500:] for p in logs[:-1])
     configs = {last_status(p)[1] for p in logs[:-1]}
     assert len(configs) == 1
+
+
+@pytest.mark.slow
+def test_north_star_at_ten_thousand_virtual_nodes(runner, gateway_runner):
+    """The north-star scenario at 10x the round-3 proof: 5 real OS processes
+    join a socket-hosted swarm of 10,000 simulated virtual nodes, converge
+    to bit-identical configuration ids on both sides of the wire, and the
+    swarm detects and removes a SIGKILLed agent."""
+    base = random.randint(30000, 39000)
+    gw_addr = f"127.0.0.1:{base}"
+    # the gateway CLI warms the engine before printing SEED, so agents
+    # arrive at a compiled swarm
+    seed = gateway_runner.start(gw_addr, n_virtual=10_000)
+
+    logs = []
+    for i in range(1, 6):
+        _, log = runner.run_node(
+            f"127.0.0.1:{base + i}", seed=seed, fd_interval_ms=200,
+            gateway=gw_addr,
+        )
+        logs.append(log)
+        assert wait_for_size([log], 10_000 + i, timeout_s=240), \
+            log.read_text()[-3000:]
+
+    all_logs = logs + [gateway_runner.log_path]
+    assert wait_for_size(all_logs, 10_005, timeout_s=180)
+    configs = {last_status(p)[1] for p in all_logs}
+    assert len(configs) == 1, f"config divergence: {configs}"
+
+    victim_proc, _ = runner.procs[-1]
+    victim_proc.send_signal(signal.SIGKILL)
+    victim_proc.wait(timeout=10)
+    survivor_logs = logs[:-1] + [gateway_runner.log_path]
+    assert wait_for_size(survivor_logs, 10_004, timeout_s=240), \
+        gateway_runner.log_path.read_text()[-3000:]
+    configs = {last_status(p)[1] for p in survivor_logs}
+    assert len(configs) == 1, f"config divergence after cut: {configs}"

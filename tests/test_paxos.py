@@ -18,7 +18,6 @@ from rapid_tpu.types import (
     Rank,
 )
 
-
 class NoOpClient(IMessagingClient):
     def send_message(self, remote, msg):
         return Promise.completed(None)
@@ -29,7 +28,6 @@ class NoOpClient(IMessagingClient):
     def shutdown(self):
         pass
 
-
 class NoOpBroadcaster(IBroadcaster):
     def broadcast(self, msg):
         return []
@@ -37,10 +35,8 @@ class NoOpBroadcaster(IBroadcaster):
     def set_membership(self, recipients):
         pass
 
-
 def hosts(*specs):
     return tuple(Endpoint.from_string(s) for s in specs)
-
 
 P1 = hosts("127.0.0.1:5891", "127.0.0.1:5821")
 P2 = hosts("127.0.0.1:5821", "127.0.0.1:5872")
@@ -48,14 +44,11 @@ NOISE = hosts("127.0.0.1:1", "127.0.0.1:2")
 
 ADDR = Endpoint.from_parts("127.0.0.1", 1234)
 
-
 def make_paxos(n):
     return Paxos(ADDR, 1, n, NoOpClient(), NoOpBroadcaster(), lambda v: None)
 
-
 def p1b(vrnd: Rank, vval) -> Phase1bMessage:
     return Phase1bMessage(sender=ADDR, configuration_id=1, rnd=vrnd, vrnd=vrnd, vval=vval)
-
 
 # (N, p1_votes_at_highest_rank, p2_votes_at_lower_rank, proposals, valid choice indexes)
 # Mirrors PaxosTests.coordinatorRuleTests (PaxosTests.java:252-286).
@@ -72,7 +65,6 @@ COORDINATOR_CASES = [
     (6, 3, 3, (P2, P1, NOISE), {0}),
     (6, 4, 1, (P1, P2, NOISE), {0}),
 ]
-
 
 @pytest.mark.parametrize("n,p1n,p2n,proposals,valid", COORDINATOR_CASES)
 def test_coordinator_rule(n, p1n, p2n, proposals, valid):
@@ -93,7 +85,6 @@ def test_coordinator_rule(n, p1n, p2n, proposals, valid):
         chosen = paxos.select_proposal_using_coordinator_rule(quorum)
         assert chosen in valid_values, f"chose {chosen}"
 
-
 # Classic-round cases (PaxosTests.java:180-188): all votes at the same rank,
 # p2 gets `p2votes` and p1 the rest; quorum = all N.
 CLASSIC_CASES = [
@@ -106,7 +97,6 @@ CLASSIC_CASES = [
     (10, 4, {P1, P2}),
     (10, 1, {P1, P2}),
 ]
-
 
 @pytest.mark.parametrize("n,p2votes,valid", CLASSIC_CASES)
 def test_coordinator_rule_same_rank(n, p2votes, valid):
@@ -121,11 +111,9 @@ def test_coordinator_rule_same_rank(n, p2votes, valid):
         chosen = paxos.select_proposal_using_coordinator_rule(messages)
         assert chosen in valid
 
-
 def test_empty_phase1b_raises():
     with pytest.raises(ValueError):
         make_paxos(5).select_proposal_using_coordinator_rule([])
-
 
 def test_all_empty_vvals_choose_nothing():
     """Quorum of acceptors that never voted => empty choice, coordinator waits
@@ -133,7 +121,6 @@ def test_all_empty_vvals_choose_nothing():
     paxos = make_paxos(5)
     msgs = [p1b(Rank(0, i), ()) for i in range(3)]
     assert paxos.select_proposal_using_coordinator_rule(msgs) == ()
-
 
 # ---------------------------------------------------------------------------
 # Fast-round quorum arithmetic (FastPaxosWithoutFallbackTests.java:85-90)
@@ -152,21 +139,17 @@ QUORUM_TABLE = {
     102: 77,
 }
 
-
 def voter(i: int) -> Endpoint:
     return Endpoint.from_parts("127.0.0.1", 10_000 + i)
 
-
 def fast_vote(i: int, proposal) -> FastRoundPhase2bMessage:
     return FastRoundPhase2bMessage(sender=voter(i), configuration_id=7, endpoints=proposal)
-
 
 def make_fast_paxos(n, on_decide):
     return FastPaxos(
         ADDR, 7, n, NoOpClient(), NoOpBroadcaster(), VirtualScheduler(), on_decide,
         rng=random.Random(0),
     )
-
 
 @pytest.mark.parametrize("n,quorum", sorted(QUORUM_TABLE.items()))
 def test_fast_round_exact_quorum(n, quorum):
@@ -179,7 +162,6 @@ def test_fast_round_exact_quorum(n, quorum):
         assert not decided
     fp.handle_messages(fast_vote(quorum - 1, proposal))
     assert decided == [list(proposal)]
-
 
 @pytest.mark.parametrize("n,quorum", sorted(QUORUM_TABLE.items()))
 def test_fast_round_with_f_conflicts(n, quorum):
@@ -204,7 +186,6 @@ def test_fast_round_with_f_conflicts(n, quorum):
         fp2.handle_messages(fast_vote(i, proposal))
     assert decided2 == []
 
-
 def test_fast_round_duplicate_votes_ignored():
     proposal = hosts("127.0.0.9:1")
     decided = []
@@ -212,7 +193,6 @@ def test_fast_round_duplicate_votes_ignored():
     for _ in range(10):
         fp.handle_messages(fast_vote(0, proposal))
     assert not decided
-
 
 def test_fast_round_config_mismatch_ignored():
     proposal = hosts("127.0.0.9:1")
@@ -223,7 +203,6 @@ def test_fast_round_config_mismatch_ignored():
             FastRoundPhase2bMessage(sender=voter(i), configuration_id=99, endpoints=proposal)
         )
     assert not decided
-
 
 def test_classic_fallback_end_to_end():
     """Wire N Paxos instances directly; one coordinator runs phase1a..2b and
@@ -271,3 +250,64 @@ def test_classic_fallback_end_to_end():
     nodes[addrs[0]].start_phase1a(2)
     assert len(decisions) == n
     assert set(decisions.values()) == {value}
+
+def test_vote_batch_tallies_like_individual_votes():
+    """FastRoundVoteBatch is pure transport fan-in: unpacking it (as
+    MembershipService._handle_vote_batch does) reaches the decision exactly
+    where the equivalent individual votes would, with per-sender dedup
+    intact."""
+    from rapid_tpu.types import FastRoundVoteBatch
+
+    n, quorum = 50, QUORUM_TABLE[50]
+    proposal = hosts("127.0.0.9:1")
+    decided = []
+    fp = make_fast_paxos(n, decided.append)
+    batch = FastRoundVoteBatch(
+        senders=tuple(voter(i) for i in range(quorum - 1)),
+        configuration_id=7,
+        endpoints=proposal,
+    )
+    for sender in batch.senders:
+        fp.handle_messages(FastRoundPhase2bMessage(
+            sender=sender, configuration_id=batch.configuration_id,
+            endpoints=batch.endpoints,
+        ))
+    assert not decided  # quorum - 1 distinct senders: not yet
+    # duplicate senders (a replayed batch) must not fake the quorum
+    for sender in batch.senders:
+        fp.handle_messages(FastRoundPhase2bMessage(
+            sender=sender, configuration_id=batch.configuration_id,
+            endpoints=batch.endpoints,
+        ))
+    assert not decided
+    fp.handle_messages(fast_vote(quorum - 1, proposal))
+    assert decided == [list(proposal)]
+
+def test_service_vote_batch_reaches_decision():
+    """End-to-end through MembershipService.handle_message: one
+    FastRoundVoteBatch frame completes the fast round and applies the view
+    change (the gateway's decision-delivery path)."""
+
+    from harness import ClusterHarness
+    from rapid_tpu.types import FastRoundVoteBatch
+
+    h = ClusterHarness(seed=91)
+    h.create_cluster(6, parallel=False)
+    h.wait_and_verify_agreement(6)
+    target = h.instances[h.addr(0)]
+    service = target._membership_service  # noqa: SLF001
+    cut = (h.addr(5),)
+    config_id = target.get_current_configuration_id()
+    # a quorum's worth of votes (6 -> 5) in ONE frame
+    batch = FastRoundVoteBatch(
+        senders=tuple(h.addr(i) for i in range(5)),
+        configuration_id=config_id,
+        endpoints=cut,
+    )
+    service.handle_message(batch)
+    ok = h.scheduler.run_until(
+        lambda: target.get_membership_size() == 5, timeout_ms=60_000
+    )
+    assert ok, "vote batch did not drive the view change"
+    assert h.addr(5) not in target.get_memberlist()
+    h.shutdown()
