@@ -55,6 +55,12 @@ def main() -> None:
         "relay (needs a native-codec transport, not grpc)",
     )
     parser.add_argument("--gossip-fanout", type=int, default=4)
+    parser.add_argument(
+        "--join-timeout", type=float, default=60.0,
+        help="seconds to wait for the two-phase join (bootstrapping into a "
+        "very large view takes longer: the full configuration must be "
+        "shipped and the member's rings built)",
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
 
@@ -138,7 +144,9 @@ def main() -> None:
             lambda c, rng, routed=client: GatewaySwarmBroadcaster(routed)
         )
     if args.seed_address:
-        cluster = builder.join(Endpoint.from_string(args.seed_address))
+        cluster = builder.join(
+            Endpoint.from_string(args.seed_address), timeout=args.join_timeout
+        )
     else:
         cluster = builder.start()
     log.info("agent started at %s", listen)

@@ -78,8 +78,15 @@ class MembershipView:
         self._config_dirty = True
         self._current_config: Optional[Configuration] = None
         self._current_config_id = -1
-        for ep in endpoints:
-            self._insert(ep)
+        if len(endpoints) > 256:
+            # bulk bootstrap (a joiner rebuilding a large view from a
+            # JoinResponse): vectorized ring keys + one sort per ring
+            # instead of per-endpoint sorted-list inserts, which are
+            # O(K * N^2) list memmoves -- minutes at 100k members
+            self._bulk_insert(list(endpoints))
+        else:
+            for ep in endpoints:
+                self._insert(ep)
         for nid in node_ids:
             if nid not in self._identifier_set:
                 bisect.insort(self._identifiers, nid)
@@ -106,6 +113,45 @@ class MembershipView:
                 )
             lst.insert(pos, entry)
         self._all_nodes.add(endpoint)
+
+    def _bulk_insert(self, endpoints: List[Endpoint]) -> None:
+        """Construct all K rings at once: batched xxHash64 over the endpoint
+        matrix and one stable argsort per ring. Produces bit-identical ring
+        contents, hash caches, and collision errors to sequential
+        ``_insert`` calls (keys are distinct signed int64s, so sorted order
+        is unique)."""
+        import numpy as np
+
+        from . import native
+        from .hashing import endpoint_hash_batch, pack_hostnames
+
+        data, lengths = pack_hostnames([ep.hostname for ep in endpoints])
+        ports = np.array([ep.port for ep in endpoints], dtype=np.int64)
+        # all K rings in one native call where the library loads (the same
+        # dispatch sim/topology.py uses for cluster synthesis)
+        all_keys = native.ring_hashes(data, lengths, ports, self.k)
+        for ring in range(self.k):
+            keys = (
+                all_keys[ring]
+                if all_keys is not None
+                else endpoint_hash_batch(data, lengths, ports, ring)
+            ).view(np.int64)
+            order = np.argsort(keys, kind="stable")
+            sorted_keys = keys[order]
+            for d in np.flatnonzero(sorted_keys[1:] == sorted_keys[:-1]):
+                a, b = endpoints[order[d]], endpoints[order[d + 1]]
+                if a != b:
+                    raise RuntimeError(
+                        f"ring hash collision on ring {ring}: {a} vs {b}"
+                    )
+            self._rings[ring] = [
+                (int(sorted_keys[i]), endpoints[order[i]])
+                for i in range(len(endpoints))
+            ]
+            self._hash_cache[ring] = {
+                ep: int(key) for key, ep in self._rings[ring]
+            }
+        self._all_nodes.update(endpoints)
 
     def _remove(self, endpoint: Endpoint) -> None:
         for ring in range(self.k):

@@ -28,10 +28,12 @@ class AgentRunner:
 
     def run_node(self, listen: str, seed: str = None, fd_interval_ms: int = 100,
                  gateway: str = None, transport: str = None,
-                 broadcaster: str = None):
+                 broadcaster: str = None, join_timeout: float = None):
         log_path = self.tmpdir / f"agent-{listen.replace(':', '-')}.log"
         cmd = [sys.executable, str(AGENT), "--listen-address", listen,
                "--fd-interval-ms", str(fd_interval_ms)]
+        if join_timeout:
+            cmd += ["--join-timeout", str(join_timeout)]
         if seed:
             cmd += ["--seed-address", seed]
         if gateway:
@@ -368,6 +370,48 @@ def test_north_star_at_ten_thousand_virtual_nodes(runner, gateway_runner):
     victim_proc.wait(timeout=10)
     survivor_logs = logs[:-1] + [gateway_runner.log_path]
     assert wait_for_size(survivor_logs, 10_004, timeout_s=240), \
+        gateway_runner.log_path.read_text()[-3000:]
+    configs = {last_status(p)[1] for p in survivor_logs}
+    assert len(configs) == 1, f"config divergence after cut: {configs}"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("RAPID_TPU_HEAVY"),
+    reason="several-minute run; set RAPID_TPU_HEAVY=1 to include",
+)
+def test_north_star_at_one_hundred_thousand_virtual_nodes(runner, gateway_runner):
+    """The BASELINE.json north star at FULL scale: real OS processes join a
+    socket-hosted swarm of 100,000 simulated virtual nodes, converge to
+    bit-identical configuration ids, and observe a virtual cut. Join cost
+    is dominated by the member's own 100k-view bootstrap (bulk ring build)
+    and the one-frame quorum vote batch."""
+    base = random.randint(30000, 39000)
+    gw_addr = f"127.0.0.1:{base}"
+    seed = gateway_runner.start(gw_addr, n_virtual=100_000)
+
+    logs = []
+    for i in (1, 2):
+        _, log = runner.run_node(
+            f"127.0.0.1:{base + i}", seed=seed, fd_interval_ms=500,
+            gateway=gw_addr, join_timeout=300,
+        )
+        logs.append(log)
+        assert wait_for_size([log], 100_000 + i, timeout_s=360), \
+            log.read_text()[-3000:]
+
+    all_logs = logs + [gateway_runner.log_path]
+    assert wait_for_size(all_logs, 100_002, timeout_s=240)
+    configs = {last_status(p)[1] for p in all_logs}
+    assert len(configs) == 1, f"config divergence: {configs}"
+
+    # SIGKILL one agent: the swarm senses the death and both survivors of
+    # the 100k-member configuration converge on the removal cut
+    victim_proc, _ = runner.procs[-1]
+    victim_proc.send_signal(signal.SIGKILL)
+    victim_proc.wait(timeout=10)
+    survivor_logs = logs[:-1] + [gateway_runner.log_path]
+    assert wait_for_size(survivor_logs, 100_001, timeout_s=360), \
         gateway_runner.log_path.read_text()[-3000:]
     configs = {last_status(p)[1] for p in survivor_logs}
     assert len(configs) == 1, f"config divergence after cut: {configs}"
