@@ -9,11 +9,29 @@ warmed); vs_baseline = value / 5000ms (fraction of the north-star budget;
 reporting: the decided cut must be exactly the crashed set, and the resulting
 configuration ID is computed with the bit-exact JVM hash chain.
 
-Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly one JSON line:
+  {"metric", "value", "unit", "vs_baseline", "backend", "sweep"}
+where "sweep" is the warmed scaling curve (1k/10k/100k/1M on TPU; the 1M
+point is skipped on CPU), each entry measured by the same warmed_run as the
+headline so the curve can never drift from it.
+
+Exit-code contract (the driver records rc alongside the JSON):
+  0   measurement produced; TPU wall within the regression budget
+  17  accelerator unreachable -- the remote-TPU tunnel's upstream is down
+      (device init hangs forever in that state, so availability is probed
+      in killable subprocesses with bounded retries before any jax import
+      in this process). Infrastructure outage, NOT a code regression.
+  18  measurement produced (JSON printed) but the warmed 100k wall on a
+      real TPU exceeded TPU_BUDGET_MS -- a perf regression the driver's
+      artifact catches even though the plain CPU test battery cannot
+      (tests/test_bench_regression.py guards CPU wall + exact protocol
+      time; this is the TPU-side structural guard).
+  other nonzero: crash / parity-assertion failure -- a correctness bug.
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -24,27 +42,127 @@ N_NODES = 100_000
 FAIL_FRACTION = 0.01
 BASELINE_MS = 5000.0  # north-star budget (BASELINE.json)
 
-# Fail fast instead of hanging forever when the accelerator is unreachable
-# (the remote-TPU tunnel blocks indefinitely inside device init when its
-# upstream is down): a warmed 100k run takes ~1 min end to end, so if the
-# watchdog fires something is broken, and a loud error beats a silent hang.
-WATCHDOG_S = 15 * 60
+# TPU-side wall budget for the warmed 100k decision (rc 18 above it).
+# Last driver-verified record: BENCH_r02.json = 122.8 ms; round-3 builder
+# measurements ranged ~115-150 ms against a noisy tunnel. 250 ms flags a
+# structural regression (lost early-exit, an extra fetched buffer ~= +100 ms)
+# without tripping on ordinary day-to-day tunnel latency variance.
+TPU_BUDGET_MS = 250.0
+
+# Device-availability probe: attempt timeouts + pauses, all in subprocesses
+# (a hung device init cannot be interrupted in-process; the wedged client
+# would also hold the single-client tunnel). Total worst case ~8.5 min.
+PROBE_TIMEOUTS_S = (90, 150, 240)
+PROBE_PAUSE_S = 15
+
+# Backstop for anything unexpectedly hanging AFTER the probe succeeded
+# (e.g. the tunnel dying mid-measurement). Probe (~8.5 min) + warmed
+# headline + sweep (~5 min) fit comfortably.
+WATCHDOG_S = 20 * 60
 
 
-def _arm_watchdog() -> None:
-    def fire() -> None:
+# Progress shared with the watchdog: once the headline measurement exists it
+# is the round's artifact, and a later hang (e.g. the 1M sweep point jitting
+# against a dying tunnel) must emit it rather than destroy it.
+_PROGRESS: dict = {"headline": None, "backend": None, "sweep": []}
+
+
+def _emit_json(headline: dict, backend: str, sweep: list) -> None:
+    merged = list(sweep) + [
+        {
+            "n": N_NODES,
+            "warmed_wall_ms": headline["value"],
+            "virtual_ms": headline["virtual_ms"],
+            "cut_ok": True,
+        }
+    ]
+    merged.sort(key=lambda e: e.get("n", 1 << 62))
+    print(
+        json.dumps(
+            {
+                "metric": "time_to_stable_view_100k_nodes_1pct_crash_sim",
+                "value": headline["value"],
+                "unit": "ms",
+                "vs_baseline": round(headline["value"] / BASELINE_MS, 4),
+                "backend": backend,
+                "sweep": merged,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _on_watchdog() -> int:
+    """The watchdog's decision, separated from os._exit for testability:
+    with the headline already measured, the hang is in the sweep tail --
+    emit the partial artifact and apply the normal rc contract; with no
+    headline, nothing was measured (rc 17)."""
+    headline = _PROGRESS["headline"]
+    if headline is not None:
+        sweep = list(_PROGRESS["sweep"])
+        sweep.append({"error": f"watchdog after {WATCHDOG_S}s mid-sweep"})
+        _emit_json(headline, _PROGRESS["backend"] or "unknown", sweep)
         print(
-            f"bench.py watchdog: no result after {WATCHDOG_S}s -- the "
-            "accelerator is likely unreachable (device init hangs when the "
-            "TPU tunnel's upstream is down). No measurement was produced.",
+            f"bench.py watchdog: hang after {WATCHDOG_S}s with the "
+            "headline already measured; emitted the partial artifact.",
             file=sys.stderr,
             flush=True,
         )
-        os._exit(17)
+        if _PROGRESS["backend"] == "tpu" and headline["value"] > TPU_BUDGET_MS:
+            return 18
+        return 0
+    print(
+        f"bench.py watchdog: no result after {WATCHDOG_S}s -- the "
+        "accelerator likely became unreachable mid-run (the TPU tunnel "
+        "hangs rather than erroring when its upstream drops). No "
+        "measurement was produced.",
+        file=sys.stderr,
+        flush=True,
+    )
+    return 17
 
-    timer = threading.Timer(WATCHDOG_S, fire)
+
+def _arm_watchdog() -> None:
+    timer = threading.Timer(WATCHDOG_S, lambda: os._exit(_on_watchdog()))
     timer.daemon = True
     timer.start()
+
+
+def _probe_backend_once(timeout_s: float) -> "str | None":
+    """Device init in a killable subprocess: returns the default backend
+    platform name if it completes within timeout_s, else None."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    lines = out.stdout.strip().splitlines()
+    return lines[-1] if lines else None
+
+
+def probe_backend() -> "str | None":
+    """Bounded-retry availability probe (the tunnel outage seen in rounds
+    3-4 lasted hours, but brief relay blips recover in seconds -- retrying
+    across a few minutes distinguishes the two without burning the round)."""
+    for i, t in enumerate(PROBE_TIMEOUTS_S):
+        backend = _probe_backend_once(t)
+        if backend is not None:
+            return backend
+        print(
+            f"bench.py: device probe {i + 1}/{len(PROBE_TIMEOUTS_S)} timed "
+            f"out after {t}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        if i + 1 < len(PROBE_TIMEOUTS_S):
+            time.sleep(PROBE_PAUSE_S)
+    return None
 
 
 def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
@@ -82,26 +200,74 @@ def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
     return wall_ms, record, build_s, warm_wall
 
 
+def run_sweep(backend: str, seed: int) -> list:
+    """Warmed scaling curve. Each size is independent: a failure at one
+    size is recorded as an error entry, not a lost artifact. Entries land in
+    _PROGRESS["sweep"] as they complete so the watchdog can emit a partial
+    curve."""
+    sizes = [1_000, 10_000, 1_000_000] if backend == "tpu" else [1_000, 10_000]
+    out = _PROGRESS["sweep"] = []
+    for n in sizes:
+        try:
+            wall_ms, record, _, _ = warmed_run(n, seed=seed)
+            out.append(
+                {
+                    "n": n,
+                    "warmed_wall_ms": round(wall_ms, 1),
+                    "virtual_ms": record.virtual_time_ms,
+                    "cut_ok": True,  # asserted inside warmed_run
+                }
+            )
+        except AssertionError:
+            # a parity/correctness failure is a BUG, not a lost data point:
+            # it must crash the bench (generic nonzero rc per the contract),
+            # never be downgraded to an error entry in a rc-0 artifact
+            raise
+        except Exception as exc:  # noqa: BLE001 -- keep the rest of the curve
+            out.append({"n": n, "error": f"{type(exc).__name__}: {exc}"})
+            print(f"bench.py: sweep n={n} failed: {exc}", file=sys.stderr, flush=True)
+    return out
+
+
 def main() -> None:
     _arm_watchdog()
-    wall_ms, record, build_s, warm_wall = warmed_run(N_NODES, seed=1234)
-
-    print(
-        json.dumps(
-            {
-                "metric": "time_to_stable_view_100k_nodes_1pct_crash_sim",
-                "value": round(wall_ms, 1),
-                "unit": "ms",
-                "vs_baseline": round(wall_ms / BASELINE_MS, 4),
-            }
+    backend = probe_backend()
+    if backend is None:
+        print(
+            "bench.py: accelerator unreachable after "
+            f"{len(PROBE_TIMEOUTS_S)} bounded probes -- the TPU tunnel's "
+            "upstream is down (known signature: connect to the relay "
+            "succeeds, then immediate EOF; device init hangs forever). "
+            "No measurement was produced. rc=17 means infrastructure "
+            "outage, not regression.",
+            file=sys.stderr,
+            flush=True,
         )
-    )
+        sys.exit(17)
+
+    wall_ms, record, build_s, warm_wall = warmed_run(N_NODES, seed=1234)
+    _PROGRESS["backend"] = backend
+    _PROGRESS["headline"] = {
+        "value": round(wall_ms, 1),
+        "virtual_ms": record.virtual_time_ms,
+    }
+    sweep = run_sweep(backend, seed=42)
+    _emit_json(_PROGRESS["headline"], backend, sweep)
     print(
         f"# membership={N_NODES}->{record.membership_size} cut={len(record.cut)} nodes "
         f"virtual_time={record.virtual_time_ms}ms config_id={record.configuration_id} "
         f"build={build_s:.1f}s warmup_wall={warm_wall:.1f}s",
         file=sys.stderr,
     )
+    if backend == "tpu" and wall_ms > TPU_BUDGET_MS:
+        print(
+            f"bench.py: warmed 100k wall {wall_ms:.1f} ms exceeds the "
+            f"{TPU_BUDGET_MS:.0f} ms TPU budget -- structural perf "
+            "regression (rc=18). The JSON above is still the measurement.",
+            file=sys.stderr,
+            flush=True,
+        )
+        sys.exit(18)
 
 
 if __name__ == "__main__":

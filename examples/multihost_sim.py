@@ -8,6 +8,13 @@ On a TPU pod slice, launch one copy of this script per host:
 Single-host (or the forced CPU backend) needs no flags: the degenerate
 1-host mesh runs the identical sharded program.
 
+Off pod hardware the same cross-process runtime can be exercised with the
+CPU backend -- one OS process per simulated "host", each owning a few forced
+CPU devices (this is how tests/test_multihost_processes.py drives it):
+
+    python examples/multihost_sim.py --coordinator 127.0.0.1:8476 \
+        --num-processes 2 --process-id $RANK --cpu-devices-per-host 2 --n 256
+
 The sharded round step row-shards the per-edge state over every mesh axis
 and performs one reduction naming both axes; XLA decomposes it into an
 intra-host ICI reduction plus a cross-host DCN exchange (see
@@ -15,8 +22,12 @@ rapid_tpu/shard/engine.py).
 """
 
 import argparse
+import os
+import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -27,7 +38,24 @@ def main() -> None:
     parser.add_argument("--n", type=int, default=10_000)
     parser.add_argument("--fail-fraction", type=float, default=0.01)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument(
+        "--cpu-devices-per-host", type=int, default=0,
+        help="force the CPU backend with this many local devices per "
+        "process (multi-host validation without pod hardware)",
+    )
     args = parser.parse_args()
+
+    if args.cpu_devices_per_host:
+        # Pin the CPU backend BEFORE anything initializes it: the config
+        # value (not the JAX_PLATFORMS env var, which an injected
+        # accelerator plugin can bypass) is what backends() respects --
+        # and jax.distributed.initialize below must run before backend
+        # init, so __graft_entry__._force_cpu_mesh (which initializes to
+        # assert) cannot be used on this path.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices_per_host)
 
     from rapid_tpu.shard.engine import make_multihost_mesh
     from rapid_tpu.sim.driver import Simulator

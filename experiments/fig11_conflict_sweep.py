@@ -18,7 +18,14 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+if __name__ == "__main__":
+    # force the CPU backend BEFORE any jax use: merely setting JAX_PLATFORMS
+    # does not stop an injected accelerator plugin, and a dead remote-device
+    # tunnel hangs device init forever (tests get this from conftest.py)
+    from __graft_entry__ import _force_cpu_mesh
+
+    _force_cpu_mesh(1)
 
 import numpy as np  # noqa: E402
 

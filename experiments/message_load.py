@@ -44,11 +44,12 @@ def run_strategy(strategy: str, n: int, crash: int, seed: int) -> dict:
     from harness import ClusterHarness
 
     h = ClusterHarness(seed=seed)
-    if strategy == "gossip":
+    if strategy.startswith("gossip"):
         from rapid_tpu.messaging.gossip import GossipBroadcaster
 
+        mode = "pushpull" if strategy == "gossip-pushpull" else "eager"
         h.broadcaster_factory = lambda client, rng: GossipBroadcaster(
-            client, client.address, fanout=4, rng=rng
+            client, client.address, fanout=4, rng=rng, mode=mode
         )
     try:
         return _measure(h, strategy, n, crash)
@@ -68,15 +69,23 @@ def _measure(h, strategy: str, n: int, crash: int) -> dict:
     h.wait_and_verify_agreement(n - crash)
 
     per_process = []
+    per_process_control = []  # payload-free IHAVE/PULL frames (pushpull)
     per_type: dict = {}
     for inst in h.instances.values():
         snap = inst._membership_service.metrics.snapshot()  # noqa: SLF001
-        total = sum(v for k, v in snap.items() if k.startswith("messages."))
+        total = sum(
+            v for k, v in snap.items()
+            if k.startswith("messages.") and not k.endswith(".control")
+        )
         per_process.append(total)
+        per_process_control.append(
+            sum(v for k, v in snap.items() if k.endswith(".control"))
+        )
         for k, v in snap.items():
             if k.startswith("messages."):
                 per_type[k[len("messages."):]] = per_type.get(k[len("messages."):], 0) + v
     arr = np.array(per_process)
+    ctl = np.array(per_process_control)
     return {
         "strategy": strategy,
         "n": n,
@@ -85,6 +94,7 @@ def _measure(h, strategy: str, n: int, crash: int) -> dict:
         "p50": int(np.percentile(arr, 50)),
         "p99": int(np.percentile(arr, 99)),
         "max": int(arr.max()),
+        "mean_control": round(float(ctl.mean()), 1),
         "per_type_totals": dict(sorted(per_type.items())),
     }
 
@@ -95,7 +105,7 @@ def main() -> None:
     parser.add_argument("--crash", type=int, default=2)
     parser.add_argument("--seed", type=int, default=11)
     args = parser.parse_args()
-    for strategy in ("unicast", "gossip"):
+    for strategy in ("unicast", "gossip", "gossip-pushpull"):
         print(
             json.dumps(run_strategy(strategy, args.n, args.crash, args.seed)),
             flush=True,

@@ -69,6 +69,14 @@ struct Conn {
 
 constexpr size_t kMaxQueuedWrite = 64ull * 1024 * 1024;
 
+// epoll_event.data.u64 tags: connection events carry the conn id (>= 1), so
+// a stale event left in an epoll_wait batch after its connection was closed
+// -- and whose fd number may already be reused by an accept later in the
+// same batch -- resolves to a dead id and is dropped, instead of being
+// misattributed to the new connection.
+constexpr uint64_t kListenTag = 0;
+constexpr uint64_t kWakeTag = ~0ull;
+
 struct Event {
   int type;  // 1 = frame, 2 = closed
   int64_t conn_id;
@@ -86,7 +94,6 @@ struct Server {
   std::mutex mu;  // conns + events + cv
   std::condition_variable cv;
   std::unordered_map<int64_t, std::shared_ptr<Conn>> conns;
-  std::unordered_map<int, int64_t> fd_to_id;
   std::deque<Event> events;
   int64_t next_conn_id = 1;
 };
@@ -113,7 +120,7 @@ void arm_writable(Server& srv, Conn& conn, bool on) {
   conn.want_write = on;
   epoll_event ev{};
   ev.events = EPOLLIN | (on ? EPOLLOUT : 0u);
-  ev.data.fd = conn.fd;
+  ev.data.u64 = static_cast<uint64_t>(conn.id);
   epoll_ctl(srv.epfd, EPOLL_CTL_MOD, conn.fd, &ev);
 }
 
@@ -174,33 +181,27 @@ bool drain_frames(Server& srv, Conn& conn) {
   return true;
 }
 
-void close_conn(Server& srv, int fd) {
+void close_conn(Server& srv, int64_t conn_id) {
   std::shared_ptr<Conn> conn;
   {
     std::lock_guard<std::mutex> lk(srv.mu);
-    auto it = srv.fd_to_id.find(fd);
-    if (it == srv.fd_to_id.end()) return;
-    auto cit = srv.conns.find(it->second);
-    if (cit != srv.conns.end()) {
-      conn = cit->second;
-      srv.conns.erase(cit);
-    }
-    srv.fd_to_id.erase(it);
+    auto it = srv.conns.find(conn_id);
+    if (it == srv.conns.end()) return;  // already closed (e.g. stale event)
+    conn = it->second;
+    srv.conns.erase(it);
   }
-  if (conn) {
-    conn->open.store(false);
-    // FIN before taking write_mu, then close under it: concurrent senders
-    // fail fast on the shut-down socket and can never write into a reused
-    // fd number
-    shutdown(fd, SHUT_RDWR);
-    std::lock_guard<std::mutex> wl(conn->write_mu);
-    epoll_ctl(srv.epfd, EPOLL_CTL_DEL, fd, nullptr);
-    close(fd);
-    Event ev;
-    ev.type = 2;
-    ev.conn_id = conn->id;
-    enqueue_event(srv, std::move(ev));
-  }
+  conn->open.store(false);
+  // FIN before taking write_mu, then close under it: concurrent senders
+  // fail fast on the shut-down socket and can never write into a reused
+  // fd number
+  shutdown(conn->fd, SHUT_RDWR);
+  std::lock_guard<std::mutex> wl(conn->write_mu);
+  epoll_ctl(srv.epfd, EPOLL_CTL_DEL, conn->fd, nullptr);
+  close(conn->fd);
+  Event ev;
+  ev.type = 2;
+  ev.conn_id = conn->id;
+  enqueue_event(srv, std::move(ev));
 }
 
 void reactor_loop(std::shared_ptr<Server> srv) {
@@ -213,14 +214,14 @@ void reactor_loop(std::shared_ptr<Server> srv) {
       break;
     }
     for (int i = 0; i < n && srv->running.load(); ++i) {
-      int fd = static_cast<int>(evs[i].data.fd);
-      if (fd == srv->wake_pipe[0]) {
+      uint64_t tag = evs[i].data.u64;
+      if (tag == kWakeTag) {
         uint8_t b;
         while (read(srv->wake_pipe[0], &b, 1) > 0) {
         }
         continue;
       }
-      if (fd == srv->listen_fd) {
+      if (tag == kListenTag) {
         for (;;) {
           int cfd = accept(srv->listen_fd, nullptr, nullptr);
           if (cfd < 0) break;
@@ -236,25 +237,27 @@ void reactor_loop(std::shared_ptr<Server> srv) {
             std::lock_guard<std::mutex> lk(srv->mu);
             conn->id = srv->next_conn_id++;
             srv->conns[conn->id] = conn;
-            srv->fd_to_id[cfd] = conn->id;
           }
           epoll_event ev{};
           ev.events = EPOLLIN;
-          ev.data.fd = cfd;
+          ev.data.u64 = static_cast<uint64_t>(conn->id);
           if (epoll_ctl(srv->epfd, EPOLL_CTL_ADD, cfd, &ev) < 0) {
-            close_conn(*srv, cfd);
+            close_conn(*srv, conn->id);
           }
         }
         continue;
       }
-      // connection readable (or errored)
+      // connection readable (or errored); a dead id means the connection was
+      // closed earlier in this batch -- drop the stale event (its fd number
+      // may already belong to a newly accepted connection)
       std::shared_ptr<Conn> conn;
       {
         std::lock_guard<std::mutex> lk(srv->mu);
-        auto it = srv->fd_to_id.find(fd);
-        if (it != srv->fd_to_id.end()) conn = srv->conns[it->second];
+        auto it = srv->conns.find(static_cast<int64_t>(tag));
+        if (it != srv->conns.end()) conn = it->second;
       }
       if (!conn) continue;
+      int fd = conn->fd;
       bool dead = (evs[i].events & (EPOLLHUP | EPOLLERR)) != 0;
       if (!dead && (evs[i].events & EPOLLOUT)) {
         std::lock_guard<std::mutex> wl(conn->write_mu);
@@ -278,7 +281,7 @@ void reactor_loop(std::shared_ptr<Server> srv) {
           dead = true;
         }
       }
-      if (dead) close_conn(*srv, fd);
+      if (dead) close_conn(*srv, conn->id);
     }
   }
 }
@@ -322,9 +325,9 @@ int64_t rapid_io_server_create(const char* host, int port) {
   }
   epoll_event ev{};
   ev.events = EPOLLIN;
-  ev.data.fd = srv->listen_fd;
+  ev.data.u64 = kListenTag;
   epoll_ctl(srv->epfd, EPOLL_CTL_ADD, srv->listen_fd, &ev);
-  ev.data.fd = srv->wake_pipe[0];
+  ev.data.u64 = kWakeTag;
   epoll_ctl(srv->epfd, EPOLL_CTL_ADD, srv->wake_pipe[0], &ev);
 
   srv->loop = std::thread(reactor_loop, srv);
@@ -432,7 +435,6 @@ void rapid_io_server_shutdown(int64_t handle) {
     std::lock_guard<std::mutex> lk(srv->mu);
     for (auto& kv : srv->conns) conns.push_back(kv.second);
     srv->conns.clear();
-    srv->fd_to_id.clear();
   }
   for (auto& conn : conns) {
     // same exclusion dance as close_conn: flip open and FIN first (peers

@@ -12,11 +12,24 @@ tags playing the role of the reference's protobuf ``oneof`` envelope
 from __future__ import annotations
 
 import struct
+from collections import OrderedDict
 from typing import Any, Dict, Tuple, Type
 
 import msgpack
 
 from .. import types as T
+
+# Encode memo for LARGE tuples (a 100k-member JoinResponse's endpoint and
+# identifier streams): the gateway sends the same configuration content to
+# every one of a joiner's K observers and to every joiner of a configuration
+# (the bridge reuses the same tuple objects, rapid_tpu/sim/bridge.py
+# _full_config_response), so the Python-level _enc walk -- ~1M dict builds
+# per copy at 100k -- runs once per content instead of once per send. Keyed
+# by object identity with the tuple held strongly, so a hit is always the
+# same (immutable) object; bounded FIFO. Bytes on the wire are unchanged.
+_ENC_MEMO_MIN = 4096
+_ENC_MEMO_CAP = 8
+_enc_memo: "OrderedDict[int, Tuple[tuple, list]]" = OrderedDict()
 
 # stable wire tags per message type (appending only; never renumber)
 _TYPES: Tuple[Type, ...] = (
@@ -54,7 +67,17 @@ def _enc(obj: Any) -> Any:
     if isinstance(obj, (T.EdgeStatus, T.JoinStatusCode, T.NodeStatus)):
         return {"__en": [type(obj).__name__, int(obj)]}
     if isinstance(obj, tuple):
-        return [_enc(x) for x in obj]
+        if len(obj) < _ENC_MEMO_MIN:
+            return [_enc(x) for x in obj]
+        hit = _enc_memo.get(id(obj))
+        if hit is not None and hit[0] is obj:
+            _enc_memo.move_to_end(id(obj))
+            return hit[1]
+        enc = [_enc(x) for x in obj]
+        _enc_memo[id(obj)] = (obj, enc)
+        while len(_enc_memo) > _ENC_MEMO_CAP:
+            _enc_memo.popitem(last=False)
+        return enc
     if isinstance(obj, T.AlertMessage):
         # predates the generic "__msg" form; kept for wire stability of
         # BatchedAlertMessage frames across versions

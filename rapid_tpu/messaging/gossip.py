@@ -7,26 +7,40 @@ native-codec transports. ``broadcast`` wraps the message in a
 ``GossipEnvelope`` (fresh 128-bit id, TTL ~ log2(N) + margin) and sends it
 to the origin itself plus ``fanout`` random members; receivers relay with
 TTL-1 and deliver the payload locally exactly once, deduping by envelope
-id. Relaying uses blind-counter rumor mongering: a node relays an envelope
-on each of its first ``relay_budget`` sightings (not only the first), which
-lifts per-node delivery probability from ~1-e^-fanout to
-~1-e^-(fanout*relay_budget) for a few extra relays. Per-broadcast cost at
-the origin drops from O(N) sends to O(fanout), traded for
-O(N*fanout*relay_budget) total relay traffic spread across the membership
--- the standard epidemic trade. The reference's own evaluation keeps
-unicast-to-all, so parity defaults stay unchanged; this is opt-in via
+id. Two relay disciplines:
+
+- ``mode="eager"`` (default): blind-counter rumor mongering -- a node
+  relays the full envelope on each of its first ``relay_budget`` sightings
+  (not only the first), which lifts per-node delivery probability from
+  ~1-e^-fanout to ~1-e^-(fanout*relay_budget) for a few extra relays, at
+  ~fanout*relay_budget duplicate payload receptions per node.
+- ``mode="pushpull"`` (anti-entropy): the full payload is relayed eagerly
+  only on the FIRST sighting; later sightings (up to ``relay_budget``) send
+  a tiny IHAVE advertisement instead. A node that sees an IHAVE for an id
+  it has not received PULLs the payload from the advertiser, which answers
+  from its recent-envelope store. Payload redundancy drops toward ~fanout
+  receptions per node while the IHAVE/PULL legs recover the reliability the
+  withheld duplicates provided -- the classic push-pull epidemic repair
+  (the lazy-push/graft shape of Plumtree). Measured by
+  experiments/message_load.py (table in BASELINE.md).
+
+Per-broadcast cost at the origin drops from O(N) sends to O(fanout), traded
+for relay traffic spread across the membership -- the standard epidemic
+trade. The reference's own evaluation keeps unicast-to-all, so parity
+defaults stay unchanged; this is opt-in via
 ``ClusterBuilder.set_broadcaster_factory``.
 
 Delivery is probabilistic-complete, and the membership protocol tolerates
 residual loss by design (the cut detector aggregates K independent
 observers; consensus needs 3/4, not all, votes); the convergence tests
-drive full cut/join cycles over this broadcaster to pin that end-to-end.
+drive full cut/join cycles over both modes to pin that end-to-end.
 """
 
 from __future__ import annotations
 
 import math
 import random
+import time
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
@@ -34,7 +48,18 @@ from ..runtime.futures import Promise
 from ..types import Endpoint, GossipEnvelope, NodeId, RapidMessage
 from .base import IBroadcaster, IMessagingClient
 
-_SEEN_CAP = 8192  # bounded dedup memory; ids are per-broadcast random
+# Dedup memory is bounded by BOTH a size floor and an age floor: an entry is
+# only evicted once the table exceeds the cap AND the entry is older than
+# _SEEN_MIN_AGE_S (a generous bound on how long an envelope can still be
+# circulating: TTL relay hops at network latency). Evicting a still-live
+# envelope would make it look first-seen again -- duplicate local delivery
+# plus a fresh relay budget (traffic amplification). Under sustained load the
+# table therefore grows to (broadcast rate x age window), the correct bound,
+# instead of silently re-admitting live envelopes. The cap also scales with
+# membership so big clusters (more concurrent broadcasts) get more room.
+_SEEN_CAP = 8192
+_SEEN_MIN_AGE_S = 30.0
+_PULL_RETRY_S = 1.0  # re-pull an unanswered id on a fresh IHAVE after this
 
 
 class GossipBroadcaster(IBroadcaster):
@@ -46,17 +71,27 @@ class GossipBroadcaster(IBroadcaster):
         relay_budget: int = 2,
         ttl: Optional[int] = None,
         rng: Optional[random.Random] = None,
+        mode: str = "eager",
     ) -> None:
+        assert mode in ("eager", "pushpull"), mode
         self._client = client
         self._my_addr = my_addr
         self._fanout = fanout
         self._relay_budget = relay_budget
         self._ttl_override = ttl
         self._rng = rng if rng is not None else random.Random()
+        self._mode = mode
         self._members: List[Endpoint] = []
         self._others: List[Endpoint] = []  # cached non-self peer pool
-        # envelope id -> sightings so far (blind-counter rumor mongering)
-        self._seen: "OrderedDict[Tuple[int, int], int]" = OrderedDict()
+        # envelope id -> (sightings so far, first-seen monotonic time,
+        # stored relay envelope for answering pulls -- pushpull mode only);
+        # insertion order == age order, so eviction pops from the front
+        self._seen: "OrderedDict[Tuple[int, int], Tuple[int, float, Optional[GossipEnvelope]]]" = (
+            OrderedDict()
+        )
+        # ids pulled but not yet received (id -> request monotonic time);
+        # bounds repeat pulls while an answer is in flight
+        self._pending_pulls: dict = {}
 
     # -- IBroadcaster --------------------------------------------------------
 
@@ -85,14 +120,26 @@ class GossipBroadcaster(IBroadcaster):
 
     def receive(self, env: GossipEnvelope) -> Optional[RapidMessage]:
         """Called by the membership service for every inbound envelope.
-        Relays on each of the first ``relay_budget`` sightings (TTL-1 to
-        ``fanout`` random members); returns the payload for local delivery
-        on the FIRST sighting only, None afterwards."""
+
+        PAYLOAD frames: relays on each of the first ``relay_budget``
+        sightings (TTL-1 to ``fanout`` random members) -- the full envelope
+        every time in eager mode, the full envelope on the first sighting
+        and tiny IHAVE advertisements afterwards in pushpull mode; returns
+        the payload for local delivery on the FIRST sighting only, None
+        afterwards. IHAVE/PULL frames run the anti-entropy repair and never
+        deliver locally."""
+        if env.kind == GossipEnvelope.KIND_IHAVE:
+            self._on_ihave(env)
+            return None
+        if env.kind == GossipEnvelope.KIND_PULL:
+            self._on_pull(env)
+            return None
         key = (env.gossip_id.high, env.gossip_id.low)
-        sightings = self._seen.get(key, 0)
-        self._seen[key] = sightings + 1
-        while len(self._seen) > _SEEN_CAP:
-            self._seen.popitem(last=False)
+        now = time.monotonic()
+        self._pending_pulls.pop(key, None)
+        prior = self._seen.get(key)
+        sightings, first_seen = (prior[0], prior[1]) if prior else (0, now)
+        relay: Optional[GossipEnvelope] = None
         if sightings < self._relay_budget and env.ttl > 0:
             relay = GossipEnvelope(
                 sender=self._my_addr,
@@ -100,8 +147,70 @@ class GossipBroadcaster(IBroadcaster):
                 ttl=env.ttl - 1,
                 payload=env.payload,
             )
-            self._send(relay, include_self=False)
+        # pushpull answers later pulls from this store; eager never pulls
+        stored = None
+        if self._mode == "pushpull":
+            stored = prior[2] if prior else None
+            if stored is None:
+                stored = relay if relay is not None else GossipEnvelope(
+                    sender=self._my_addr, gossip_id=env.gossip_id, ttl=0,
+                    payload=env.payload,
+                )
+        if key in self._seen:  # preserve age order: do not move to the end
+            self._seen[key] = (sightings + 1, first_seen, stored)
+        else:
+            self._seen[key] = (1, first_seen, stored)
+        cap = max(_SEEN_CAP, 4 * len(self._members))
+        while len(self._seen) > cap:
+            _, entry = next(iter(self._seen.items()))
+            if now - entry[1] < _SEEN_MIN_AGE_S:
+                break  # everything old enough is gone; let the table grow
+            self._seen.popitem(last=False)
+        if relay is not None:
+            if self._mode == "pushpull" and sightings > 0:
+                # anti-entropy: advertise instead of re-pushing the payload
+                ihave = GossipEnvelope(
+                    sender=self._my_addr,
+                    gossip_id=env.gossip_id,
+                    ttl=env.ttl - 1,
+                    kind=GossipEnvelope.KIND_IHAVE,
+                )
+                self._send(ihave, include_self=False)
+            else:
+                self._send(relay, include_self=False)
         return env.payload if sightings == 0 else None
+
+    def _on_ihave(self, env: GossipEnvelope) -> None:
+        """An advertisement: pull the payload from the advertiser iff the id
+        is unseen and no pull is already in flight (re-pull after a timeout,
+        so a lost answer is repaired by the next advertisement)."""
+        key = (env.gossip_id.high, env.gossip_id.low)
+        if key in self._seen:
+            return
+        now = time.monotonic()
+        asked = self._pending_pulls.get(key)
+        if asked is not None and now - asked < _PULL_RETRY_S:
+            return
+        if len(self._pending_pulls) > _SEEN_CAP:
+            self._pending_pulls.clear()  # stale flood; repairs re-request
+        self._pending_pulls[key] = now
+        pull = GossipEnvelope(
+            sender=self._my_addr,
+            gossip_id=env.gossip_id,
+            ttl=0,
+            kind=GossipEnvelope.KIND_PULL,
+        )
+        self._client.send_message_best_effort(env.sender, pull)
+
+    def _on_pull(self, env: GossipEnvelope) -> None:
+        """Answer a pull from the recent-envelope store (best effort: an
+        evicted or never-stored id is simply not answered; the puller
+        retries on the next advertisement)."""
+        key = (env.gossip_id.high, env.gossip_id.low)
+        entry = self._seen.get(key)
+        if entry is None or entry[2] is None:
+            return
+        self._client.send_message_best_effort(env.sender, entry[2])
 
     # -- internals -----------------------------------------------------------
 

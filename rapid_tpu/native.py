@@ -32,19 +32,34 @@ def build(quiet: bool = False) -> str:
     return _LIB_PATH
 
 
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    src = os.path.join(_NATIVE_DIR, "rapid_native.cpp")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
+
+
 def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
-    """Load the library, optionally building it on first use. None if
-    unavailable (callers fall back to numpy)."""
+    """Load the library, optionally building it on first use. Rebuilds when
+    the source is newer than the binary so edits are never shadowed by a
+    stale .so. None if unavailable (callers fall back to numpy)."""
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    if _stale():
         if not auto_build:
-            return None
-        try:
-            build(quiet=True)
-        except Exception:  # noqa: BLE001 -- no toolchain: numpy fallback
-            return None
+            # never build here: load the (possibly stale) binary if present
+            if not os.path.exists(_LIB_PATH):
+                return None
+        else:
+            try:
+                build(quiet=True)
+            except Exception:  # noqa: BLE001 -- no toolchain: numpy fallback
+                if not os.path.exists(_LIB_PATH):
+                    return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

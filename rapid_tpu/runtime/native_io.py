@@ -35,20 +35,37 @@ EV_CLOSED = 2
 EV_SHUTDOWN = -1
 
 
+def _needs_build() -> bool:
+    """True when the .so is absent or older than its source — a stale binary
+    (e.g. built on another machine, or predating an edit to rapid_io.cpp)
+    must never silently shadow the current source."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    src = os.path.join(_NATIVE_DIR, "rapid_io.cpp")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
+
+
 def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    if _needs_build():
         if not auto_build:
-            return None
-        try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "librapid_io.so"],
-                check=True, capture_output=True,
-            )
-        except Exception:  # noqa: BLE001 -- no toolchain: Python fallback
-            return None
+            # never build here: load the (possibly stale) binary if present
+            if not os.path.exists(_LIB_PATH):
+                return None
+        else:
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "-B", "librapid_io.so"],
+                    check=True, capture_output=True,
+                )
+            except Exception:  # noqa: BLE001 -- no toolchain: fallback
+                if not os.path.exists(_LIB_PATH):
+                    return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

@@ -134,7 +134,13 @@ class MembershipService:
     # ------------------------------------------------------------------ #
 
     def handle_message(self, msg: RapidMessage) -> Promise:
-        self.metrics.incr(f"messages.{type(msg).__name__}")
+        name = type(msg).__name__
+        if isinstance(msg, GossipEnvelope) and msg.kind != GossipEnvelope.KIND_PAYLOAD:
+            # payload-free anti-entropy control frames (IHAVE/PULL) are
+            # counted apart: the redundancy measurement in
+            # experiments/message_load.py compares payload receptions
+            name += ".control"
+        self.metrics.incr(f"messages.{name}")
         if isinstance(msg, PreJoinMessage):
             return self._handle_pre_join(msg)
         if isinstance(msg, JoinMessage):
@@ -298,8 +304,19 @@ class MembershipService:
     ) -> bool:
         """Drop stale/invariant-violating alerts (MembershipService.java:633-664)."""
         if alert.configuration_id != current_configuration_id:
+            if alert.edge_status == EdgeStatus.UP:
+                LOG.debug(
+                    "%s: dropping stale UP alert for %s (alert config %d, "
+                    "current %d)",
+                    self._my_addr, alert.edge_dst, alert.configuration_id,
+                    current_configuration_id,
+                )
             return False
         if alert.edge_status == EdgeStatus.UP and self._view.is_host_present(alert.edge_dst):
+            LOG.debug(
+                "%s: dropping UP alert for already-present %s",
+                self._my_addr, alert.edge_dst,
+            )
             return False
         if alert.edge_status == EdgeStatus.DOWN and not self._view.is_host_present(
             alert.edge_dst
@@ -348,6 +365,30 @@ class MembershipService:
     # ------------------------------------------------------------------ #
 
     def _decide_view_change(self, proposal: List[Endpoint]) -> None:
+        # A decided proposal can reference a joiner whose UUID-carrying UP
+        # alerts this node never processed (every alert delivery is
+        # best-effort; the quorum of votes can arrive anyway). Applying a
+        # partial view change would silently fork this node's configuration
+        # id; the reference would NPE here (its assert at
+        # MembershipService.java:396 is disabled at runtime and
+        # joinerUuid.remove returns null). Instead: refuse the whole view
+        # change and stay on the current configuration -- Rapid's answer to
+        # a node that falls behind is removal and rejoin, and the stale
+        # traffic this node keeps emitting triggers exactly that repair.
+        missing = [
+            node for node in proposal
+            if not self._view.is_host_present(node)
+            and node not in self._joiner_uuid
+        ]
+        if missing:
+            self.metrics.incr("view_changes_refused_missing_identity")
+            LOG.error(
+                "%s: refusing view change at config %d: no joiner identity "
+                "for %s (UP alerts lost); staying behind for removal+rejoin",
+                self._my_addr, self._view.get_current_configuration_id(),
+                [str(node) for node in missing],
+            )
+            return
         self._cancel_failure_detectors()
         status_changes: List[NodeStatusChange] = []
         for node in proposal:
@@ -358,7 +399,6 @@ class MembershipService:
                 )
                 self._metadata_manager.remove_node(node)
             else:
-                assert node in self._joiner_uuid, f"no joiner UUID stashed for {node}"
                 node_id = self._joiner_uuid.pop(node)
                 self._view.ring_add(node, node_id)
                 metadata = self._joiner_metadata.pop(node, ())

@@ -145,6 +145,7 @@ def state_shardings(mesh: Mesh) -> SimState:
         voted=rep,
         vote_prop=rep,
         vote_new=rep,
+        vote_hist=rep,
         votes_recv=rep,
         classic_rnd=rep,
         classic_vrnd=rep,

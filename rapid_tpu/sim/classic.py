@@ -39,6 +39,18 @@ manufacture a decision, exactly as lost gRPC traffic starves the reference's
 coordinator (Paxos.java:160-236). Acceptor state still advances for every
 acceptor that heard the broadcast, even when its response is lost on the way
 back.
+
+Heterogeneous latency rides the exchange too (one fabric carries every
+message type, UnicastToAllBroadcaster.java:46-52): acceptor a's phase
+response arrives at the coordinator ``2 + delay[group(a), coord] +
+delay[group(coord), a]`` rounds after the phase broadcast (one round per
+hop, the same quantization as the fast-round vote hop, plus each hop's
+per-(group, sender) delay). The coordinator proceeds the moment a majority
+of the membership has responded (Paxos.java:160-190 collects exactly the
+first > N/2 responses), so its phase1b inbox holds only responses that
+arrived by that cutoff -- a skewed acceptor's (vrnd, vval) report can miss
+the value pick, and the exchange bills the cutoff times instead of the flat
+four hops.
 """
 
 from __future__ import annotations
@@ -75,11 +87,38 @@ def _effective(state: SimState):
 
 
 class Phase1Summary(NamedTuple):
-    promised: jax.Array  # int32[] responders (> N/2 needed)
+    promised: jax.Array  # int32[] responders in the inbox (> N/2 needed)
     max_vrnd: jax.Array  # int32[] highest vrnd among voted responders (0=none)
     at_max: jax.Array  # int32[P] per-VALUE votes at max_vrnd (row-pooled)
     any_vval: jax.Array  # int32[P] per-VALUE votes at any vrnd (row-pooled)
     rep: jax.Array  # int32[P] canonical (lowest) row holding each row's value
+    cutoff: jax.Array  # int32[] rounds until the quorum-closing response
+
+
+def _inbox_cutoff(
+    config: SimConfig,
+    responders: jax.Array,  # bool[C] responses that will eventually arrive
+    resp_time: jax.Array,  # int32[C] per-acceptor response round-trip rounds
+    n: jax.Array,  # membership size
+):
+    """(in_inbox, cutoff): the coordinator proceeds the round its (> N/2)-th
+    response arrives (Paxos.java:160-190), so its inbox holds exactly the
+    responses whose arrival time is <= that cutoff. With no quorum the
+    cutoff is the last response's arrival (the phase fails on count). With
+    zero delays every response takes 2 rounds and this is the whole heard
+    set at cutoff 2 -- the flat four-hop exchange."""
+    max_t = 2 + 2 * config.max_delivery_delay
+    tvals = jnp.arange(2, max_t + 1, dtype=jnp.int32)  # possible arrivals
+    by_t = (
+        responders[None, :] & (resp_time[None, :] <= tvals[:, None])
+    ).sum(axis=1)  # [T] cumulative responses by each time
+    reached = by_t > (n // 2)
+    cutoff = jnp.where(
+        jnp.any(reached),
+        tvals[jnp.argmax(reached)],
+        max_t,
+    )
+    return responders & (resp_time <= cutoff), cutoff
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -89,23 +128,26 @@ def phase1(
     rank: jax.Array,
     hears_coord: jax.Array,  # bool[C] acceptor hears the coordinator's 1a/2a
     coord_hears: jax.Array,  # bool[C] coordinator hears the acceptor's 1b/2b
+    resp_time: jax.Array,  # int32[C] response round-trip (2 + both hop delays)
 ):
     """Phase1a broadcast + the aggregate of the phase1b responses.
 
     Every live acceptor that *hears the broadcast* and has ``rnd < rank``
     promises (bumps rnd) and reports its (vrnd, vval); only responses the
-    coordinator's delivery group hears enter the summary -- what its phase1b
-    inbox would actually contain (Paxos.java:135-145,160-190). Votes are
-    counted per *value*: proposal rows holding identical cut masks (a group
-    row and an extern row interned from real members' votes) pool their
-    counts through the same [P, P] equality matrix as the fast-round tally,
-    with ``rep`` naming each value's canonical row."""
+    coordinator's delivery group hears, arriving by the majority cutoff,
+    enter the summary -- what its phase1b inbox would actually contain
+    (Paxos.java:135-145,160-190). Votes are counted per *value*: proposal
+    rows holding identical cut masks (a group row and an extern row interned
+    from real members' votes) pool their counts through the same [P, P]
+    equality matrix as the fast-round tally, with ``rep`` naming each
+    value's canonical row."""
     live = state.active & state.alive
     rnd, vrnd, vval = _effective(state)
     promise = live & hears_coord & (rank > rnd)
     classic_rnd = jnp.where(promise, rank, state.classic_rnd)
 
-    heard = promise & coord_hears
+    n = state.active.sum()
+    heard, cutoff = _inbox_cutoff(config, promise & coord_hears, resp_time, n)
     has_vote = heard & (vrnd > 0) & (vval >= 0)
     max_vrnd = jnp.max(jnp.where(has_vote, vrnd, 0))
     p = config.proposal_rows
@@ -125,6 +167,7 @@ def phase1(
         at_max=eq @ at_max_row,
         any_vval=eq @ any_row,
         rep=jnp.argmax(eq, axis=1).astype(jnp.int32),
+        cutoff=cutoff,
     )
     return dataclasses.replace(state, classic_rnd=classic_rnd), summary
 
@@ -137,14 +180,16 @@ def phase2(
     row: jax.Array,
     hears_coord: jax.Array,
     coord_hears: jax.Array,
+    resp_time: jax.Array,  # int32[C] response round-trip (2 + both hop delays)
 ):
     """Phase2a broadcast + the phase2b acceptance count.
 
     An acceptor that hears the broadcast accepts iff ``rnd <= rank`` and
     ``vrnd != rank`` (Paxos.java:205-213); more than N/2 acceptances decide
     (Paxos.java:229-236) -- counted from the coordinator's vantage (only
-    phase2b broadcasts its group hears), a conservative stand-in for the
-    reference's any-node-with-majority-decides."""
+    phase2b broadcasts its group hears, arriving by the majority cutoff), a
+    conservative stand-in for the reference's any-node-with-majority-decides.
+    Returns (state, acceptances in the inbox, cutoff rounds)."""
     live = state.active & state.alive
     rnd, vrnd, _ = _effective(state)
     accept = live & hears_coord & (rank >= rnd) & (vrnd != rank)
@@ -154,7 +199,11 @@ def phase2(
         classic_vrnd=jnp.where(accept, rank, state.classic_vrnd),
         classic_vval=jnp.where(accept, row, state.classic_vval),
     )
-    return state, (accept & coord_hears).sum()
+    n = state.active.sum()
+    in_inbox, cutoff = _inbox_cutoff(
+        config, accept & coord_hears, resp_time, n
+    )
+    return state, in_inbox.sum(), cutoff
 
 
 class ClassicCoordinator:
@@ -175,14 +224,27 @@ class ClassicCoordinator:
         group_of = sim.group_of
         self._hears_coord = jnp.asarray(deliver[group_of, slot])
         self._coord_hears = jnp.asarray(deliver[group_of[slot], :])
+        # ... and the latency plane: acceptor a's phase response arrives
+        # 2 + delay[group(a), coord] + delay[group(coord), a] rounds after
+        # the phase broadcast (base one round per hop, each hop skewed by
+        # the same per-(group, sender) delay as alert/vote broadcasts)
+        delay = sim._deliver_delay  # noqa: SLF001 -- [G, C] host fault plane
+        self._resp_time = jnp.asarray(
+            2 + delay[group_of, slot] + delay[group_of[slot], :],
+            dtype=jnp.int32,
+        )
+        # rounds the exchange has billed so far (phase cutoffs; 4 with no
+        # delays -- the flat 1a/1b/2a/2b hops)
+        self.elapsed_rounds = 0
 
     def phase1(self) -> bool:
         """Run phase1a/1b; True iff a majority of the membership promised."""
         self.sim.state, summary = phase1(
             self.sim.config, self.sim.state, jnp.int32(self.rank),
-            self._hears_coord, self._coord_hears,
+            self._hears_coord, self._coord_hears, self._resp_time,
         )
         self._summary = jax.device_get(summary)
+        self.elapsed_rounds += int(self._summary.cutoff)
         n = int(self.sim.active.sum())
         return int(self._summary.promised) > n // 2
 
@@ -213,9 +275,12 @@ class ClassicCoordinator:
         """Run phase2a/2b for ``row``; returns the row iff a majority
         accepted (the decision), else None (outranked by a concurrent
         coordinator)."""
-        self.sim.state, accepted = phase2(
+        self.sim.state, accepted, cutoff = phase2(
             self.sim.config, self.sim.state, jnp.int32(self.rank),
             jnp.int32(row), self._hears_coord, self._coord_hears,
+            self._resp_time,
         )
+        accepted, cutoff = jax.device_get((accepted, cutoff))
+        self.elapsed_rounds += int(cutoff)
         n = int(self.sim.active.sum())
-        return row if int(jax.device_get(accepted)) > n // 2 else None
+        return row if int(accepted) > n // 2 else None

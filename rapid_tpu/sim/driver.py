@@ -46,7 +46,10 @@ from .topology import (
 
 
 # the classic exchange's delivery hops (phase1a, 1b, 2a, 2b), billed at the
-# same one-round-per-hop quantization as fast-round vote propagation
+# same one-round-per-hop quantization as fast-round vote propagation; under
+# heterogeneous latency the winning coordinator's actual phase cutoffs are
+# billed instead (sim/classic.py: each phase closes when the majority's
+# responses have arrived)
 _CLASSIC_ROUND_HOPS = 4
 
 
@@ -133,6 +136,9 @@ class Simulator:
         never silently diverge from freshly-constructed ones."""
         capacity = self.config.capacity
         self._sharded_runs: dict = {}
+        # configuration-id memo; invalidated whenever its inputs (active
+        # membership / identifier history) change, i.e. at view changes
+        self._config_id: Optional[int] = None
         # speculative view-change precomputation (see _speculate_view_change):
         # (new-active bytes, seed, config id, fresh SimState, alive bytes).
         # Must exist before the first _fresh_state call below.
@@ -727,16 +733,18 @@ class Simulator:
                     classic_fallback_after_rounds is not None
                     and stalled_rounds >= classic_fallback_after_rounds
                 ):
-                    winner = self._run_classic_round()
+                    winner, exchange_rounds = self._run_classic_round()
                     if winner is not None:
                         # no need to write the decision back to the device:
                         # _apply_view_change consumes the fetched arrays and
-                        # replaces the device state wholesale. The exchange's
-                        # four hops (1a/1b/2a/2b) bill as four rounds, like
-                        # every other delivery hop.
+                        # replaces the device state wholesale. The exchange
+                        # bills its hops (1a/1b/2a/2b -- four rounds with no
+                        # latency skew, the winning coordinator's actual
+                        # majority cutoffs otherwise) like every other
+                        # delivery hop.
                         record = self._apply_view_change(
                             t0, (proposal_np, winner,
-                                 int(round_np) + _CLASSIC_ROUND_HOPS)
+                                 int(round_np) + exchange_rounds)
                         )
                         record.via_classic_round = True
                         return record
@@ -852,7 +860,7 @@ class Simulator:
             )
         return self._sharded_runs[key]
 
-    def _run_classic_round(self) -> Optional[int]:
+    def _run_classic_round(self) -> Tuple[Optional[int], int]:
         """One classic recovery attempt with per-node acceptor state on
         device (sim/classic.py). Every live node's expovariate fallback timer
         races (FastPaxos.java:200-203: delay ~ Exp(1/N), so ~1 start/sec
@@ -863,19 +871,22 @@ class Simulator:
         acceptors (rank checks + the Fig.-2 value pick), not on any host-side
         single-coordinator shortcut. The attempt's round number grows with
         each failure, so retries outrank earlier rounds. Recovery traffic
-        rides the delivery-group fault plane (see sim/classic.py).
+        rides the delivery-group fault plane AND the latency plane (see
+        sim/classic.py).
 
-        Returns the decided proposal row, or None if the attempt failed
-        (no quorum, no valid vote reported, or every coordinator outranked).
+        Returns (decided proposal row or None, the winning coordinator's
+        exchange rounds to bill -- _CLASSIC_ROUND_HOPS when the attempt
+        failed or no latency skew is active).
         """
         from .classic import RANK_BITS, ClassicCoordinator
 
         live = self.active & self.alive
         n = int(self.active.sum())
         if int(live.sum()) <= n // 2:
-            return None
+            return None, _CLASSIC_ROUND_HOPS
         if 2 + self._classic_attempts >= (1 << (31 - RANK_BITS)):
-            return None  # rank space exhausted: stay stalled gracefully
+            # rank space exhausted: stay stalled gracefully
+            return None, _CLASSIC_ROUND_HOPS
         self._classic_attempts += 1
         live_slots = np.flatnonzero(live)
         # expovariate arrival times, mean n per node => cluster-wide the
@@ -901,6 +912,7 @@ class Simulator:
         # arbitrate both interleavings
         promised = [c.phase1() for c in coordinators]
         decided = None
+        exchange_rounds = _CLASSIC_ROUND_HOPS
         for coordinator, ok in zip(coordinators, promised):
             if not ok:
                 continue
@@ -910,9 +922,10 @@ class Simulator:
             won = coordinator.phase2(row)
             if won is not None and decided is None:
                 decided = won
+                exchange_rounds = coordinator.elapsed_rounds
         if racing > 1:
             self.metrics.incr("classic_coordinator_races")
-        return decided
+        return decided, exchange_rounds
 
     def _apply_view_change(
         self,
@@ -920,6 +933,7 @@ class Simulator:
         fetched: Tuple[np.ndarray, int, int],  # (proposal[G,C], group, round)
     ) -> ViewChangeRecord:
         self.metrics.incr("view_changes")
+        self._config_id = None  # membership / identifier history change below
         proposal_np, decided_group, decided_round = fetched
         # the winning proposal row's value is the decided cut
         cut = proposal_np[int(decided_group)]
@@ -989,19 +1003,27 @@ class Simulator:
         """Bit-exact configuration identity of the current membership.
 
         Element hashes are cached (endpoint hashes on the cluster, identifier
-        hashes on the append-only history); only the fold over the current
-        ordering runs per view change -- and when the speculative worker
-        already folded this exact membership, not even that."""
+        hashes on the append-only history); the fold over the current
+        ordering runs once per configuration (its inputs -- the active mask
+        and the identifier history -- mutate only at view changes, which
+        invalidate the memo), and when the speculative worker already folded
+        this exact membership, not even that. The memo matters at scale: the
+        bridge stamps/validates every real-member message with this id, and
+        a 100k fold per received vote would dwarf the protocol itself."""
+        if self._config_id is not None:
+            return self._config_id
         if self._spec is not None and self._spec[0] == self.active.tobytes():
             self.metrics.incr("speculation_hits_config_id")
-            return self._spec[2]
+            self._config_id = self._spec[2]
+            return self._config_id
         _, _, host_h, port_h = self.cluster.node_hashes()
         order = self._sorted_identifiers()
         seen_h = self._seen_id_hashes()
         order0 = ring_order(self.cluster, self.active, 0)
-        return config_fold(
+        self._config_id = config_fold(
             seen_h[order, 0], seen_h[order, 1], host_h[order0], port_h[order0]
         )
+        return self._config_id
 
     def sorted_identifiers(self) -> np.ndarray:
         """The identifier history as [M, 2] (high, low) values in NodeId

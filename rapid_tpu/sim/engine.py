@@ -23,9 +23,11 @@ fast-round vote -- for its own cut detector's proposal, i.e. its delivery
 group's -- the round that proposal is announced, guarded by a per-sender
 dedup latch (``voted``, the votesReceived set of FastPaxos.java:134-141). The
 vote broadcast is itself a delivery hop: votes cast in round t are in flight
-(``vote_new``) and arrive in round t+1, gated per receiving group by the same
-``deliver`` fault mask as alert broadcasts (a dropped vote is lost, exactly
-like the reference's best-effort unicast). Each group tallies the votes it
+(``vote_new``) and arrive in round t+1 (plus the per-(group, sender)
+``deliver_delay`` under heterogeneous latency -- one fabric carries alerts
+and votes alike), gated per receiving group by the same ``deliver`` fault
+mask as alert broadcasts (a dropped vote is lost, exactly like the
+reference's best-effort unicast). Each group tallies the votes it
 received (``votes_recv``); identical proposals pool their votes; a cut decides
 when some group's tally holds N - floor((N-1)/4) votes for one value
 (FastPaxos.java:145-150). ``decided_round`` therefore always bills at least
@@ -90,16 +92,19 @@ class SimConfig:
     # votes into these rows). 0 = all-simulated cluster.
     extern_proposals: int = 0
     # Heterogeneous broadcast LATENCY (the paper's Fig.-11 conflict regime):
-    # an alert from sender s reaches group g ``deliver_delay[g, s]`` rounds
-    # after it fires (0..max_delivery_delay). Nothing is lost -- but groups
-    # see different interleavings of the alert stream, so with staggered FD
-    # phases they can cross H at different times holding different report
-    # snapshots and propose *different* cuts, purely from timing. 0 disables
-    # the delay buffer entirely (static). Scope: the delay applies to ALERT
-    # traffic only -- join reports and the fast-round vote hop always arrive
-    # exactly one round after casting (votes are shaped by the ``deliver``
-    # drop mask, not by latency); the conflict regime this models needs only
-    # the alert stream to skew.
+    # a broadcast from sender s reaches group g ``deliver_delay[g, s]``
+    # EXTRA rounds late (0..max_delivery_delay). Nothing is lost -- but
+    # groups see different interleavings, so with staggered FD phases they
+    # can cross H at different times holding different report snapshots and
+    # propose *different* cuts, purely from timing. 0 disables the delay
+    # buffers entirely (static). One fabric carries every message type
+    # (UnicastToAllBroadcaster.java:46-52: alerts, votes, and recovery all
+    # ride the same sendRequest RPC), so the delay applies uniformly: DOWN
+    # alerts arrive at fire + delay, fast-round votes arrive at cast + 1 +
+    # delay (the base one-round vote hop, skewed like any broadcast), and
+    # the classic recovery exchange's per-acceptor hop times carry the same
+    # per-edge delays (sim/classic.py via driver._run_classic_round). Join
+    # reports stay delay-0: the experiment axis is failure timing.
     max_delivery_delay: int = 0
 
     def __post_init__(self) -> None:
@@ -150,6 +155,7 @@ class SimState:
     voted: jax.Array  # bool[C] fast-round per-sender dedup latch
     vote_prop: jax.Array  # int32[C] proposal row each voter voted for
     vote_new: jax.Array  # bool[C] votes cast this round, arriving next round
+    vote_hist: jax.Array  # bool[Dmax, C] votes in flight, cast 2+d rounds ago
     votes_recv: jax.Array  # bool[G, C] votes received per (group, sender)
     # Classic-Paxos acceptor state (sim/classic.py; Paxos.java:63-70). Ranks
     # are (round, node) pairs packed into int32 (round << RANK_BITS | node);
@@ -211,6 +217,7 @@ def initial_state(
         voted=jnp.zeros(c, bool),
         vote_prop=jnp.zeros(c, jnp.int32),
         vote_new=jnp.zeros(c, bool),
+        vote_hist=jnp.zeros((config.max_delivery_delay, c), bool),
         votes_recv=jnp.zeros((g, c), bool),
         classic_rnd=jnp.zeros(c, jnp.int32),
         classic_vrnd=jnp.zeros(c, jnp.int32),
@@ -253,7 +260,8 @@ def route_and_tally(
     otherwise, so gating is exact.
 
     Returns ``state`` with the tally-owned fields replaced (reports,
-    seen_down, announced, proposal, voted, vote_prop, vote_new, votes_recv,
+    seen_down, announced, proposal, voted, vote_prop, vote_new, vote_hist,
+    votes_recv,
     decided, decided_group, decided_round); the caller layers the FD fields
     and the round increment on top.
     """
@@ -368,7 +376,22 @@ def route_and_tally(
     # (state.vote_new) arrive now, gated per receiving group by the same
     # fault mask as any broadcast. A vote dropped on its delivery round is
     # lost for good (best-effort unicast, UnicastToAllBroadcaster.java:46-52).
-    if uniform_delivery:
+    # With heterogeneous latency the vote rides the same per-(group, sender)
+    # delay as every other broadcast: group g hears sender s's vote
+    # deliver_delay[g, s] rounds after the base one-round hop, read from the
+    # same aged-history mechanism as alerts.
+    vote_hist = state.vote_hist
+    if config.max_delivery_delay > 0:
+        vhist = jnp.concatenate(
+            [state.vote_new[None], vote_hist], axis=0
+        )  # [Dmax+1, C]; vhist[d] = votes of age 1+d rounds
+        vote_hist = vhist[: config.max_delivery_delay]
+        c_idx = jnp.arange(config.capacity, dtype=jnp.int32)[None, :]
+        arrived_votes = vhist[inputs.deliver_delay, c_idx]  # [G, C]
+        if not uniform_delivery:
+            arrived_votes = arrived_votes & inputs.deliver
+        votes_recv = state.votes_recv | arrived_votes
+    elif uniform_delivery:
         votes_recv = state.votes_recv | state.vote_new[None, :]
     else:
         votes_recv = state.votes_recv | (
@@ -408,6 +431,7 @@ def route_and_tally(
         voted=voted,
         vote_prop=vote_prop,
         vote_new=new_voters,
+        vote_hist=vote_hist,
         votes_recv=votes_recv,
         decided=decided,
         decided_group=decided_group,
@@ -692,6 +716,7 @@ def run_until_decided_const(
         & ~jnp.any(state.seen_down)
         & ~jnp.any(state.voted)
         & ~jnp.any(state.vote_new)
+        & ~jnp.any(state.vote_hist)
         & ~jnp.any(state.arrival_hist)
         & ~jnp.any(inputs.join_reports)
     )
@@ -821,6 +846,7 @@ def device_initial_state(
         voted=jnp.zeros(c, bool),
         vote_prop=jnp.zeros(c, jnp.int32),
         vote_new=jnp.zeros(c, bool),
+        vote_hist=jnp.zeros((config.max_delivery_delay, c), bool),
         votes_recv=jnp.zeros((g, c), bool),
         classic_rnd=jnp.zeros(c, jnp.int32),
         classic_vrnd=jnp.zeros(c, jnp.int32),

@@ -266,12 +266,23 @@ class GossipEnvelope:
     ``gossip_id`` dedups relays cluster-wide; ``ttl`` bounds propagation
     depth. Carried by the native codec transports (tcp / in-process /
     native-tcp); the JVM-wire-compatible gRPC transport cannot carry it
-    (rapid.proto has no such message)."""
+    (rapid.proto has no such message).
+
+    ``kind`` selects the anti-entropy sub-protocol frame (push-pull gossip
+    mode, messaging/gossip.py): PAYLOAD carries the message itself; IHAVE
+    advertises the id without the payload (tiny); PULL asks the advertiser
+    to send the payload. Pre-push-pull frames carry no ``kind`` field and
+    decode to PAYLOAD (0), so the wire stays backward compatible."""
+
+    KIND_PAYLOAD = 0
+    KIND_IHAVE = 1
+    KIND_PULL = 2
 
     sender: "Endpoint"
     gossip_id: NodeId
     ttl: int
-    payload: object  # any RapidMessage
+    payload: object = None  # any RapidMessage (None for IHAVE/PULL frames)
+    kind: int = 0
 
 
 # Any protocol request/response, for type annotations.

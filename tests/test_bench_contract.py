@@ -1,0 +1,155 @@
+"""bench.py's driver contract (VERDICT r3 items 1 & 6): the per-round
+artifact must distinguish infrastructure outage (rc 17) from perf
+regression (rc 18) from success (rc 0), survive a partial sweep failure,
+and carry the full scaling curve in the one JSON line.
+
+The measurement math itself is guarded by test_bench_regression.py; these
+tests cover the orchestration with the device layer stubbed out, so they
+run in the plain CPU battery with no tunnel dependency.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+class _FakeRecord:
+    virtual_time_ms = 11_100
+    configuration_id = -42
+    membership_size = bench.N_NODES - 1000
+
+    cut = list(range(1000))
+
+
+def _fake_warmed_run(wall_ms):
+    def run(n_nodes, seed, fail_fraction=bench.FAIL_FRACTION):
+        return wall_ms, _FakeRecord(), 1.0, 2.0
+
+    return run
+
+
+def test_probe_gives_up_after_bounded_retries(monkeypatch):
+    attempts = []
+    monkeypatch.setattr(
+        bench, "_probe_backend_once", lambda t: attempts.append(t) or None
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.probe_backend() is None
+    assert tuple(attempts) == bench.PROBE_TIMEOUTS_S  # bounded, not forever
+
+
+def test_probe_returns_first_success(monkeypatch):
+    calls = [None, "tpu"]
+    monkeypatch.setattr(bench, "_probe_backend_once", lambda t: calls.pop(0))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.probe_backend() == "tpu"
+
+
+def test_unreachable_accelerator_exits_17(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_arm_watchdog", lambda: None)
+    monkeypatch.setattr(bench, "probe_backend", lambda: None)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 17
+    assert capsys.readouterr().out == ""  # no JSON: nothing was measured
+
+
+def test_budget_breach_prints_json_then_exits_18(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_arm_watchdog", lambda: None)
+    monkeypatch.setattr(bench, "probe_backend", lambda: "tpu")
+    monkeypatch.setattr(bench, "warmed_run", _fake_warmed_run(bench.TPU_BUDGET_MS + 50))
+    monkeypatch.setattr(bench, "run_sweep", lambda backend, seed: [])
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 18
+    # the measurement is still the artifact: JSON printed before the rc
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["value"] == bench.TPU_BUDGET_MS + 50
+    assert parsed["backend"] == "tpu"
+
+
+def test_success_emits_sweep_curve(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_arm_watchdog", lambda: None)
+    monkeypatch.setattr(bench, "probe_backend", lambda: "tpu")
+    monkeypatch.setattr(bench, "warmed_run", _fake_warmed_run(120.0))
+    monkeypatch.setattr(
+        bench,
+        "run_sweep",
+        lambda backend, seed: [
+            {"n": 1_000, "warmed_wall_ms": 30.0, "virtual_ms": 11_100, "cut_ok": True},
+            {"n": 1_000_000, "warmed_wall_ms": 470.0, "virtual_ms": 11_100, "cut_ok": True},
+        ],
+    )
+    bench.main()  # rc 0: returns normally
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["value"] == 120.0
+    assert parsed["vs_baseline"] == round(120.0 / bench.BASELINE_MS, 4)
+    sizes = [e["n"] for e in parsed["sweep"]]
+    assert sizes == [1_000, 100_000, 1_000_000]  # headline folded in, sorted
+
+
+def test_cpu_wall_within_budget_is_rc0(monkeypatch, capsys):
+    """A CPU run never trips the TPU budget (the driver's TPU-side guard
+    must not misfire when the bench is exercised off-hardware)."""
+    monkeypatch.setattr(bench, "_arm_watchdog", lambda: None)
+    monkeypatch.setattr(bench, "probe_backend", lambda: "cpu")
+    monkeypatch.setattr(bench, "warmed_run", _fake_warmed_run(3000.0))
+    monkeypatch.setattr(bench, "run_sweep", lambda backend, seed: [])
+    bench.main()
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["backend"] == "cpu"
+
+
+def test_watchdog_emits_partial_artifact_after_headline(monkeypatch, capsys):
+    """A hang AFTER the headline measurement (e.g. the 1M sweep point
+    against a dying tunnel) must not destroy it: the watchdog emits the
+    JSON with the completed sweep entries plus an error marker, rc 0."""
+    monkeypatch.setitem(bench._PROGRESS, "headline",
+                        {"value": 120.0, "virtual_ms": 11_100})
+    monkeypatch.setitem(bench._PROGRESS, "backend", "tpu")
+    monkeypatch.setitem(
+        bench._PROGRESS, "sweep",
+        [{"n": 1_000, "warmed_wall_ms": 30.0, "virtual_ms": 11_100,
+          "cut_ok": True}],
+    )
+    assert bench._on_watchdog() == 0
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["value"] == 120.0
+    sizes = [e.get("n") for e in parsed["sweep"]]
+    assert sizes[:2] == [1_000, bench.N_NODES]  # error marker sorts last
+    assert "watchdog" in parsed["sweep"][-1]["error"]
+
+
+def test_watchdog_without_headline_is_rc17(monkeypatch, capsys):
+    monkeypatch.setitem(bench._PROGRESS, "headline", None)
+    assert bench._on_watchdog() == 17
+    assert capsys.readouterr().out == ""  # nothing measured: no JSON
+
+
+def test_sweep_parity_failure_crashes_the_bench(monkeypatch):
+    """A cut-parity AssertionError at a sweep size is a correctness bug:
+    it must propagate (generic nonzero rc), never become an rc-0 error
+    entry."""
+    def bad_parity(n_nodes, seed, fail_fraction=bench.FAIL_FRACTION):
+        raise AssertionError("cut-set parity violated")
+
+    monkeypatch.setattr(bench, "warmed_run", bad_parity)
+    monkeypatch.setitem(bench._PROGRESS, "sweep", [])
+    with pytest.raises(AssertionError):
+        bench.run_sweep("tpu", seed=42)
+
+
+def test_sweep_isolates_per_size_failures(monkeypatch):
+    def flaky(n_nodes, seed, fail_fraction=bench.FAIL_FRACTION):
+        if n_nodes == 10_000:
+            raise RuntimeError("boom")
+        return 50.0, _FakeRecord(), 1.0, 2.0
+
+    monkeypatch.setattr(bench, "warmed_run", flaky)
+    sweep = bench.run_sweep("tpu", seed=42)
+    by_n = {e["n"]: e for e in sweep}
+    assert by_n[1_000]["warmed_wall_ms"] == 50.0
+    assert "boom" in by_n[10_000]["error"]
+    assert by_n[1_000_000]["warmed_wall_ms"] == 50.0  # later sizes still ran

@@ -64,6 +64,47 @@ def test_receive_dedups_and_relays_with_decremented_ttl():
     assert len(client.sent) == 4
 
 
+def test_dedup_eviction_is_age_guarded(monkeypatch):
+    """Under burst load the dedup table must NOT FIFO-evict entries for
+    envelopes that could still be circulating: a live envelope evicted and
+    re-seen would be delivered locally a second time with a fresh relay
+    budget. Young entries survive beyond the size cap; old ones are evicted
+    once the cap is exceeded."""
+    import rapid_tpu.messaging.gossip as gossip_mod
+
+    client = RecordingClient()
+    me = Endpoint.from_parts("127.0.0.1", 1003)
+    g = GossipBroadcaster(client, me, fanout=0, rng=random.Random(4))
+    g.set_membership(members(4))
+    monkeypatch.setattr(gossip_mod, "_SEEN_CAP", 8)
+
+    clock = [100.0]
+    monkeypatch.setattr(gossip_mod.time, "monotonic", lambda: clock[0])
+
+    def env_for(i: int) -> GossipEnvelope:
+        return GossipEnvelope(
+            sender=members(4)[0], gossip_id=NodeId(0, i), ttl=0,
+            payload=ProbeMessage(sender=members(4)[0]),
+        )
+
+    live = env_for(0)
+    assert g.receive(live) is not None
+    # 20 more envelopes at the same instant: cap (8) exceeded but every
+    # entry is young, so nothing is evicted...
+    for i in range(1, 21):
+        g.receive(env_for(i))
+    assert len(g._seen) == 21
+    # ...and the live envelope is still deduped
+    assert g.receive(live) is None
+
+    # after the propagation window passes, new traffic evicts the old tail
+    clock[0] += gossip_mod._SEEN_MIN_AGE_S + 1.0
+    for i in range(21, 40):
+        g.receive(env_for(i))
+    assert len(g._seen) <= 21
+    assert (0, 0) not in g._seen  # the old entry aged out
+
+
 def test_receive_ttl_zero_delivers_without_relay():
     client = RecordingClient()
     me = Endpoint.from_parts("127.0.0.1", 1002)
@@ -116,6 +157,101 @@ def test_gossip_join_wave_converges():
     h.broadcaster_factory = _gossip_factory
     h.create_cluster(12, parallel=True)
     h.wait_and_verify_agreement(12)
+
+
+def test_pushpull_advertises_instead_of_repushing():
+    """Anti-entropy mode (VERDICT r3 item 8): the payload is pushed eagerly
+    only on the first sighting; the second sighting (within relay_budget)
+    sends tiny IHAVE advertisements, bounding duplicate payload traffic."""
+    client = RecordingClient()
+    me = Endpoint.from_parts("127.0.0.1", 1010)
+    g = GossipBroadcaster(client, me, fanout=2, rng=random.Random(6),
+                          mode="pushpull")
+    g.set_membership(members(10))
+    env = GossipEnvelope(
+        sender=members(10)[5], gossip_id=NodeId(9, 9), ttl=3,
+        payload=ProbeMessage(sender=members(10)[5]),
+    )
+    assert isinstance(g.receive(env), ProbeMessage)
+    assert len(client.sent) == 2  # first sighting: eager full-payload relay
+    assert all(
+        m.kind == GossipEnvelope.KIND_PAYLOAD and m.payload is not None
+        for _, m in client.sent
+    )
+    assert g.receive(env) is None  # second sighting: IHAVE only
+    assert len(client.sent) == 4
+    for _, m in client.sent[2:]:
+        assert m.kind == GossipEnvelope.KIND_IHAVE and m.payload is None
+    assert g.receive(env) is None  # budget exhausted: silence
+    assert len(client.sent) == 4
+
+
+def test_pushpull_ihave_pull_repair_roundtrip():
+    """A node that only hears an advertisement PULLs the payload from the
+    advertiser, which answers from its store -- and the pulled payload then
+    delivers locally like a first sighting."""
+    advertiser_client, holder_client = RecordingClient(), RecordingClient()
+    adv_addr = Endpoint.from_parts("127.0.0.1", 1011)
+    hol_addr = Endpoint.from_parts("127.0.0.1", 1012)
+    advertiser = GossipBroadcaster(
+        advertiser_client, adv_addr, fanout=1, rng=random.Random(7),
+        mode="pushpull",
+    )
+    holder = GossipBroadcaster(
+        holder_client, hol_addr, fanout=1, rng=random.Random(8),
+        mode="pushpull",
+    )
+    for g in (advertiser, holder):
+        g.set_membership(members(6))
+    origin = members(6)[0]
+    env = GossipEnvelope(
+        sender=origin, gossip_id=NodeId(4, 2), ttl=2,
+        payload=ProbeMessage(sender=origin),
+    )
+    advertiser.receive(env)  # advertiser now stores the payload
+    ihave = GossipEnvelope(
+        sender=adv_addr, gossip_id=NodeId(4, 2), ttl=1,
+        kind=GossipEnvelope.KIND_IHAVE,
+    )
+    assert holder.receive(ihave) is None  # no local delivery from an IHAVE
+    pulls = [
+        (t, m) for t, m in holder_client.sent
+        if m.kind == GossipEnvelope.KIND_PULL
+    ]
+    assert len(pulls) == 1 and pulls[0][0] == adv_addr
+    # a duplicate advertisement while the pull is in flight does not re-pull
+    assert holder.receive(ihave) is None
+    assert len([
+        (t, m) for t, m in holder_client.sent
+        if m.kind == GossipEnvelope.KIND_PULL
+    ]) == 1
+    # the advertiser answers the pull with the stored payload...
+    advertiser_client.sent.clear()
+    advertiser.receive(pulls[0][1])
+    answers = [
+        m for _, m in advertiser_client.sent
+        if m.kind == GossipEnvelope.KIND_PAYLOAD
+    ]
+    assert len(answers) == 1 and isinstance(answers[0].payload, ProbeMessage)
+    # ...and the puller delivers it as a first sighting
+    assert isinstance(holder.receive(answers[0]), ProbeMessage)
+
+
+def test_cluster_converges_on_pushpull_gossip():
+    """Full protocol over the anti-entropy mode: 16 nodes, two crash, exact
+    cut, identical configuration ids everywhere."""
+    h = ClusterHarness(seed=79)
+    h.broadcaster_factory = lambda client, rng: GossipBroadcaster(
+        client, client.address, fanout=4, rng=rng, mode="pushpull"
+    )
+    h.create_cluster(16, parallel=False)
+    h.wait_and_verify_agreement(16)
+    h.fail_nodes([h.addr(6), h.addr(11)])
+    h.wait_and_verify_agreement(14)
+    configs = {
+        c.get_current_configuration_id() for c in h.instances.values()
+    }
+    assert len(configs) == 1
 
 
 def test_gossip_refused_on_jvm_wire_transport():

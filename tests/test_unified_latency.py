@@ -1,0 +1,147 @@
+"""One fabric, one latency model (VERDICT r3 item 3): the reference sends
+alerts, votes, and recovery messages over the same transport
+(UnicastToAllBroadcaster.java:46-52 -- one sendRequest RPC for every type in
+rapid.proto:9-11), so network delay skews all of them alike. These tests pin
+that SimConfig.max_delivery_delay applies to the fast-round vote hop and the
+classic recovery exchange, not just the alert stream -- and that delaying one
+member's vote delays the decision identically in the simulation plane and
+the object plane.
+"""
+
+import numpy as np
+
+from harness import ClusterHarness
+from rapid_tpu.events import ClusterEvents
+from rapid_tpu.sim.classic import ClassicCoordinator
+from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.sim.engine import SimConfig
+from rapid_tpu.types import FastRoundPhase2bMessage
+
+N = 8  # with one crash, quorum = N - (N-1)//4 = 7 = every live vote
+
+
+def _non_observer_member(sim: Simulator, victim: int) -> int:
+    """A live member that observes the victim on zero rings: delaying ALL of
+    its broadcasts is behaviorally identical to delaying only its vote (it
+    contributes no DOWN alert for this cut), which is what the object-plane
+    half of the cross-plane test delays."""
+    observers = set(int(o) for o in np.asarray(sim.state.observers)[victim])
+    for m in range(N):
+        if m != victim and m not in observers:
+            return m
+    raise AssertionError("no non-observer member for this seed")
+
+
+def _sim_decision_ms(delay_rounds: int, seed: int = 5) -> int:
+    config = SimConfig(capacity=N, fd_interval_ms=100, max_delivery_delay=3)
+    sim = Simulator(N, config=config, seed=seed)
+    victim = 4
+    if delay_rounds:
+        m = _non_observer_member(sim, victim)
+        sim.delay_broadcasts(0, np.array([m]), delay_rounds)
+    sim.crash(np.array([victim]))
+    rec = sim.run_until_decision(max_rounds=32, batch=32,
+                                 classic_fallback_after_rounds=None)
+    assert rec is not None and list(rec.cut) == [victim]
+    return rec.virtual_time_ms
+
+
+def test_sim_vote_delay_shifts_decision_by_exact_rounds():
+    """Quorum needs every live vote; the delayed member contributes no alert
+    for the cut, so the ONLY thing its delay skews is its vote -- and the
+    decision shifts by exactly that many rounds."""
+    base = _sim_decision_ms(0)
+    for d in (1, 2, 3):
+        assert _sim_decision_ms(d) - base == d * 100, f"delay_rounds={d}"
+
+
+def _object_decision_shift_ms(delay_ms: int, n: int = N) -> int:
+    """Virtual time from the failure to the seed's VIEW_CHANGE, with the
+    FastRoundPhase2bMessage (and only it) from one live member delayed --
+    the per-type filter isolates the vote hop exactly, mirroring the sim
+    half's non-observer construction."""
+    harness = ClusterHarness(seed=11)
+    fired = []
+    harness.start_seed(
+        0,
+        subscriptions=[
+            (ClusterEvents.VIEW_CHANGE,
+             lambda _cid, _changes: fired.append(harness.scheduler.now_ms()))
+        ],
+    )
+    for i in range(1, n):
+        harness.join(i)
+    delayed_member = harness.addr(1)
+    if delay_ms:
+        harness.network.add_delay(
+            lambda src, dst, msg: (
+                delay_ms
+                if isinstance(msg, FastRoundPhase2bMessage)
+                and src == delayed_member
+                else 0
+            )
+        )
+    fired.clear()
+    t_fail = harness.scheduler.now_ms()
+    harness.fail_nodes([harness.addr(n - 1)])
+    harness.wait_and_verify_agreement(n - 1, poll_ms=10)
+    harness.shutdown()
+    assert fired, "seed never saw the failure view change"
+    return fired[0] - t_fail
+
+
+def test_cross_plane_vote_delay_parity():
+    """Delaying one member's vote by D delays the decision by exactly D in
+    BOTH planes (the fabric treats votes like any broadcast; quorum waits
+    for the skewed vote)."""
+    shift_ms = 300
+    obj = _object_decision_shift_ms(shift_ms) - _object_decision_shift_ms(0)
+    sim = _sim_decision_ms(3) - _sim_decision_ms(0)  # 3 rounds x 100 ms
+    assert obj == sim == shift_ms, f"object shifted {obj}, sim {sim}"
+
+
+def _stalled_sim_with_delay(slow_acceptors: int):
+    """A genuinely stalled fast round (blind delivery class > F members) on a
+    latency-enabled config, with ``slow_acceptors`` acceptors' responses to
+    group 0 (the coordinator's group) one round late."""
+    n = 1000
+    config = SimConfig(capacity=n, groups=2, max_delivery_delay=1)
+    sim = Simulator(n, config=config, seed=7)
+    group_of = np.zeros(n, dtype=np.int32)
+    group_of[n - 260:] = 1
+    sim.set_delivery_groups(group_of)
+    victims = np.array([5, 6])
+    sim.crash(victims)
+    sim.drop_broadcasts(1, np.arange(n))  # group 1 hears nothing: stall
+    if slow_acceptors:
+        # slot 0 (the coordinator below) is NOT delayed, so its 1a/2a
+        # broadcasts land on time and only the response legs are slow
+        sim.delay_broadcasts(0, np.arange(1, 1 + slow_acceptors), 1)
+    rec = sim.run_until_decision(max_rounds=16,
+                                 classic_fallback_after_rounds=None)
+    assert rec is None, "fast round must stall for these tests"
+    return sim, victims
+
+
+def test_classic_exchange_bills_flat_hops_without_skew():
+    sim, victims = _stalled_sim_with_delay(slow_acceptors=0)
+    live = np.flatnonzero(sim.active & sim.alive)
+    c = ClassicCoordinator(sim, round_no=2, slot=int(live[0]))
+    assert c.phase1() and c.phase2(c.pick_value()) == 0
+    assert c.elapsed_rounds == 4  # 1a/1b/2a/2b, one round per hop
+
+
+def test_classic_exchange_bills_majority_cutoffs_under_skew():
+    """With 598 of the 998 live acceptors' responses one round late, the
+    coordinator's majority (>500) completes only when the slow responses
+    land: each phase closes at cutoff 3 instead of 2, and the recovery still
+    decides the stalled cut -- latency skews recovery, it never breaks it."""
+    sim, victims = _stalled_sim_with_delay(slow_acceptors=600)
+    c = ClassicCoordinator(sim, round_no=2, slot=0)
+    assert c.phase1()
+    row = c.pick_value()
+    assert row == 0 and c.phase2(row) == 0
+    assert c.elapsed_rounds == 6  # two phases, each cut off at round 3
+    np.testing.assert_array_equal(
+        np.flatnonzero(np.asarray(sim.state.proposal)[0]), victims
+    )
