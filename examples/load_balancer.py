@@ -1,0 +1,238 @@
+"""End-to-end application scenario: a workload router on live membership.
+
+The paper's closing evaluation (docs/atc-2018-camera-ready.pdf §7 Fig. 13)
+runs nginx in front of 50 backends, fails 10 of them at once, and shows
+Rapid removing the whole set in a SINGLE view change -- the application
+reroutes immediately instead of bleeding errors through ten separate
+reconfigurations. This example is that scenario on the TPU-hosted plane:
+
+- a ``SwarmGateway`` hosts N virtual backends (the simulated fleet),
+- the router is a real member: the untouched ClusterBuilder stack joined
+  through the gateway, its backend pool maintained ONLY by VIEW_CHANGE
+  subscriptions (ClusterEvents.java:19-24 -- no health checks of its own,
+  membership IS the health signal),
+- requests are routed by rendezvous (highest-random-weight) hashing over
+  the live pool, so a view change moves only the failed backends' keys,
+- a correlated burst kills 10 backends; the membership protocol cuts all
+  of them in one view change and the router's very next routes are clean.
+
+    python examples/load_balancer.py --backends 50 --fail 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+from rapid_tpu import ClusterBuilder, Cluster, Endpoint, Settings  # noqa: E402
+from rapid_tpu.events import ClusterEvents, NodeStatusChange  # noqa: E402
+from rapid_tpu.hashing import xxh64  # noqa: E402
+from rapid_tpu.messaging.gateway import (  # noqa: E402
+    GatewayRoutedClient,
+    GatewaySwarmBroadcaster,
+    SwarmGateway,
+)
+from rapid_tpu.messaging.tcp import TcpClientServer  # noqa: E402
+from rapid_tpu.types import EdgeStatus  # noqa: E402
+
+
+class ViewChangeRouter:
+    """Routes request keys over the live membership, rebalancing exactly at
+    VIEW_CHANGE events (the reference app surface: Cluster.java:98-140's
+    getters plus registerSubscription).
+
+    Rendezvous hashing: key k goes to argmax over backends b of
+    xxhash64(key_bytes, seed=hash(b)). Removing a backend only remaps the
+    keys that were on it -- the property that makes a single multi-node cut
+    a single rebalance."""
+
+    def __init__(self, cluster: Cluster, self_address: Endpoint) -> None:
+        self._self = self_address
+        self._lock = threading.Lock()
+        self._backends: List[Endpoint] = []
+        self._weight_seed: Dict[Endpoint, int] = {}
+        self.view_changes = 0
+        self.last_down: List[NodeStatusChange] = []
+        cluster.register_subscription(
+            ClusterEvents.VIEW_CHANGE, self._on_view_change
+        )
+        # the initial pool comes from the join response's configuration
+        self._set_backends(cluster.get_memberlist())
+
+    def _set_backends(self, members: List[Endpoint]) -> None:
+        backends = [m for m in members if m != self._self]
+        with self._lock:
+            self._backends = backends
+            self._weight_seed = {
+                b: xxh64(b.hostname + b"#%d" % b.port, 0) & 0x7FFFFFFF
+                for b in backends
+            }
+
+    def _on_view_change(self, config_id: int, changes) -> None:
+        with self._lock:
+            pool = {b for b in self._backends}
+        for change in changes:
+            if change.status == EdgeStatus.UP:
+                pool.add(change.endpoint)
+            else:
+                pool.discard(change.endpoint)
+        self.view_changes += 1
+        self.last_down = [
+            c for c in changes if c.status == EdgeStatus.DOWN
+        ]
+        self._set_backends(sorted(pool, key=lambda e: (e.hostname, e.port)))
+
+    def backends(self) -> List[Endpoint]:
+        with self._lock:
+            return list(self._backends)
+
+    def route(self, key: bytes) -> Optional[Endpoint]:
+        """The backend owning this key under rendezvous hashing."""
+        with self._lock:
+            if not self._backends:
+                return None
+            return max(
+                self._backends,
+                key=lambda b: xxh64(key, self._weight_seed[b]),
+            )
+
+
+def run_scenario(
+    backends: int = 50,
+    fail: int = 10,
+    seed: int = 23,
+    requests_per_check: int = 200,
+    quiet: bool = False,
+) -> Dict[str, object]:
+    """The Fig.-13 shape; returns the measurements the caller asserts on."""
+    from rapid_tpu.messaging.ports import free_port_base
+
+    def say(msg: str) -> None:
+        if not quiet:
+            print(msg)
+
+    base = free_port_base(4)
+    settings = Settings(
+        failure_detector_interval_ms=100,
+        batching_window_ms=50,
+    )
+    gateway = SwarmGateway(
+        Endpoint.from_parts("127.0.0.1", base),
+        n_virtual=backends,
+        seed=seed,
+        settings=settings,
+        pump_interval_ms=50,
+    )
+    gateway.start()
+    router_cluster = None
+    try:
+        gateway.warm()
+        router_addr = Endpoint.from_parts("127.0.0.1", base + 1)
+        transport = TcpClientServer(router_addr, settings)
+        client = GatewayRoutedClient(
+            router_addr, gateway.address, transport, settings
+        )
+        router_cluster = (
+            ClusterBuilder(router_addr)
+            .use_settings(settings)
+            .set_messaging_client_and_server(client, transport)
+            .set_broadcaster_factory(
+                lambda c, rng, routed=client: GatewaySwarmBroadcaster(routed)
+            )
+            .join(gateway.seed_endpoint(), timeout=90)
+        )
+        router = ViewChangeRouter(router_cluster, router_addr)
+        say(f"router joined: {len(router.backends())} backends live")
+        assert len(router.backends()) == backends
+
+        # steady-state traffic before the failure
+        keys = [b"req-%d" % i for i in range(requests_per_check)]
+        before = {k: router.route(k) for k in keys}
+        assert all(v is not None for v in before.values())
+
+        # the correlated burst: fail `fail` backends at once
+        victims = np.arange(2, 2 + fail)
+        victim_eps = {gateway.bridge.endpoint(int(v)) for v in victims}
+        changes_before = router.view_changes
+        gateway.bridge.sim.crash(victims)
+        say(f"crashed {fail} backends; waiting for the cut...")
+        deadline = time.time() + 120
+        while (
+            time.time() < deadline
+            and router_cluster.get_membership_size() != backends + 1 - fail
+        ):
+            time.sleep(0.05)
+        assert router_cluster.get_membership_size() == backends + 1 - fail
+
+        # Fig. 13's claim: ONE view change removed the whole failed set
+        view_changes = router.view_changes - changes_before
+        cut = {c.endpoint for c in router.last_down}
+        say(f"view changes: {view_changes}; cut size: {len(cut)}")
+
+        # and the router's next routes never touch a dead backend
+        after = {k: router.route(k) for k in keys}
+        dead_routes = [k for k, b in after.items() if b in victim_eps]
+        moved = [k for k in keys if before[k] != after[k]]
+        say(
+            f"routes to dead backends after the change: {len(dead_routes)}; "
+            f"keys remapped: {len(moved)}/{len(keys)}"
+        )
+        return {
+            "view_changes": view_changes,
+            "cut": cut,
+            "victims": victim_eps,
+            "dead_routes": dead_routes,
+            "moved": len(moved),
+            "keys": len(keys),
+            "config_id_router": router_cluster.get_current_configuration_id(),
+            "config_id_swarm": gateway.configuration_id(),
+        }
+    finally:
+        if router_cluster is not None:
+            router_cluster.shutdown()
+        gateway.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--backends", type=int, default=50)
+    parser.add_argument("--fail", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=23)
+    parser.add_argument(
+        "--platform", default="cpu",
+        help="jax platform for the swarm engine (cpu default: an injected "
+        "accelerator plugin would otherwise claim the backend, and a dead "
+        "remote-TPU tunnel hangs device init)",
+    )
+    args = parser.parse_args()
+    if args.platform:
+        import jax
+
+        # config value, not the env var: an injected plugin (e.g. the axon
+        # remote-TPU relay) monkeypatches backend init and ignores the env
+        jax.config.update("jax_platforms", args.platform)
+    out = run_scenario(args.backends, args.fail, args.seed)
+    ok = (
+        out["view_changes"] == 1
+        and out["cut"] == out["victims"]
+        and not out["dead_routes"]
+        and out["config_id_router"] == out["config_id_swarm"]
+    )
+    print(
+        f"single view change: {out['view_changes'] == 1}; exact cut: "
+        f"{out['cut'] == out['victims']}; clean routes: "
+        f"{not out['dead_routes']}; config ids match: "
+        f"{out['config_id_router'] == out['config_id_swarm']}"
+    )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""The end-to-end application scenario (paper §7 Fig. 13's shape): a
+workload router over the gateway swarm reroutes after a SINGLE view change
+when 10 of 50 backends fail at once, and never routes to a dead backend
+afterwards."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from examples.load_balancer import run_scenario  # noqa: E402
+
+
+@pytest.mark.slow
+def test_ten_of_fifty_backend_failures_rebalance_in_one_view_change():
+    out = run_scenario(backends=50, fail=10, seed=23, quiet=True)
+    # the whole failed set lands in ONE view change (Fig. 13's headline)
+    assert out["view_changes"] == 1
+    assert out["cut"] == out["victims"] and len(out["cut"]) == 10
+    # the router's next routes are clean, and only moved keys moved
+    assert out["dead_routes"] == []
+    assert 0 < out["moved"] < out["keys"]
+    # both sides of the wire agree on the configuration
+    assert out["config_id_router"] == out["config_id_swarm"]
