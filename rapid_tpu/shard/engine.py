@@ -117,6 +117,19 @@ def make_multihost_mesh(
             f"{len(host_devices)} devices"
         )
         rows.append(host_devices[:per_host])
+    widths = {len(r) for r in rows}
+    if len(widths) != 1:
+        # fail LOUDLY: np.array over ragged rows would otherwise surface as
+        # an inscrutable dtype=object Mesh error far from the cause
+        raise ValueError(
+            "uneven devices per process: "
+            + ", ".join(
+                f"process {p}: {len(r)}"
+                for p, r in zip(sorted(by_process), rows)
+            )
+            + " -- a ('dcn', 'ici') mesh needs identical host rows; pass "
+            "chips_per_host to truncate every host to a common width"
+        )
     return Mesh(np.array(rows), ("dcn", "ici"))
 
 

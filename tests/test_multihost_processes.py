@@ -39,14 +39,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(tmp_path, pid: int, port: int) -> subprocess.Popen:
+def _launch(tmp_path, pid: int, port: int, num_processes: int,
+            devices: int) -> subprocess.Popen:
     log = open(tmp_path / f"proc-{pid}.log", "w")
     cmd = [
         sys.executable, str(EXAMPLE),
         "--coordinator", f"127.0.0.1:{port}",
-        "--num-processes", "2",
+        "--num-processes", str(num_processes),
         "--process-id", str(pid),
-        "--cpu-devices-per-host", "2",
+        "--cpu-devices-per-host", str(devices),
         "--n", str(N),
         "--seed", str(SEED),
     ]
@@ -57,32 +58,49 @@ def _launch(tmp_path, pid: int, port: int) -> subprocess.Popen:
 
 
 @pytest.mark.slow
-def test_sharded_driver_bit_identical_across_real_processes(tmp_path):
+@pytest.mark.parametrize(
+    "num_processes,devices_per_host",
+    [
+        (2, 2),  # the minimum nontrivial shape: 2 hosts x 2 chips
+        (4, 1),  # more hosts, single chip each: every DCN row is one process
+    ],
+)
+def test_sharded_driver_bit_identical_across_real_processes(
+    tmp_path, num_processes, devices_per_host
+):
     port = _free_port()
-    procs = [_launch(tmp_path, pid, port) for pid in (1, 0)]
+    procs = [
+        _launch(tmp_path, pid, port, num_processes, devices_per_host)
+        for pid in reversed(range(num_processes))
+    ]
     try:
         for p in procs:
-            assert p.wait(timeout=240) == 0
+            assert p.wait(timeout=360) == 0
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
     records = []
-    for pid in (0, 1):
+    for pid in range(num_processes):
         text = (tmp_path / f"proc-{pid}.log").read_text()
-        assert f"mesh {{'dcn': 2, 'ici': 2}}" in text, text
+        assert (
+            f"mesh {{'dcn': {num_processes}, 'ici': {devices_per_host}}}"
+            in text
+        ), text
         m = _RECORD.search(text)
         assert m, f"no record line in process {pid}'s output:\n{text}"
         records.append(tuple(int(g) for g in m.groups()))
-    assert records[0] == records[1], "processes diverged"
+    assert len(set(records)) == 1, f"processes diverged: {records}"
     cut_len, virtual_ms, config_id = records[0]
 
-    # the same scenario single-process on a local (2, 2) mesh: the global
-    # program is identical, so the record must match bit for bit
+    # the same scenario single-process on a local mesh of the same shape:
+    # the global program is identical, so the record must match bit for bit
     from rapid_tpu.shard.engine import make_mesh
     from rapid_tpu.sim.driver import Simulator
 
-    sim = Simulator(N, seed=SEED, mesh=make_mesh(shape=(2, 2)))
+    sim = Simulator(
+        N, seed=SEED, mesh=make_mesh(shape=(num_processes, devices_per_host))
+    )
     rng = np.random.default_rng(SEED)
     victims = rng.choice(N, max(1, int(N * 0.01)), replace=False)
     sim.crash(victims)
@@ -91,3 +109,41 @@ def test_sharded_driver_bit_identical_across_real_processes(tmp_path):
     assert len(rec.cut) == cut_len
     assert rec.virtual_time_ms == virtual_ms
     assert rec.configuration_id == config_id
+
+
+@pytest.mark.slow
+def test_uneven_devices_per_process_fails_loudly(tmp_path):
+    """Heterogeneous hosts (2 devices vs 1) cannot form a ('dcn', 'ici')
+    mesh; make_multihost_mesh must refuse with a message naming the per-
+    process widths and the chips_per_host escape hatch -- not collapse into
+    a ragged-array Mesh error."""
+    port = _free_port()
+    log0 = open(tmp_path / "uneven-0.log", "w")
+    log1 = open(tmp_path / "uneven-1.log", "w")
+    cmds = []
+    for pid, devices, log in ((0, 2, log0), (1, 1, log1)):
+        cmds.append(subprocess.Popen(
+            [
+                sys.executable, str(EXAMPLE),
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", "2",
+                "--process-id", str(pid),
+                "--cpu-devices-per-host", str(devices),
+                "--n", str(N),
+                "--seed", str(SEED),
+            ],
+            stdout=log, stderr=subprocess.STDOUT,
+            env=dict(os.environ, PYTHONUNBUFFERED="1"), cwd=str(REPO),
+        ))
+    try:
+        rcs = [p.wait(timeout=360) for p in cmds]
+    finally:
+        for p in cmds:
+            if p.poll() is None:
+                p.kill()
+    assert all(rc != 0 for rc in rcs), f"uneven shape was accepted: {rcs}"
+    combined = (
+        (tmp_path / "uneven-0.log").read_text()
+        + (tmp_path / "uneven-1.log").read_text()
+    )
+    assert "uneven devices per process" in combined, combined

@@ -132,3 +132,26 @@ def test_2d_dcn_ici_mesh_matches_single_device():
 def test_make_mesh_1d_shape_names_ici():
     m = make_mesh(shape=(8,))
     assert m.axis_names == ("ici",)
+
+
+def test_make_multihost_mesh_rejects_uneven_rows(monkeypatch):
+    """Heterogeneous per-process device counts must fail loudly at mesh
+    construction (shard/engine.py row grouping), naming the widths and the
+    chips_per_host escape hatch."""
+    import jax
+
+    import rapid_tpu.shard.engine as eng
+
+    class FakeDevice:
+        def __init__(self, i, proc):
+            self.id = i
+            self.process_index = proc
+
+    fakes = [FakeDevice(0, 0), FakeDevice(1, 0), FakeDevice(2, 1)]
+    monkeypatch.setattr(jax, "devices", lambda: fakes)
+    with pytest.raises(ValueError, match="uneven devices per process"):
+        eng.make_multihost_mesh()
+    # chips_per_host truncates every host to a common width: accepted
+    mesh = eng.make_multihost_mesh(chips_per_host=1)
+    assert mesh.axis_names == ("dcn", "ici")
+    assert mesh.devices.shape == (2, 1)
