@@ -12,6 +12,7 @@ tags playing the role of the reference's protobuf ``oneof`` envelope
 from __future__ import annotations
 
 import struct
+import threading
 from collections import OrderedDict
 from typing import Any, Dict, Tuple, Type
 
@@ -30,6 +31,11 @@ from .. import types as T
 _ENC_MEMO_MIN = 4096
 _ENC_MEMO_CAP = 8
 _enc_memo: "OrderedDict[int, Tuple[tuple, list]]" = OrderedDict()
+# concurrent encodes are real (gateway delivery lanes + protocol thread,
+# exactly the >=4096-element JoinResponse case the memo targets): guard the
+# OrderedDict mutations, or one thread's eviction races another's
+# move_to_end into a KeyError and corrupts the dict's internal list
+_enc_memo_lock = threading.Lock()
 
 # stable wire tags per message type (appending only; never renumber)
 _TYPES: Tuple[Type, ...] = (
@@ -69,14 +75,16 @@ def _enc(obj: Any) -> Any:
     if isinstance(obj, tuple):
         if len(obj) < _ENC_MEMO_MIN:
             return [_enc(x) for x in obj]
-        hit = _enc_memo.get(id(obj))
-        if hit is not None and hit[0] is obj:
-            _enc_memo.move_to_end(id(obj))
-            return hit[1]
+        with _enc_memo_lock:
+            hit = _enc_memo.get(id(obj))
+            if hit is not None and hit[0] is obj:
+                _enc_memo.move_to_end(id(obj))
+                return hit[1]
         enc = [_enc(x) for x in obj]
-        _enc_memo[id(obj)] = (obj, enc)
-        while len(_enc_memo) > _ENC_MEMO_CAP:
-            _enc_memo.popitem(last=False)
+        with _enc_memo_lock:
+            _enc_memo[id(obj)] = (obj, enc)
+            while len(_enc_memo) > _ENC_MEMO_CAP:
+                _enc_memo.popitem(last=False)
         return enc
     if isinstance(obj, T.AlertMessage):
         # predates the generic "__msg" form; kept for wire stability of
@@ -135,10 +143,43 @@ def _tupled(value: Any) -> Any:
     return value
 
 
+# Packed-body memo for large messages (the >=64 KB full-configuration
+# JoinResponses a swarm bridge streams to every joiner): the body depends
+# only on the message object, not the request number, and the bridge reuses
+# one response object per (configuration, sender) -- so msgpack runs once
+# per object instead of once per send. Same identity-keyed, lock-guarded
+# shape as the _enc memo above.
+_BODY_MEMO_MIN = 65536
+_BODY_MEMO_CAP = 32
+_BODY_MEMO_BYTES = 64 * 1024 * 1024  # pinned bodies are MBs at 100k scale
+_body_memo: "OrderedDict[int, Tuple[Any, bytes]]" = OrderedDict()
+_body_memo_bytes = 0
+_body_memo_lock = threading.Lock()
+
+
 def encode(request_no: int, msg: Any) -> bytes:
     tag = _TAG_OF[type(msg)]
+    with _body_memo_lock:
+        hit = _body_memo.get(id(msg))
+        if hit is not None and hit[0] is msg:
+            _body_memo.move_to_end(id(msg))
+            return ENVELOPE.pack(request_no, tag) + hit[1]
     payload = {k: _enc(v) for k, v in _fields_of(msg).items()}
     body = msgpack.packb(payload, use_bin_type=True)
+    if len(body) >= _BODY_MEMO_MIN:
+        global _body_memo_bytes
+        with _body_memo_lock:
+            _body_memo[id(msg)] = (msg, body)
+            _body_memo_bytes += len(body)
+            # count AND bytes caps: the memo strongly pins message objects
+            # and their packed bodies, and at 100k capacity each is several
+            # MB -- without a bytes budget, stale configurations' responses
+            # would stay resident for the life of the process
+            while len(_body_memo) > _BODY_MEMO_CAP or (
+                _body_memo_bytes > _BODY_MEMO_BYTES and len(_body_memo) > 1
+            ):
+                _, (_, old) = _body_memo.popitem(last=False)
+                _body_memo_bytes -= len(old)
     return ENVELOPE.pack(request_no, tag) + body
 
 

@@ -41,7 +41,7 @@ from __future__ import annotations
 import math
 import random
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import List, Optional, Tuple
 
 from ..runtime.futures import Promise
@@ -92,6 +92,17 @@ class GossipBroadcaster(IBroadcaster):
         # ids pulled but not yet received (id -> request monotonic time);
         # bounds repeat pulls while an answer is in flight
         self._pending_pulls: dict = {}
+        # pushpull payload store keys, oldest first: the age-guarded _seen
+        # eviction lets the TABLE grow under sustained load, but full
+        # payloads must not grow with it (rate x 30 s of envelopes is a
+        # large amplification over the int-per-id table). The hard payload
+        # ceiling drops stored envelopes oldest-first (entry payload ->
+        # None) while KEEPING the dedup key, so dedup safety is unaffected
+        # and pulls for dropped payloads stay best-effort (unanswered, the
+        # puller retries against a fresher advertiser).
+        self._payload_keys: "deque[Tuple[int, int]]" = deque()
+        self._stored_payloads = 0  # LIVE stored envelopes (deque may hold
+        # stale keys for entries evicted from _seen or re-stored later)
 
     # -- IBroadcaster --------------------------------------------------------
 
@@ -160,12 +171,27 @@ class GossipBroadcaster(IBroadcaster):
             self._seen[key] = (sightings + 1, first_seen, stored)
         else:
             self._seen[key] = (1, first_seen, stored)
+        if stored is not None and (prior is None or prior[2] is None):
+            self._payload_keys.append(key)
+            self._stored_payloads += 1
         cap = max(_SEEN_CAP, 4 * len(self._members))
         while len(self._seen) > cap:
             _, entry = next(iter(self._seen.items()))
             if now - entry[1] < _SEEN_MIN_AGE_S:
                 break  # everything old enough is gone; let the table grow
-            self._seen.popitem(last=False)
+            _, evicted = self._seen.popitem(last=False)
+            if evicted[2] is not None:
+                self._stored_payloads -= 1
+        # hard payload ceiling, counted over LIVE stored envelopes (the
+        # deque can hold stale keys; popping one without a live payload
+        # must not count against the budget, or fresh payloads get nulled
+        # while the true count is below the cap)
+        while self._stored_payloads > cap and self._payload_keys:
+            stale_key = self._payload_keys.popleft()
+            entry = self._seen.get(stale_key)
+            if entry is not None and entry[2] is not None:
+                self._seen[stale_key] = (entry[0], entry[1], None)
+                self._stored_payloads -= 1
         if relay is not None:
             if self._mode == "pushpull" and sightings > 0:
                 # anti-entropy: advertise instead of re-pushing the payload

@@ -42,10 +42,25 @@ def _stale() -> bool:
         return False
 
 
+def _warn_if_stale() -> None:
+    if os.path.exists(_LIB_PATH):
+        import warnings
+
+        warnings.warn(
+            f"loading {_LIB_PATH} although its source is newer (rebuild "
+            "unavailable); native results may not reflect source edits",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
 def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
     """Load the library, optionally building it on first use. Rebuilds when
     the source is newer than the binary so edits are never shadowed by a
-    stale .so. None if unavailable (callers fall back to numpy)."""
+    stale .so; if that rebuild is impossible (no toolchain) the stale binary
+    is still loaded, but with a loud warning -- silently-stale native code
+    must at least be visible. None if unavailable (callers fall back to
+    numpy)."""
     global _lib
     if _lib is not None:
         return _lib
@@ -54,12 +69,14 @@ def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
             # never build here: load the (possibly stale) binary if present
             if not os.path.exists(_LIB_PATH):
                 return None
+            _warn_if_stale()
         else:
             try:
                 build(quiet=True)
             except Exception:  # noqa: BLE001 -- no toolchain: numpy fallback
                 if not os.path.exists(_LIB_PATH):
                     return None
+                _warn_if_stale()
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

@@ -48,6 +48,18 @@ def _needs_build() -> bool:
         return False
 
 
+def _warn_if_stale() -> None:
+    if os.path.exists(_LIB_PATH):
+        import warnings
+
+        warnings.warn(
+            f"loading {_LIB_PATH} although its source is newer (rebuild "
+            "unavailable); native results may not reflect source edits",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
 def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
@@ -57,6 +69,7 @@ def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
             # never build here: load the (possibly stale) binary if present
             if not os.path.exists(_LIB_PATH):
                 return None
+            _warn_if_stale()
         else:
             try:
                 subprocess.run(
@@ -66,6 +79,7 @@ def load(auto_build: bool = True) -> Optional[ctypes.CDLL]:
             except Exception:  # noqa: BLE001 -- no toolchain: fallback
                 if not os.path.exists(_LIB_PATH):
                     return None
+                _warn_if_stale()
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

@@ -105,6 +105,45 @@ def test_dedup_eviction_is_age_guarded(monkeypatch):
     assert (0, 0) not in g._seen  # the old entry aged out
 
 
+def test_pushpull_payload_store_is_hard_capped(monkeypatch):
+    """The age guard lets the dedup TABLE grow past the cap while entries are
+    young, but stored full payloads (pushpull's pull-answer store) must not
+    grow with it: beyond the cap the oldest stored envelopes are dropped
+    (entry payload -> None) while their dedup keys survive, so dedup safety
+    is intact and pulls for dropped ids are simply unanswered (best-effort,
+    repaired via a fresher advertiser)."""
+    import rapid_tpu.messaging.gossip as gossip_mod
+
+    client = RecordingClient()
+    me = Endpoint.from_parts("127.0.0.1", 1004)
+    g = GossipBroadcaster(
+        client, me, fanout=0, rng=random.Random(5), mode="pushpull"
+    )
+    g.set_membership(members(4))
+    monkeypatch.setattr(gossip_mod, "_SEEN_CAP", 8)
+    clock = [100.0]
+    monkeypatch.setattr(gossip_mod.time, "monotonic", lambda: clock[0])
+
+    def env_for(i: int) -> GossipEnvelope:
+        return GossipEnvelope(
+            sender=members(4)[0], gossip_id=NodeId(0, i), ttl=0,
+            payload=ProbeMessage(sender=members(4)[0]),
+        )
+
+    # a burst far past the cap, all inside the age window: the table grows
+    # (age guard) but payload-bearing entries stay hard-capped
+    for i in range(40):
+        g.receive(env_for(i))
+    cap = max(gossip_mod._SEEN_CAP, 4 * 4)
+    assert len(g._seen) == 40
+    stored = [k for k, e in g._seen.items() if e[2] is not None]
+    assert len(stored) <= cap
+    # oldest dropped first; the newest envelopes still answer pulls
+    assert (0, 39) in stored and (0, 0) not in stored
+    # dedup keys survive the payload drop
+    assert g.receive(env_for(0)) is None
+
+
 def test_receive_ttl_zero_delivers_without_relay():
     client = RecordingClient()
     me = Endpoint.from_parts("127.0.0.1", 1002)
