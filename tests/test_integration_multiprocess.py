@@ -5,7 +5,8 @@ redirect output, assert liveness and convergence, reap processes).
 """
 
 import os
-import random
+
+from harness import free_port_base
 import re
 import signal
 import subprocess
@@ -77,7 +78,7 @@ def wait_for_membership(log_path: Path, size: int, timeout_s: float = 30) -> boo
 
 def test_single_agent_liveness(runner):
     """RapidNodeRunnerTest.java:27-38."""
-    port = random.randint(21000, 29000)
+    port = free_port_base(1)
     proc, log = runner.run_node(f"127.0.0.1:{port}")
     assert wait_for_membership(log, 1, 20), log.read_text()
     assert proc.poll() is None
@@ -86,7 +87,7 @@ def test_single_agent_liveness(runner):
 def test_three_agents_converge(runner):
     """Seed + 2 joiners in separate OS processes converge to size 3; killing
     one converges the survivors to size 2."""
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     seed_addr = f"127.0.0.1:{base}"
     _, seed_log = runner.run_node(seed_addr)
     assert wait_for_membership(seed_log, 1, 20)
@@ -174,7 +175,7 @@ def test_agents_join_tpu_swarm_over_sockets(runner, gateway_runner):
     swarm of 1000 TPU-simulated virtual nodes, converge to bit-identical
     configuration ids on both sides of the wire, and the swarm detects and
     removes a SIGKILLed agent (VERDICT r2 item 1)."""
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     gw_addr = f"127.0.0.1:{base}"
     seed = gateway_runner.start(gw_addr, n_virtual=1000)
 
@@ -213,7 +214,7 @@ def test_ten_agents_converge_kill_and_rejoin(runner):
     and the survivors converge on exactly that cut, then a fresh agent rejoins
     on a killed agent's address."""
     n = 10
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     seed_addr = f"127.0.0.1:{base}"
     _, seed_log = runner.run_node(seed_addr, fd_interval_ms=200)
     assert wait_for_membership(seed_log, 1, 30)
@@ -254,7 +255,7 @@ def test_three_agents_converge_over_grpc(runner):
     default): real OS processes speaking rapid.proto bytes converge and
     recover from a SIGKILL, like the TCP tier does."""
     pytest.importorskip("grpc")  # declared as the optional [grpc] extra
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     seed_addr = f"127.0.0.1:{base}"
     _, seed_log = runner.run_node(seed_addr, fd_interval_ms=200,
                                   transport="grpc")
@@ -286,7 +287,7 @@ def test_three_agents_converge_over_native_tcp(runner):
 
     if not available():
         pytest.skip("librapid_io.so unavailable (no toolchain)")
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     seed_addr = f"127.0.0.1:{base}"
     _, seed_log = runner.run_node(seed_addr, fd_interval_ms=200,
                                   transport="native-tcp")
@@ -314,7 +315,7 @@ def test_five_agents_converge_over_gossip(runner):
     """Tier-3 with epidemic dissemination: real OS processes over TCP with
     --broadcaster gossip converge on joins and on a SIGKILL cut -- alert
     batches and consensus votes riding gossip relay over real sockets."""
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     seed_addr = f"127.0.0.1:{base}"
     _, seed_log = runner.run_node(seed_addr, fd_interval_ms=200,
                                   broadcaster="gossip")
@@ -344,7 +345,7 @@ def test_north_star_at_ten_thousand_virtual_nodes(runner, gateway_runner):
     join a socket-hosted swarm of 10,000 simulated virtual nodes, converge
     to bit-identical configuration ids on both sides of the wire, and the
     swarm detects and removes a SIGKILLed agent."""
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     gw_addr = f"127.0.0.1:{base}"
     # the gateway CLI warms the engine before printing SEED, so agents
     # arrive at a compiled swarm
@@ -386,7 +387,7 @@ def test_north_star_at_one_hundred_thousand_virtual_nodes(runner, gateway_runner
     bit-identical configuration ids, and observe a virtual cut. Join cost
     is dominated by the member's own 100k-view bootstrap (bulk ring build)
     and the one-frame quorum vote batch."""
-    base = random.randint(30000, 39000)
+    base = free_port_base(16)
     gw_addr = f"127.0.0.1:{base}"
     seed = gateway_runner.start(gw_addr, n_virtual=100_000)
 

@@ -14,7 +14,7 @@ Run with:
 """
 
 import os
-import random
+from harness import free_port_base
 import shutil
 import subprocess
 import time
@@ -34,25 +34,12 @@ def test_reference_jvm_agent_joins_rapid_tpu_seed():
     from rapid_tpu import ClusterBuilder, Endpoint, Settings
     from rapid_tpu.messaging.grpc_transport import GrpcClient, GrpcServer
 
-    import socket
-
-    def pair_free(b):
-        for port in (b, b + 1):
-            with socket.socket() as probe:
-                try:
-                    probe.bind(("127.0.0.1", port))
-                except OSError:
-                    return False
-        return True
-
     settings = Settings()
     seed = None
-    # retry over random port pairs: an occupied port (either the seed's or
-    # the JVM agent's) must not fail the opt-in parity test spuriously
+    # retry over probed free port pairs: an occupied port (either the
+    # seed's or the JVM agent's) must not fail the opt-in test spuriously
     for _ in range(5):
-        base = random.randint(30000, 39000)
-        if not pair_free(base):
-            continue
+        base = free_port_base(2)
         seed_addr = Endpoint.from_parts("127.0.0.1", base)
         try:
             seed = (

@@ -35,9 +35,9 @@ NID = NodeId(424242, -171717)
 
 @pytest.fixture
 def port_base():
-    import random
+    from harness import free_port_base
 
-    return random.randint(20000, 50000)
+    return free_port_base(8)
 
 
 class EchoService:

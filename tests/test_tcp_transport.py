@@ -81,10 +81,10 @@ class EchoService:
 
 @pytest.fixture
 def port_base():
-    # spread ports across tests to dodge TIME_WAIT
-    import random
+    # probe a free block: concurrent batteries must not collide
+    from harness import free_port_base
 
-    return random.randint(20000, 50000)
+    return free_port_base(12)
 
 
 def test_many_clients_one_server(port_base):
