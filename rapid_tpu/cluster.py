@@ -25,6 +25,7 @@ from .messaging.base import IMessagingClient, IMessagingServer
 from .metadata import FrozenMetadata
 from .monitoring.base import IEdgeFailureDetectorFactory
 from .monitoring.pingpong import PingPongFailureDetectorFactory
+from .observability import Metrics
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
 from .runtime.scheduler import Scheduler
@@ -43,6 +44,13 @@ K = 10
 H = 9
 L = 4
 RETRIES = 5
+
+# Process-wide join-health counters (regression guard for seed starvation:
+# a seed that answers phase 1 within the deadline keeps
+# ``join.phase1_no_response`` at zero; ``join.exhausted`` counts joins that
+# burned all RETRIES attempts). Protocol-legal retries -- CONFIG_CHANGED,
+# UUID redraws, phase-2 races -- are deliberately NOT counted here.
+JOIN_METRICS = Metrics()
 
 
 class JoinException(RuntimeError):
@@ -273,6 +281,7 @@ class ClusterBuilder:
         state = {"node_id": NodeId.random(rng), "attempt": 0}
 
         def fail_all(reason: str) -> None:
+            JOIN_METRICS.incr("join.exhausted")
             server.shutdown()
             client.shutdown()
             resources.shutdown()
@@ -293,6 +302,9 @@ class ClusterBuilder:
 
         def on_phase1(p: Promise) -> None:
             if p.exception() is not None:
+                # the seed never answered within the join deadline -- the
+                # starvation signature, distinct from protocol-legal retries
+                JOIN_METRICS.incr("join.phase1_no_response")
                 next_attempt(f"phase 1 failed: {p.exception()}")
                 return
             response = p.peek()

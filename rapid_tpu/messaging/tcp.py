@@ -15,10 +15,12 @@ integration tests (tier 3 of the test strategy, SURVEY.md §4.3).
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import logging
 import socket
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 from ..runtime.futures import Promise
@@ -212,6 +214,54 @@ class FramedTcpServer:
                 pass
 
 
+class _TimeoutWheel:
+    """One shared deadline thread for every in-flight framed request.
+
+    The obvious per-request ``threading.Timer`` is an OS thread per send; at
+    swarm scale (50 agents x K probe subjects per FD interval in one test
+    process) that is ~1000 thread creations per second and ~1000 live timer
+    threads -- a GIL convoy that starves every protocol stack on the box
+    (observed as load averages in the hundreds and multi-minute protocol
+    stalls). One heap + one thread arms every deadline; completed promises
+    simply expire off the heap (``try_set_exception`` on a completed promise
+    is a no-op), so no cancellation bookkeeping is needed."""
+
+    def __init__(self) -> None:
+        self._heap: list = []
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+
+    def arm(self, timeout_s: float, promise: Promise, remote: Endpoint) -> None:
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="rapid-timeouts", daemon=True
+                )
+                self._thread.start()
+            heapq.heappush(self._heap, (deadline, next(self._seq), promise, remote))
+            self._cond.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._heap:
+                    self._cond.wait()
+                delay = self._heap[0][0] - time.monotonic()
+                if delay > 0:
+                    self._cond.wait(delay)
+                    continue
+                _, _, promise, remote = heapq.heappop(self._heap)
+            if not promise.done():
+                promise.try_set_exception(
+                    TimeoutError(f"no response from {remote}")
+                )
+
+
+_timeouts = _TimeoutWheel()
+
+
 def send_framed(conn: _Connection, request_no: int, frame: bytes,
                 timeout_s: float, remote: Endpoint) -> Promise:
     """One framed request over a correlated connection: register the entry,
@@ -229,18 +279,10 @@ def send_framed(conn: _Connection, request_no: int, frame: bytes,
             out.set_exception(e)
         return out
     # non-strict: a response arriving at exactly the deadline must win the
-    # race, not crash the timer thread
-    timer = threading.Timer(
-        timeout_s,
-        lambda: out.try_set_exception(
-            TimeoutError(f"no response from {remote}")
-        ),
-    )
-    timer.daemon = True
-    timer.start()
+    # race, not crash the deadline thread
+    _timeouts.arm(timeout_s, out, remote)
 
     def on_complete(_p: Promise, c=conn, rn=request_no) -> None:
-        timer.cancel()
         c.forget(rn)
 
     out.add_callback(on_complete)

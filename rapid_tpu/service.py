@@ -108,6 +108,10 @@ class MembershipService:
         self._joiner_uuid: Dict[Endpoint, NodeId] = {}
         self._joiner_metadata: Dict[Endpoint, FrozenMetadata] = {}
         self._announced_proposal = False
+        # a decided proposal refused for missing joiner identities (the UP
+        # alerts lost a race against the quorum of votes); retried when the
+        # alerts land -- see _decide_view_change / _handle_batched_alerts
+        self._pending_decision: Optional[List[Endpoint]] = None
         self._alert_send_queue: List[AlertMessage] = []
         self._last_enqueue_ms = -1
         self._failure_detector_jobs: List[ScheduledTask] = []
@@ -278,6 +282,21 @@ class MembershipService:
                 for msg in batch.messages
                 if self._filter_alert(msg, membership_size, current_configuration_id)
             ]
+            pending = self._pending_decision
+            if pending is not None and all(
+                self._view.is_host_present(node) or node in self._joiner_uuid
+                for node in pending
+            ):
+                # the refused decision's missing joiner identities have now
+                # arrived: apply the parked view change
+                LOG.info(
+                    "%s: joiner identities arrived; applying the parked "
+                    "view change", self._my_addr,
+                )
+                self._pending_decision = None
+                self._decide_view_change(pending)
+                future.set_result(Response())
+                return
             if self._announced_proposal:
                 # We already initiated consensus and cannot go back on it.
                 future.set_result(Response())
@@ -384,11 +403,20 @@ class MembershipService:
             self.metrics.incr("view_changes_refused_missing_identity")
             LOG.error(
                 "%s: refusing view change at config %d: no joiner identity "
-                "for %s (UP alerts lost); staying behind for removal+rejoin",
+                "for %s (UP alerts lost); parked until the alerts land, "
+                "else removal+rejoin",
                 self._my_addr, self._view.get_current_configuration_id(),
                 [str(node) for node in missing],
             )
+            # park, don't drop: this configuration's FastPaxos has decided
+            # and will never re-fire, so if the UUID-carrying alerts arrive
+            # a moment after the quorum of votes (every delivery is
+            # best-effort and independently ordered), only this parked
+            # proposal can still apply the view change
+            # (_handle_batched_alerts retries it once identities are known)
+            self._pending_decision = list(proposal)
             return
+        self._pending_decision = None
         self._cancel_failure_detectors()
         status_changes: List[NodeStatusChange] = []
         for node in proposal:

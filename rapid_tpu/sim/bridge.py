@@ -54,6 +54,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import pickle
+import threading
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -270,6 +271,25 @@ class TpuSimMessaging:
         # JoinResponse; serialized content is bit-identical to the uncached
         # build, so parity is untouched
         self._config_content: Optional[Tuple[int, tuple, tuple, tuple]] = None
+        self._config_responses: Dict[Endpoint, JoinResponse] = {}
+        # joiners already streamed the full configuration this join attempt
+        # (sender -> configuration id): the sibling phase-2 responses of the
+        # SAME attempt answer CONFIG_CHANGED instead of re-streaming the
+        # multi-megabyte configuration K times through one socket; a fresh
+        # PreJoin (every retry starts with one, Cluster.java:313-344) clears
+        # the mark, so a lost full-config response self-heals via retry
+        self._streamed: Dict[Endpoint, int] = {}
+        # the decision's two wire messages, built ONCE (identity-stable so
+        # the codec's packed-body memo makes every delivery reuse one
+        # encode): (config id, alert batch, vote batch, src endpoint)
+        self._decision_packet: Optional[tuple] = None
+        # members whose decision chain failed (member -> missed config id):
+        # the pump actively re-drives these -- probes carry no configuration
+        # id, so passive stale-sighting repair alone can strand a quiescent
+        # member. Mutated from delivery-callback threads.
+        self._undelivered: Dict[Endpoint, int] = {}
+        self._chain_inflight: set = set()
+        self._undelivered_lock = threading.Lock()
 
     def _endpoint(self, slot: int) -> Endpoint:
         ep = self._ep_cache.get(slot)
@@ -361,12 +381,55 @@ class TpuSimMessaging:
             return Promise.completed(Response())
         return None
 
+    def warm_compile(self) -> None:
+        """Compile every executable the steady-state pump can hit, BEFORE
+        agents arrive: at large capacities a 20-40 s jit compile landing on
+        the protocol thread mid-join-wave starves every joiner past its
+        phase-1 retry budget (the r4 50-joiner failure). Covers the no-op
+        probe variants (plain + announcement-stop), the full decision path
+        (_apply_view_change / ring rebuild / speculation -- only compiled at
+        the FIRST decision, which warm-by-probe never reaches), and the
+        classic-fallback phases. The decision path runs on a throwaway twin
+        simulator: the jit cache is keyed by shapes + statics, so the twin's
+        executables ARE the real ones, and the real sim's protocol state
+        (membership, configuration id, clock) is untouched."""
+        import jax.numpy as jnp
+
+        from . import classic
+
+        sim = self.sim
+        sim.run_until_decision(max_rounds=1, batch=1)
+        sim.run_until_decision(max_rounds=1, batch=1, stop_when_announced=True)
+        spare = Simulator(
+            sim.config.capacity, config=sim.config, seed=104729,
+            mesh=sim.mesh,
+        )
+        spare.crash(np.array([0]))
+        rec = spare.run_until_decision(max_rounds=32, batch=8)
+        assert rec is not None, "warm twin failed to decide"
+        deliver = spare._deliver  # noqa: SLF001
+        group_of = spare.group_of
+        hears = jnp.asarray(deliver[group_of, 0])
+        coord_hears = jnp.asarray(deliver[group_of[0], :])
+        resp = jnp.full(sim.config.capacity, 2, dtype=jnp.int32)
+        rank = jnp.int32(classic.make_rank(2, 0))
+        state1, _ = classic.phase1(
+            spare.config, spare.state, rank, hears, coord_hears, resp
+        )
+        classic.phase2(
+            spare.config, state1, rank, jnp.int32(0), hears, coord_hears,
+            resp,
+        )
+        sim.ready()
+
     # ------------------------------------------------------------------ #
     # join protocol (swarm side)
     # ------------------------------------------------------------------ #
 
     def _handle_pre_join(self, dst: Endpoint, msg: PreJoinMessage) -> JoinResponse:
         """Phase-1 gatekeeping at a virtual seed (MembershipService.java:200-221)."""
+        # a new attempt begins: its phase 2 may stream the full config once
+        self._streamed.pop(msg.sender, None)
         slot = self._slot_of.get(msg.sender)
         if slot is not None and self.sim.active[slot]:
             status = JoinStatusCode.HOSTNAME_ALREADY_IN_RING
@@ -431,7 +494,23 @@ class TpuSimMessaging:
             )
         if msg.configuration_id != current:
             if self.sim.active[slot]:
-                # the cut already admitted this joiner; stream the config
+                # the cut already admitted this joiner; stream the config --
+                # to the FIRST of this attempt's K observer messages only
+                # (the joiner accepts one response; re-streaming the
+                # multi-MB configuration K times through one socket starved
+                # the gateway at scale). Siblings answer CONFIG_CHANGED,
+                # which the join client ignores when a valid response
+                # exists, and a lost full response heals via retry: the
+                # next attempt's PreJoin clears the mark.
+                if self._streamed.get(msg.sender) == current:
+                    return Promise.completed(
+                        JoinResponse(
+                            sender=dst,
+                            status_code=JoinStatusCode.CONFIG_CHANGED,
+                            configuration_id=current,
+                        )
+                    )
+                self._streamed[msg.sender] = current
                 return Promise.completed(self._full_config_response(dst))
             return Promise.completed(
                 JoinResponse(
@@ -474,14 +553,22 @@ class TpuSimMessaging:
             cached = self._config_content = (
                 config_id, endpoints, identifiers, metadata
             )
-        return JoinResponse(
-            sender=sender,
-            status_code=JoinStatusCode.SAFE_TO_JOIN,
-            configuration_id=config_id,
-            endpoints=cached[1],
-            identifiers=cached[2],
-            metadata=cached[3],
-        )
+            self._config_responses = {}
+        # one response OBJECT per (configuration, sender): the codec's
+        # packed-body memo is identity-keyed, so reusing the object makes
+        # msgpack run once per configuration instead of once per send
+        response = self._config_responses.get(sender)
+        if response is None:
+            response = JoinResponse(
+                sender=sender,
+                status_code=JoinStatusCode.SAFE_TO_JOIN,
+                configuration_id=config_id,
+                endpoints=cached[1],
+                identifiers=cached[2],
+                metadata=cached[3],
+            )
+            self._config_responses[sender] = response
+        return response
 
     # ------------------------------------------------------------------ #
     # votes from real members
@@ -532,29 +619,11 @@ class TpuSimMessaging:
             if count >= self._MAX_REPLAYS:
                 return
             self._replay_counts[sender] = count + 1
-            config_before, alerts, cut_eps, voters = packet
             LOG.info(
                 "replaying decision %d to lagging member %s (attempt %d)",
-                config_before, sender, count + 1,
+                packet[0], sender, count + 1,
             )
-            votes_msg = FastRoundVoteBatch(
-                senders=tuple(voters),
-                configuration_id=config_before,
-                endpoints=tuple(cut_eps),
-            )
-            # same chain as the original delivery: the quorum-completing
-            # votes only follow a SUCCESSFUL delivery of the UUID-carrying
-            # alerts (a member deciding without them is the reference's NPE
-            # path); a failed replay attempt just waits for the next one
-            self._deliver(
-                voters[0], sender, BatchedAlertMessage(voters[0], alerts)
-            ).add_callback(
-                lambda p, s=sender: (
-                    self._deliver(voters[0], s, votes_msg)
-                    if p.exception() is None
-                    else None
-                )
-            )
+            self._deliver_decision_chain(sender)
         elif config_id in self._prior_configs:
             # a single old-config frame can be an in-flight race against two
             # quick decisions (a join wave); only REPEATED sightings of the
@@ -571,6 +640,81 @@ class TpuSimMessaging:
                     sender,
                 )
                 self.sim.crash(np.array([slot]))
+
+    def _deliver_decision_chain(self, member: Endpoint) -> None:
+        """Deliver the last decision to one member: the UUID-carrying alert
+        batch first, the quorum-completing vote batch ONLY after the alerts
+        succeed. Delivering votes to a member whose alert leg was lost would
+        make it decide a proposal whose joiner identities it never saw --
+        the reference's disabled-assert NPE path
+        (MembershipService.java:396). On failure the member is recorded in
+        ``_undelivered`` and the pump re-drives the chain: FD probes carry
+        no configuration id, so a quiescent lagging member emits nothing
+        stale and passive sighting-based repair alone would strand it."""
+        packet = self._decision_packet
+        if packet is None:
+            return
+        config_id, alert_msg, votes_msg, src = packet
+        with self._undelivered_lock:
+            if member in self._chain_inflight:
+                return
+            self._chain_inflight.add(member)
+
+        def settle(ok: bool) -> None:
+            with self._undelivered_lock:
+                self._chain_inflight.discard(member)
+                if ok:
+                    self._undelivered.pop(member, None)
+                else:
+                    self._undelivered[member] = config_id
+
+        def after_votes(p: Promise) -> None:
+            settle(p.exception() is None)
+
+        def after_alerts(p: Promise) -> None:
+            if p.exception() is None:
+                self._deliver(src, member, votes_msg).add_callback(after_votes)
+            else:
+                LOG.warning(
+                    "alert delivery to %s failed (%s); withholding votes -- "
+                    "the pump will re-drive the chain",
+                    member, p.exception(),
+                )
+                settle(False)
+
+        self._deliver(src, member, alert_msg).add_callback(after_alerts)
+
+    def _reconcile_lagging(self) -> None:
+        """Active repair of members whose decision chain failed (runs at the
+        top of every pump). A member still missing the CURRENT decision gets
+        the chain re-driven; a member that missed a decision that has since
+        been superseded is beyond vote repair (FastPaxos is
+        per-configuration) and is cut for rejoin -- Rapid's answer to a node
+        that falls behind is removal and rejoin."""
+        packet = self._decision_packet
+        if packet is None:
+            return
+        current = packet[0]
+        with self._undelivered_lock:
+            lagging = dict(self._undelivered)
+        for member, missed in lagging.items():
+            slot = self._real.get(member)
+            if slot is None or not self.sim.active[slot]:
+                with self._undelivered_lock:
+                    self._undelivered.pop(member, None)
+                continue
+            if missed == current:
+                self._deliver_decision_chain(member)
+            else:
+                LOG.warning(
+                    "member %s missed decision %d entirely (superseded); "
+                    "cutting it (rejoin required)",
+                    member, missed,
+                )
+                with self._undelivered_lock:
+                    self._undelivered.pop(member, None)
+                if self.sim.alive[slot]:
+                    self.sim.crash(np.array([slot]))
 
     # ------------------------------------------------------------------ #
     # alerts from real members
@@ -613,6 +757,7 @@ class TpuSimMessaging:
         complete a quorum the virtual members alone cannot reach, or block
         one by voting a conflicting value."""
         self._sense_real_liveness()
+        self._reconcile_lagging()
         sim = self.sim
         if self._quiescent():
             # nothing can decide: no pending membership work, every member
@@ -726,36 +871,28 @@ class TpuSimMessaging:
                 configuration_id=config_before,
                 endpoints=tuple(cut_eps),
             )
-            for member in members_before:
-                # votes are chained on the alert delivery SUCCEEDING: the
-                # alert batch carries the joiner UUIDs the member's
-                # decideViewChange needs (MembershipService.java:666-674
-                # stashes them from UP alerts). Delivering the
-                # quorum-completing votes to a member whose alert leg was
-                # lost (send retries exhausted under load) would make it
-                # decide a proposal whose joiner identities it never saw --
-                # the NPE path in the reference. Withholding the votes
-                # instead leaves the member one configuration behind, which
-                # the stale-traffic replay (_maybe_catch_up) repairs with
-                # the same alerts-then-votes chain.
-                self._deliver(
-                    voters[0], member, BatchedAlertMessage(voters[0], alerts)
-                ).add_callback(
-                    lambda p, m=member: (
-                        self._deliver(voters[0], m, votes_msg)
-                        if p.exception() is None
-                        else LOG.warning(
-                            "alert delivery to %s failed (%s); withholding "
-                            "votes -- the member will catch up via replay",
-                            m, p.exception(),
-                        )
-                    )
-                )
-            # keep the packet: a member whose delivery was lost will keep
-            # sending traffic stamped with config_before, and gets a replay
+            # keep the packet BEFORE delivering: a failed chain records the
+            # member in _undelivered against this decision
             self._last_decision = (
                 config_before, alerts, tuple(cut_eps), tuple(voters[:quorum])
             )
+            self._decision_packet = (
+                config_before,
+                BatchedAlertMessage(voters[0], alerts),
+                votes_msg,
+                voters[0],
+            )
+            with self._undelivered_lock:
+                lagging_now = set(self._undelivered)
+            for member in members_before:
+                if member in lagging_now:
+                    # it provably missed the PREVIOUS decision and is now
+                    # beyond vote repair (FastPaxos is per-configuration);
+                    # delivering the new chain would "succeed" at the
+                    # transport and mask the miss -- the next pump's
+                    # reconciliation cuts it for rejoin instead
+                    continue
+                self._deliver_decision_chain(member)
             self._replay_counts = {}
             self._prior_configs.append(config_before)
             # prune strikes whose config fell out of the stale window; keep
@@ -768,12 +905,31 @@ class TpuSimMessaging:
                 for key, strikes in self._stale_counts.items()
                 if key[1] in self._prior_configs
             }
-        # unblock admitted joiners (respondToJoiners, MembershipService.java:708-733)
+        # unblock admitted joiners (respondToJoiners, MembershipService.java:708-733);
+        # the full configuration streams once per joiner -- the first parked
+        # observer response carries it, siblings answer CONFIG_CHANGED (the
+        # join client needs exactly one valid response; K full copies of a
+        # multi-MB configuration through one socket starved the gateway)
+        config_now = sim.configuration_id()
         for joiner in list(self._parked):
             slot = self._slot_of.get(joiner)
             if slot is not None and sim.active[slot]:
+                first = self._streamed.get(joiner) != config_now
                 for observer_ep, parked in self._parked.pop(joiner):
-                    parked.set_result(self._full_config_response(observer_ep))
+                    if first:
+                        self._streamed[joiner] = config_now
+                        first = False
+                        parked.set_result(
+                            self._full_config_response(observer_ep)
+                        )
+                    else:
+                        parked.set_result(
+                            JoinResponse(
+                                sender=observer_ep,
+                                status_code=JoinStatusCode.CONFIG_CHANGED,
+                                configuration_id=config_now,
+                            )
+                        )
         # recycle removed real nodes' slots: the identifier history is
         # value-based, so a slot can be re-seated for a future joiner
         for slot in (int(s) for s in rec.removed):
@@ -782,6 +938,7 @@ class TpuSimMessaging:
                 del self._real[ep]
                 del self._slot_of[ep]
                 self._metadata.pop(ep, None)
+                self._streamed.pop(ep, None)
                 self.sim.set_auto_vote(slot, True)
                 self._free_slots.append(slot)
         return rec
@@ -842,7 +999,14 @@ class TpuSimMessaging:
             time.sleep(ms / 1000.0)
 
     def _deliver(self, src: Endpoint, dst: Endpoint, msg: RapidMessage):
-        return self.network.deliver(src, dst, msg, timeout_ms=1000)
+        # join-class deadline, not the 1 s default: decision packets straddle
+        # a view change, and the receiving member may be mid-bootstrap of its
+        # new N-member view when the packet lands -- the same reason the
+        # reference gives joins 5x the default RPC deadline
+        # (GrpcClient.java:55-59). A short deadline here made the bridge
+        # declare deliveries failed against members that were merely busy,
+        # stranding them a configuration behind for the replay path to fix.
+        return self.network.deliver(src, dst, msg, timeout_ms=5000)
 
     def _quiescent(self) -> bool:
         """True when no protocol progress is possible: no membership work
