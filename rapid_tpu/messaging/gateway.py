@@ -207,6 +207,45 @@ class GatewaySwarmBroadcaster(IBroadcaster):
         return promises
 
 
+class GatewayGossipBroadcaster(IBroadcaster):
+    """Epidemic dissemination among the real members behind a gateway.
+
+    Composition of the two broadcast optimizations: swarm-bound copies
+    collapse into ONE wildcard frame exactly like GatewaySwarmBroadcaster
+    (the device delivers them to every virtual member as array work), while
+    the direct (real-member) plane uses GossipBroadcaster's relay instead of
+    unicast-to-all -- at M real members that turns each broadcast's direct
+    leg from M-1 sends into ~fanout, the dissemination alternative the
+    reference names but never ships (IBroadcaster.java:24-26). The swarm is
+    one "super-node" from the epidemic's viewpoint: it hears every broadcast
+    exactly once and never relays."""
+
+    def __init__(self, routed: "GatewayRoutedClient", gossip) -> None:
+        self._routed = routed
+        self._gossip = gossip
+        self._any_swarm = False
+
+    def set_membership(self, recipients: List[Endpoint]) -> None:
+        direct = [
+            r for r in recipients if self._routed._is_direct(r)  # noqa: SLF001
+        ]
+        self._any_swarm = len(direct) < len(recipients)
+        self._gossip.set_membership(direct)
+
+    def broadcast(self, msg: RapidMessage) -> List[Promise]:
+        promises = self._gossip.broadcast(msg)
+        if self._any_swarm:
+            promises.append(
+                self._routed._send_routed_once(SWARM_BROADCAST, msg)  # noqa: SLF001
+            )
+        return promises
+
+    def receive(self, env) -> Optional[RapidMessage]:
+        """Relay-plane entry (the membership service forwards inbound
+        GossipEnvelopes here, like for a plain GossipBroadcaster)."""
+        return self._gossip.receive(env)
+
+
 class _GatewayScheduler(RealScheduler):
     """RealScheduler plus ``run_for``: the bridge's clock advance drains the
     gateway's protocol queue for the window, so inbound votes are processed
@@ -387,7 +426,9 @@ class _GatewayNetwork:
 
         def send() -> None:
             try:
-                self._out.send_message(dst, msg).add_callback(
+                self._out.send_message_with_timeout(
+                    dst, msg, timeout_ms
+                ).add_callback(
                     lambda p: out.done()
                     or (
                         out.set_exception(p.exception())

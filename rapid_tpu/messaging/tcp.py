@@ -351,20 +351,38 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
                 self._connections[remote] = conn
             return conn
 
-    def _send_once(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+    def _send_once(self, remote: Endpoint, msg: RapidMessage,
+                   timeout_ms: Optional[int] = None) -> Promise:
         try:
             conn = self._connection(remote)
         except OSError as e:
             return Promise.failed(e)
         request_no = next(self._request_no)
+        timeout = (
+            timeout_ms if timeout_ms is not None
+            else self._settings.timeout_for(msg)
+        )
         return send_framed(
-            conn, request_no, encode(request_no, msg),
-            self._settings.timeout_for(msg) / 1000.0, remote,
+            conn, request_no, encode(request_no, msg), timeout / 1000.0,
+            remote,
         )
 
     def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
         return call_with_retries(
             lambda: self._send_once(remote, msg), self._settings.message_retries
+        )
+
+    def send_message_with_timeout(
+        self, remote: Endpoint, msg: RapidMessage, timeout_ms: int
+    ) -> Promise:
+        """send_message with an explicit per-attempt deadline, for callers
+        whose message class deserves a different budget than the settings
+        table (the gateway's decision-packet deliveries use the join-class
+        deadline: the receiving member may be mid-bootstrap of a new view,
+        busy rather than dead)."""
+        return call_with_retries(
+            lambda: self._send_once(remote, msg, timeout_ms),
+            self._settings.message_retries,
         )
 
     def send_message_best_effort(self, remote: Endpoint, msg: RapidMessage) -> Promise:

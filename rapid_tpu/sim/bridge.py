@@ -55,7 +55,7 @@ import dataclasses
 import logging
 import pickle
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -283,6 +283,11 @@ class TpuSimMessaging:
         # the codec's packed-body memo makes every delivery reuse one
         # encode): (config id, alert batch, vote batch, src endpoint)
         self._decision_packet: Optional[tuple] = None
+        # pre-decision config id -> packet, newest last (bounded like
+        # _prior_configs): lets a lagging member be walked FORWARD packet by
+        # packet instead of being cut the moment one decision supersedes
+        # another mid-chain
+        self._packet_history: "OrderedDict[int, tuple]" = OrderedDict()
         # members whose decision chain failed (member -> missed config id):
         # the pump actively re-drives these -- probes carry no configuration
         # id, so passive stale-sighting repair alone can strand a quiescent
@@ -614,16 +619,18 @@ class TpuSimMessaging:
         packet = self._last_decision
         if packet is None or sender not in self._real:
             return
-        if config_id == packet[0]:
+        if config_id in self._packet_history:
             count = self._replay_counts.get(sender, 0)
             if count >= self._MAX_REPLAYS:
                 return
             self._replay_counts[sender] = count + 1
             LOG.info(
                 "replaying decision %d to lagging member %s (attempt %d)",
-                packet[0], sender, count + 1,
+                config_id, sender, count + 1,
             )
-            self._deliver_decision_chain(sender)
+            self._deliver_decision_chain(
+                sender, self._packet_history[config_id]
+            )
         elif config_id in self._prior_configs:
             # a single old-config frame can be an in-flight race against two
             # quick decisions (a join wave); only REPEATED sightings of the
@@ -641,22 +648,36 @@ class TpuSimMessaging:
                 )
                 self.sim.crash(np.array([slot]))
 
-    def _deliver_decision_chain(self, member: Endpoint) -> None:
-        """Deliver the last decision to one member: the UUID-carrying alert
-        batch first, the quorum-completing vote batch ONLY after the alerts
+    def _deliver_decision_chain(
+        self, member: Endpoint, packet: Optional[tuple] = None
+    ) -> None:
+        """Deliver one decision to one member: the UUID-carrying alert batch
+        first, the quorum-completing vote batch ONLY after the alerts
         succeed. Delivering votes to a member whose alert leg was lost would
         make it decide a proposal whose joiner identities it never saw --
         the reference's disabled-assert NPE path
-        (MembershipService.java:396). On failure the member is recorded in
-        ``_undelivered`` and the pump re-drives the chain: FD probes carry
-        no configuration id, so a quiescent lagging member emits nothing
-        stale and passive sighting-based repair alone would strand it."""
-        packet = self._decision_packet
+        (MembershipService.java:396).
+
+        On success, if newer decisions committed meanwhile, the member is
+        walked FORWARD through the packet history one decision at a time
+        (FastPaxos is per-configuration: each packet only applies to a
+        member sitting exactly at its pre-decision configuration). On
+        failure the member is recorded in ``_undelivered`` and the pump
+        re-drives the chain: FD probes carry no configuration id, so a
+        quiescent lagging member emits nothing stale and passive
+        sighting-based repair alone would strand it."""
+        if packet is None:
+            packet = self._decision_packet
         if packet is None:
             return
-        config_id, alert_msg, votes_msg, src = packet
+        config_id, alert_msg, votes_msg, src, after_id = packet
         with self._undelivered_lock:
             if member in self._chain_inflight:
+                # a chain for an earlier decision is still in flight; its
+                # settle() walks forward from the then-current history, so
+                # this newer decision is NOT lost (dropping it here was the
+                # staircase bug: members stuck at their join-era
+                # configuration once decisions outpaced their chains)
                 return
             self._chain_inflight.add(member)
 
@@ -667,6 +688,13 @@ class TpuSimMessaging:
                     self._undelivered.pop(member, None)
                 else:
                     self._undelivered[member] = config_id
+            if not ok:
+                return
+            nxt = self._packet_history.get(after_id)
+            if nxt is not None:
+                # the member now sits at after_id and the decision taken
+                # FROM there is in history: keep walking
+                self._deliver_decision_chain(member, nxt)
 
         def after_votes(p: Promise) -> None:
             settle(p.exception() is None)
@@ -686,15 +714,13 @@ class TpuSimMessaging:
 
     def _reconcile_lagging(self) -> None:
         """Active repair of members whose decision chain failed (runs at the
-        top of every pump). A member still missing the CURRENT decision gets
-        the chain re-driven; a member that missed a decision that has since
-        been superseded is beyond vote repair (FastPaxos is
-        per-configuration) and is cut for rejoin -- Rapid's answer to a node
-        that falls behind is removal and rejoin."""
-        packet = self._decision_packet
-        if packet is None:
+        top of every pump): re-drive the missed packet from history so the
+        member can be walked forward. Only a member whose needed packet has
+        aged OUT of the history (>= 8 decisions behind) is beyond repair
+        and is cut for rejoin -- Rapid's answer to a node that falls behind
+        is removal and rejoin."""
+        if self._decision_packet is None:
             return
-        current = packet[0]
         with self._undelivered_lock:
             lagging = dict(self._undelivered)
         for member, missed in lagging.items():
@@ -703,12 +729,14 @@ class TpuSimMessaging:
                 with self._undelivered_lock:
                     self._undelivered.pop(member, None)
                 continue
-            if missed == current:
-                self._deliver_decision_chain(member)
+            packet = self._packet_history.get(missed)
+            if packet is not None:
+                self._deliver_decision_chain(member, packet)
             else:
                 LOG.warning(
-                    "member %s missed decision %d entirely (superseded); "
-                    "cutting it (rejoin required)",
+                    "member %s missed decision %d and its packet has aged "
+                    "out of the replay history; cutting it (rejoin "
+                    "required)",
                     member, missed,
                 )
                 with self._undelivered_lock:
@@ -881,7 +909,13 @@ class TpuSimMessaging:
                 BatchedAlertMessage(voters[0], alerts),
                 votes_msg,
                 voters[0],
+                # post-decision id: a later packet applies to a member only
+                # if that member is exactly here (chains walk off this)
+                sim.configuration_id(),
             )
+            self._packet_history[config_before] = self._decision_packet
+            while len(self._packet_history) > 8:
+                self._packet_history.popitem(last=False)
             with self._undelivered_lock:
                 lagging_now = set(self._undelivered)
             for member in members_before:
@@ -915,7 +949,12 @@ class TpuSimMessaging:
             slot = self._slot_of.get(joiner)
             if slot is not None and sim.active[slot]:
                 first = self._streamed.get(joiner) != config_now
-                for observer_ep, parked in self._parked.pop(joiner):
+                # newest parked entry first: a slow decision can span several
+                # join attempts, and the earlier attempts' requests have
+                # expired client-side -- streaming the one full configuration
+                # to the oldest entry hands it to a dead request while the
+                # live retry gets CONFIG_CHANGED
+                for observer_ep, parked in reversed(self._parked.pop(joiner)):
                     if first:
                         self._streamed[joiner] = config_now
                         first = False

@@ -45,7 +45,10 @@ class GatewayHarness:
     """A socket-hosted swarm plus real agents, all on loopback."""
 
     def __init__(self, n_virtual=32, seed=11, native_server=False,
-                 capacity=None, fd_interval_ms=100, pump_interval_ms=50):
+                 capacity=None, fd_interval_ms=100, pump_interval_ms=50,
+                 broadcaster_factory=None):
+        # broadcaster_factory(routed_client, rng) -> IBroadcaster; default
+        # is the wildcard-collapsing GatewaySwarmBroadcaster
         self.base = free_port_base(64)
         self.settings = Settings(
             failure_detector_interval_ms=fd_interval_ms,
@@ -62,6 +65,7 @@ class GatewayHarness:
             native_server=native_server,
         )
         self.gateway.start()
+        self.broadcaster_factory = broadcaster_factory
         self.agents = []
 
     def join_agent(self, i, timeout=60):
@@ -77,7 +81,13 @@ class GatewayHarness:
             # swarm-bound broadcasts collapse to one wildcard frame, as the
             # agent CLI does in gateway mode
             .set_broadcaster_factory(
-                lambda c, rng, routed=client: GatewaySwarmBroadcaster(routed)
+                self.broadcaster_factory
+                if self.broadcaster_factory is not None
+                else (
+                    lambda c, rng, routed=client: GatewaySwarmBroadcaster(
+                        routed
+                    )
+                )
             )
             .join(self.gateway.seed_endpoint(), timeout=timeout)
         )
@@ -94,6 +104,17 @@ class GatewayHarness:
             ):
                 return True
             time.sleep(0.1)
+        # diagnosis on timeout: who lags, and at what size
+        sizes = {}
+        for a in agents:
+            sizes.setdefault(a.get_membership_size(), []).append(
+                a.listen_address.port
+            )
+        print(
+            f"wait_converged({want}) timed out: gateway="
+            f"{self.gateway.membership_size()}, agent sizes "
+            f"{{size: [ports]}} = { {k: v for k, v in sorted(sizes.items())} }"
+        )
         return False
 
     def shutdown(self):
@@ -447,5 +468,54 @@ def test_agents_join_swarm_through_native_reactor():
             == a2.get_current_configuration_id()
             == h.gateway.configuration_id()
         )
+    finally:
+        h.shutdown()
+
+
+@pytest.mark.slow
+def test_agents_gossip_among_themselves_behind_gateway():
+    """The socket-tier gossip composition (IBroadcaster.java:24-26 at the
+    gateway): real agents disseminate alert batches and votes to EACH OTHER
+    by epidemic relay (GatewayGossipBroadcaster) while the swarm still hears
+    one wildcard copy. Joins, a virtual cut, and an abrupt agent death all
+    converge with bit-identical configuration ids."""
+    import random as _random
+
+    from rapid_tpu.messaging.gateway import GatewayGossipBroadcaster
+    from rapid_tpu.messaging.gossip import GossipBroadcaster
+
+    def factory(client, rng):
+        return GatewayGossipBroadcaster(
+            client,
+            GossipBroadcaster(
+                client, client.address, fanout=3, rng=rng, mode="pushpull"
+            ),
+        )
+
+    h = GatewayHarness(n_virtual=32, seed=18, broadcaster_factory=factory)
+    try:
+        agents = [h.join_agent(i) for i in range(1, 7)]
+        assert h.wait_converged(38)
+        ids = {a.get_current_configuration_id() for a in h.agents}
+        ids.add(h.gateway.configuration_id())
+        assert len(ids) == 1
+
+        # a virtual cut observed by every gossiping agent
+        h.gateway.bridge.sim.crash(np.array([4, 21]))
+        assert h.wait_converged(36, timeout=90)
+        ids = {a.get_current_configuration_id() for a in h.agents}
+        ids.add(h.gateway.configuration_id())
+        assert len(ids) == 1
+
+        # an abrupt agent death cut by the swarm FDs, gossip carrying the
+        # survivors' alert/vote traffic
+        victim = agents[-1]
+        victim.shutdown()
+        h.agents.remove(victim)
+        assert h.wait_converged(35, timeout=120)
+        assert victim.listen_address not in h.agents[0].get_memberlist()
+        ids = {a.get_current_configuration_id() for a in h.agents}
+        ids.add(h.gateway.configuration_id())
+        assert len(ids) == 1
     finally:
         h.shutdown()
