@@ -40,10 +40,16 @@ sys.path.insert(
 )
 
 
-def run_strategy(strategy: str, n: int, crash: int, seed: int) -> dict:
+def run_strategy(strategy: str, n: int, crash: int, seed: int,
+                 failure_mode: str = "crash") -> dict:
     from harness import ClusterHarness
 
-    h = ClusterHarness(seed=seed)
+    # crash mode uses the instant static FDs (the paper's Table 2 shape:
+    # dissemination cost of one clean cut). one-way mode uses the REAL
+    # cumulative PingPong detectors -- detection must flow through actual
+    # probe loss across the asymmetric fault, so the column measures the
+    # dissemination fabric under the noisier probe-driven alert pattern
+    h = ClusterHarness(seed=seed, use_static_fd=(failure_mode == "crash"))
     if strategy.startswith("gossip"):
         from rapid_tpu.messaging.gossip import GossipBroadcaster
 
@@ -52,21 +58,44 @@ def run_strategy(strategy: str, n: int, crash: int, seed: int) -> dict:
             client, client.address, fanout=4, rng=rng, mode=mode
         )
     try:
-        return _measure(h, strategy, n, crash)
+        return _measure(h, strategy, n, crash, failure_mode)
     finally:
         h.shutdown()
 
 
-def _measure(h, strategy: str, n: int, crash: int) -> dict:
+def _measure(h, strategy: str, n: int, crash: int,
+             failure_mode: str = "crash") -> dict:
     h.create_cluster(n, parallel=False)
     h.wait_and_verify_agreement(n)
-    # zero the counters after bootstrap so the measurement is the crash
+    # zero the counters after bootstrap so the measurement is the failure
     # experiment itself, like the paper's steady-state window
     for inst in h.instances.values():
         inst._membership_service.metrics.reset()  # noqa: SLF001
     victims = [h.addr(i) for i in range(2, 2 + crash)]
-    h.fail_nodes(victims)
-    h.wait_and_verify_agreement(n - crash)
+    if failure_mode == "crash":
+        h.fail_nodes(victims)
+    elif failure_mode == "one-way":
+        # paper Fig. 9's iptables INPUT shape: victims receive nothing,
+        # their egress still flows; the survivors' PingPong detectors
+        # accumulate real probe losses until the alert threshold
+        victim_set = set(victims)
+        h.network.add_filter(lambda s, d, m: d not in victim_set)
+    else:
+        raise ValueError(f"unknown failure mode {failure_mode}")
+    survivors = [
+        c for ep, c in h.instances.items() if ep not in set(victims)
+    ]
+    ok = h.scheduler.run_until(
+        lambda: all(
+            len(c.get_memberlist()) == n - crash for c in survivors
+        ),
+        timeout_ms=600_000,
+    )
+    assert ok, "survivors did not converge"
+    for v in victims:
+        c = h.instances.pop(v, None)
+        if c is not None and failure_mode != "crash":
+            c.shutdown()
 
     per_process = []
     per_process_control = []  # payload-free IHAVE/PULL frames (pushpull)
@@ -88,6 +117,7 @@ def _measure(h, strategy: str, n: int, crash: int) -> dict:
     ctl = np.array(per_process_control)
     return {
         "strategy": strategy,
+        "failure_mode": failure_mode,
         "n": n,
         "crashed": crash,
         "mean_msgs": round(float(arr.mean()), 1),
@@ -104,12 +134,23 @@ def main() -> None:
     parser.add_argument("--n", type=int, default=32)
     parser.add_argument("--crash", type=int, default=2)
     parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--failure-mode", default="crash",
+                        choices=("crash", "one-way", "all"))
     args = parser.parse_args()
-    for strategy in ("unicast", "gossip", "gossip-pushpull"):
-        print(
-            json.dumps(run_strategy(strategy, args.n, args.crash, args.seed)),
-            flush=True,
-        )
+    modes = (
+        ("crash", "one-way")
+        if args.failure_mode == "all"
+        else (args.failure_mode,)
+    )
+    for failure_mode in modes:
+        for strategy in ("unicast", "gossip", "gossip-pushpull"):
+            print(
+                json.dumps(run_strategy(
+                    strategy, args.n, args.crash, args.seed,
+                    failure_mode=failure_mode,
+                )),
+                flush=True,
+            )
 
 
 if __name__ == "__main__":
