@@ -21,6 +21,23 @@ import time
 import numpy as np
 
 
+def recomputed_config_id(sim) -> int:
+    """The configuration id recomputed FROM SCRATCH (fresh element hashes +
+    vectorized fold), independent of the driver's per-configuration memo and
+    speculative-fold fast paths -- a scenario-level cross-check that the
+    incremental identity the protocol stamped on every message equals the
+    ground-truth fold over the final membership."""
+    from rapid_tpu.sim.topology import configuration_id_vectorized, ring_order
+
+    ids = sim.sorted_identifiers()
+    order0 = ring_order(sim.cluster, sim.active, 0)
+    vc = sim.cluster
+    return configuration_id_vectorized(
+        ids[:, 0], ids[:, 1],
+        vc.hostnames[order0], vc.host_lengths[order0], vc.ports[order0],
+    )
+
+
 def scenario_10_node_cross_plane():
     """10-node ring, 1 crash-stop: protocol plane vs simulation plane."""
     
@@ -88,6 +105,10 @@ def scenario_crash(n, n_fail, seed, label):
         "virtual_ms": rec.virtual_time_ms if rec else None,
         "wall_s": round(wall, 3),
         "cut_ok": bool(rec is not None and set(rec.cut) == set(victims)),
+        "config_id_ok": bool(
+            rec is not None
+            and rec.configuration_id == recomputed_config_id(sim)
+        ),
     }
 
 
@@ -107,6 +128,10 @@ def scenario_one_way_loss(n, n_fail, seed):
         "virtual_ms": rec.virtual_time_ms if rec else None,
         "wall_s": round(wall, 3),
         "cut_ok": bool(rec is not None and set(rec.cut) == set(victims)),
+        "config_id_ok": bool(
+            rec is not None
+            and rec.configuration_id == recomputed_config_id(sim)
+        ),
     }
 
 
@@ -145,10 +170,21 @@ def scenario_flip_flop_with_join_wave(n, capacity, seed):
         "wall_s": round(wall, 3),
         "cut_ok": bool(final_ok),
         "view_changes": len(decided),
+        "config_id_ok": bool(
+            decided
+            and decided[-1].configuration_id == recomputed_config_id(sim)
+        ),
     }
 
 
 def main() -> None:
+    if "--tpu" not in sys.argv:
+        # pin the CPU backend via the CONFIG value (an injected accelerator
+        # plugin ignores the env var, and a dead remote-TPU tunnel hangs
+        # device init); pass --tpu to run on real hardware
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     results = [
         scenario_10_node_cross_plane(),
         scenario_crash(1000, 1, 100, "1k virtual nodes, single crash-stop fault"),
@@ -157,13 +193,18 @@ def main() -> None:
         scenario_flip_flop_with_join_wave(100_000, 100_100, 400),
     ]
     if "--scale-1m" in sys.argv:
-        # headroom demo at 10x the north-star scale (~3 min of extra jit
-        # compile for the 1M shapes; protocol wall time is ~1.3s)
+        # first-class targets at 10x the north-star scale (VERDICT r4 item
+        # 3): every failure class the paper holds stable, at 1M, with cut
+        # parity AND the from-scratch configuration-id cross-check
         results.append(
             scenario_crash(
                 1_000_000, 10_000, 500,
                 "1M virtual nodes, 1% correlated crash burst (10x north star)",
             )
+        )
+        results.append(scenario_one_way_loss(1_000_000, 10_000, 501))
+        results.append(
+            scenario_flip_flop_with_join_wave(1_000_000, 1_001_000, 502)
         )
     for result in results:
         print(json.dumps(result))
