@@ -243,7 +243,9 @@ def _sharded_round(
             config, state, probed, fail_event
         )
     else:
-        fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
+        fd_fail = state.fd_fail + (
+            fail_event & (state.fd_fail < jnp.uint8(255))
+        ).astype(jnp.uint8)
         new_down = probed & (fd_fail >= config.fd_threshold) & ~state.alerted
     alerted = state.alerted | new_down
 

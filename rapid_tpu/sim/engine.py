@@ -141,9 +141,12 @@ class SimState:
     group_of: jax.Array  # int32[C] delivery group of each node
     subjects: jax.Array  # int32[C, K] monitored node per ring
     observers: jax.Array  # int32[C, K] monitoring node per ring
-    fd_fail: jax.Array  # int32[C, K] cumulative failed probes per edge
+    fd_fail: jax.Array  # uint8[C, K] cumulative failed probes per edge,
+    # saturating at 255 (only >= fd_threshold comparisons and the
+    # threshold-distance are ever read; uint8 quarters the FD plane's
+    # per-round HBM traffic at 1M nodes vs int32)
     fd_hist: jax.Array  # uint16[C, K] last-W probe outcomes (windowed policy)
-    fd_seen: jax.Array  # int32[C, K] probes recorded, saturating at W
+    fd_seen: jax.Array  # uint8[C, K] probes recorded, saturating at W (<=16)
     alerted: jax.Array  # bool[C, K] edge already reported DOWN
     reports: jax.Array  # bool[G, C, K] per-group report tables (dst, ring)
     arrival_hist: jax.Array  # bool[Dmax, C, K] DOWN alerts aged 1..Dmax rounds
@@ -203,9 +206,9 @@ def initial_state(
         group_of=jnp.asarray(group_of, dtype=jnp.int32),
         subjects=jnp.asarray(subjects),
         observers=jnp.asarray(observers),
-        fd_fail=jnp.zeros((c, k), jnp.int32),
+        fd_fail=jnp.zeros((c, k), jnp.uint8),
         fd_hist=jnp.zeros((c, k), jnp.uint16),
-        fd_seen=jnp.zeros((c, k), jnp.int32),
+        fd_seen=jnp.zeros((c, k), jnp.uint8),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         arrival_hist=jnp.zeros((config.max_delivery_delay, c, k), bool),
@@ -460,7 +463,7 @@ def _window_params(config: SimConfig) -> Tuple[int, int, jnp.ndarray]:
 def window_step(
     config: SimConfig,
     hist: jax.Array,  # uint16[., K] last-W probe outcomes
-    seen: jax.Array,  # int32[., K] probes recorded, saturating at W
+    seen: jax.Array,  # uint8[., K] probes recorded, saturating at W
     probed: jax.Array,  # bool[., K] a probe was recorded on this edge
     fail_event: jax.Array,  # bool[., K] the recorded probe failed
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -474,7 +477,9 @@ def window_step(
     w, t, mask = _window_params(config)
     shifted = ((hist << 1) | fail_event.astype(jnp.uint16)) & mask
     hist = jnp.where(probed, shifted, hist)
-    seen = jnp.where(probed, jnp.minimum(seen + 1, w), seen)
+    seen = jnp.where(
+        probed, jnp.minimum(seen + jnp.uint8(1), jnp.uint8(w)), seen
+    )
     crossed = (
         probed
         & (seen >= w)
@@ -542,7 +547,11 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
         alerted = state.alerted | new_down
     else:
         fail_event = edge_live & observer_up & ~probe_ok
-        fd_fail = state.fd_fail + fail_event.astype(jnp.int32)
+        # saturating add: the counter is only ever compared against the
+        # (<=255) threshold, so clamping at 255 preserves semantics
+        fd_fail = state.fd_fail + (
+            fail_event & (state.fd_fail < jnp.uint8(255))
+        ).astype(jnp.uint8)
         new_down = (
             edge_live
             & observer_up
@@ -679,7 +688,9 @@ def run_until_decided_const(
             )
         fires = (fire_probe != never) & ~state.alerted
     else:
-        fire_probe = jnp.maximum(config.fd_threshold - state.fd_fail, 1)
+        fire_probe = jnp.maximum(
+            config.fd_threshold - state.fd_fail.astype(jnp.int32), 1
+        )
         fires = fail_event & ~state.alerted
     if rpi > 1:
         fire_round = p_rel[:, None] + 1 + (fire_probe - 1) * rpi
@@ -774,12 +785,19 @@ def run_until_decided_const(
         hist_new = ((h32 | fills) & jnp.uint32(maskw)).astype(jnp.uint16)
         fd_hist = jnp.where(probed, hist_new, state.fd_hist)
         fd_seen = jnp.where(
-            probed, jnp.minimum(state.fd_seen + probes, w), state.fd_seen
+            probed,
+            jnp.minimum(
+                state.fd_seen.astype(jnp.int32) + probes, w
+            ).astype(jnp.uint8),
+            state.fd_seen,
         )
         return dataclasses.replace(
             final, fd_hist=fd_hist, fd_seen=fd_seen, alerted=alerted
         )
-    fd_fail = state.fd_fail + probes * fail_event.astype(jnp.int32)
+    fd_fail = jnp.minimum(
+        state.fd_fail.astype(jnp.int32) + probes * fail_event.astype(jnp.int32),
+        255,
+    ).astype(jnp.uint8)
     return dataclasses.replace(final, fd_fail=fd_fail, alerted=alerted)
 
 
@@ -832,9 +850,9 @@ def device_initial_state(
         group_of=group_of,
         subjects=subjects,
         observers=observers,
-        fd_fail=jnp.zeros((c, k), jnp.int32),
+        fd_fail=jnp.zeros((c, k), jnp.uint8),
         fd_hist=jnp.zeros((c, k), jnp.uint16),
-        fd_seen=jnp.zeros((c, k), jnp.int32),
+        fd_seen=jnp.zeros((c, k), jnp.uint8),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         arrival_hist=jnp.zeros((config.max_delivery_delay, c, k), bool),

@@ -65,7 +65,7 @@ def test_kernel_matches_engine_fd_phase_through_run():
     assert rec is not None
     rng = np.random.default_rng(9)
     c, k = 64, config.k
-    fd_fail = np.asarray(sim.state.fd_fail)
+    fd_fail = np.asarray(sim.state.fd_fail).astype(np.int32)  # exemplar kernel is int32
     alerted = np.asarray(sim.state.alerted)
     edge_live = rng.random((c, k)) < 0.9
     observer_up = np.ones((c, k), dtype=bool)
