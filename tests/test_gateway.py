@@ -479,8 +479,6 @@ def test_agents_gossip_among_themselves_behind_gateway():
     by epidemic relay (GatewayGossipBroadcaster) while the swarm still hears
     one wildcard copy. Joins, a virtual cut, and an abrupt agent death all
     converge with bit-identical configuration ids."""
-    import random as _random
-
     from rapid_tpu.messaging.gateway import GatewayGossipBroadcaster
     from rapid_tpu.messaging.gossip import GossipBroadcaster
 
