@@ -438,3 +438,53 @@ def test_lagging_member_caught_up_after_lost_decision():
     h.scheduler.run_for(15_000)
     assert cluster.get_membership_size() == 22
     assert cluster.get_current_configuration_id() == h.swarm.sim.configuration_id()
+
+
+def test_lagging_member_walked_forward_through_packet_history():
+    """A live member unreachable across TWO consecutive decisions is walked
+    FORWARD packet by packet when deliveries resume (bridge packet history
+    + pump reconciliation), instead of being cut: FastPaxos is
+    per-configuration, so each missed decision must be replayed in order.
+    Regression pin for the round-5 'staircase' (members stranded at their
+    join-era configuration once decisions outpaced their chains)."""
+    h = BridgeHarness(n_virtual=24, capacity=32, seed=6)
+    cluster, _ = h.join_real_node("10.9.9.1", 9100)
+    member_ep = Endpoint.from_parts("10.9.9.1", 9100)
+    assert cluster.get_membership_size() == 25
+
+    # the member stays alive and listening, but nothing reaches it
+    lift = h.network.add_filter(lambda s, d, m: d != member_ep)
+
+    def decide(victim):
+        h.swarm.sim.crash(np.array([victim]))
+        for _ in range(40):
+            rec = h.swarm.pump()
+            h.scheduler.run_for(2_000)
+            if rec is not None:
+                return rec
+        raise AssertionError("no decision")
+
+    decide(2)
+    decide(3)
+    # chains to the member failed (5s deadline x retries, on virtual time);
+    # it is now two configurations behind
+    assert cluster.get_membership_size() == 25
+    swarm_config = h.swarm.sim.configuration_id()
+    assert cluster.get_current_configuration_id() != swarm_config
+
+    lift()
+    # reconciliation re-drives the FIRST missed packet; its settle walks the
+    # member through the second -- no cut, no rejoin
+    for _ in range(60):
+        h.swarm.pump()
+        h.scheduler.run_for(2_000)
+        if (
+            cluster.get_membership_size() == 23
+            and cluster.get_current_configuration_id() == swarm_config
+        ):
+            break
+    assert cluster.get_membership_size() == 23
+    assert cluster.get_current_configuration_id() == swarm_config
+    # the member was repaired in place: still an active seat, never cut
+    slot = h.swarm._slot_of[member_ep]  # noqa: SLF001
+    assert h.swarm.sim.active[slot] and h.swarm.sim.alive[slot]

@@ -244,3 +244,57 @@ def test_crash_beyond_fast_paxos_quorum(harness):
     harness.wait_and_verify_agreement(34, timeout_ms=1_200_000)
     for cluster in harness.instances.values():
         assert not set(cluster.get_memberlist()) & set(failing)
+
+
+def test_refused_view_change_parks_and_applies_when_alerts_land(harness):
+    """The vote-quorum-before-UP-alerts race (every delivery is best-effort
+    and independently ordered): a member whose FastPaxos decides a proposal
+    naming a joiner it has no identity for must refuse the view change
+    (applying it would fork the configuration id; the reference NPEs,
+    MembershipService.java:396) -- but PARK it, because that
+    configuration's FastPaxos has decided and will never re-fire. When the
+    UUID-carrying alerts arrive a moment later, the parked decision
+    applies."""
+    from rapid_tpu.types import (
+        AlertMessage,
+        BatchedAlertMessage,
+        EdgeStatus,
+        Endpoint,
+        FastRoundVoteBatch,
+        NodeId,
+    )
+
+    harness.create_cluster(4)
+    harness.wait_and_verify_agreement(4)
+    node = harness.instances[harness.addr(0)]
+    service = node._membership_service  # noqa: SLF001
+    config_id = node.get_current_configuration_id()
+    joiner = Endpoint.from_parts("127.0.0.1", 4999)
+    joiner_id = NodeId(1234, 5678)
+
+    # quorum of identical votes arrives FIRST (N=4 => F=0, quorum=4)
+    service.handle_message(FastRoundVoteBatch(
+        senders=tuple(harness.addr(i) for i in range(4)),
+        configuration_id=config_id,
+        endpoints=(joiner,),
+    ))
+    harness.scheduler.run_for(500)
+    assert service.metrics.get("view_changes_refused_missing_identity") == 1
+    assert node.get_membership_size() == 4  # refused, not forked
+
+    # ... then the UP alert lands: the parked decision applies
+    service.handle_message(BatchedAlertMessage(
+        sender=harness.addr(1),
+        messages=(AlertMessage(
+            edge_src=harness.addr(1),
+            edge_dst=joiner,
+            edge_status=EdgeStatus.UP,
+            configuration_id=config_id,
+            ring_numbers=(0,),
+            node_id=joiner_id,
+        ),),
+    ))
+    harness.scheduler.run_for(500)
+    assert node.get_membership_size() == 5
+    assert joiner in node.get_memberlist()
+    assert node.get_current_configuration_id() != config_id
