@@ -488,3 +488,44 @@ def test_lagging_member_walked_forward_through_packet_history():
     # the member was repaired in place: still an active seat, never cut
     slot = h.swarm._slot_of[member_ep]  # noqa: SLF001
     assert h.swarm.sim.active[slot] and h.swarm.sim.alive[slot]
+
+
+def test_member_beyond_packet_history_is_cut_for_rejoin():
+    """The walking repair has a horizon: a member unreachable across MORE
+    decisions than the packet history holds (8) cannot be walked forward
+    (its oldest missed packet is gone), so it is cut for rejoin -- Rapid's
+    answer to a node that falls behind."""
+    h = BridgeHarness(n_virtual=24, capacity=32, seed=7)
+    cluster, _ = h.join_real_node("10.9.9.2", 9200)
+    member_ep = Endpoint.from_parts("10.9.9.2", 9200)
+    slot = h.swarm._slot_of[member_ep]  # noqa: SLF001
+    lift = h.network.add_filter(lambda s, d, m: d != member_ep)
+
+    def decide(victim):
+        h.swarm.sim.crash(np.array([victim]))
+        for _ in range(40):
+            rec = h.swarm.pump()
+            h.scheduler.run_for(2_000)
+            if rec is not None:
+                return rec
+        raise AssertionError("no decision")
+
+    # 9 decisions while the member is unreachable: its first missed packet
+    # ages out of the 8-deep history, and reconciliation cuts it
+    for victim in range(2, 11):
+        decide(victim)
+        if not h.swarm.sim.active[slot]:
+            break
+        # let failed chains settle and reconciliation run
+        for _ in range(6):
+            h.swarm.pump()
+            h.scheduler.run_for(3_000)
+    for _ in range(60):
+        rec = h.swarm.pump()
+        h.scheduler.run_for(2_000)
+        if not h.swarm.sim.active[slot]:
+            break
+    assert not h.swarm.sim.active[slot] or not h.swarm.sim.alive[slot], (
+        "member beyond the packet history was never cut"
+    )
+    lift()
