@@ -1,0 +1,629 @@
+"""Transport-agnostic deterministic fault injection: the nemesis plane.
+
+Rapid's claim (PAPER.md, atc-2018 section 7) is stability under *messy*
+failures -- one-way link loss, flip-flopping links, partial packet drops --
+yet each transport historically had its own incompatible fault seam: the
+in-process fabric's filters, the sim plane's mask arrays, nothing at all for
+sockets. This module unifies them:
+
+- :class:`FaultPlan`: a seeded, declarative schedule of per-link faults --
+  probabilistic drops, one-way partitions with open/heal windows, flip-flop
+  schedules, delay distributions, duplication and reordering. The plan is
+  pure data; it carries no clocks or counters, so one plan replays across
+  runs and transports.
+- :class:`Nemesis`: one *armed* instance of a plan for one run: it sources
+  time from the :class:`~.runtime.scheduler.Scheduler` seam (virtual-time
+  runs stay discrete-event deterministic), derives every probabilistic
+  decision from ``(plan seed, rule, link, per-link sequence number)`` via a
+  keyed hash -- never from shared RNG state -- and counts injected faults
+  into :mod:`~.observability` (``nemesis_*``).
+- :class:`NemesisClient` / :class:`NemesisServer`: decorators over the
+  ``IMessagingClient`` / ``IMessagingServer`` seams (messaging/base.py), so
+  the same plan wraps the in-process, TCP and gRPC transports unchanged.
+  The client additionally hardens ``send_message``: retries with the
+  settings backoff policy and the per-message-type overall deadline
+  (``Settings.deadline_for``), enforced uniformly at this layer whatever the
+  wrapped transport does.
+- :func:`replay_on_simulator`: compiles the device-plane-expressible subset
+  of the same plan onto a :class:`~.sim.driver.Simulator`'s fault-schedule
+  arrays segment by segment, so one seeded plan replays on both planes and
+  parity tests can assert identical cuts and configuration ids.
+
+Egress rules (``at="egress"``, the default) are applied by the client
+decorator at the sender; ingress rules by the server decorator at the
+receiver. A rule is applied exactly once either way, so wrapping both halves
+of every node (the normal setup) never double-applies a fault.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import random
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .messaging.base import IMessagingClient, IMessagingServer
+from .messaging.retries import call_with_retries
+from .observability import Metrics, global_metrics
+from .runtime.futures import Promise
+from .runtime.scheduler import Scheduler
+from .settings import Settings
+from .types import Endpoint, ProbeMessage, RapidMessage
+
+EGRESS = "egress"
+INGRESS = "ingress"
+
+# (start_ms, end_ms) relative to the nemesis arm epoch; end None = forever
+Window = Tuple[int, Optional[int]]
+_ALWAYS: Tuple[Window, ...] = ((0, None),)
+
+
+def _u01(seed: int, *parts) -> float:
+    """Deterministic uniform in [0, 1) keyed on ``(seed, parts)``.
+
+    blake2b, not ``hash()``: decisions must not depend on per-process hash
+    salting, and must not depend on draw interleaving across links -- each
+    (rule, link, sequence-number) tuple owns its value outright.
+    """
+    h = hashlib.blake2b(digest_size=8)
+    h.update(struct.pack("<q", seed))
+    for part in parts:
+        h.update(str(part).encode())
+        h.update(b"\x1f")
+    return int.from_bytes(h.digest(), "little") / 2.0**64
+
+
+@dataclass(frozen=True)
+class LinkMatch:
+    """Which (src, dst, message type) triples a rule applies to; None = any."""
+
+    src: Optional[Endpoint] = None
+    dst: Optional[Endpoint] = None
+    msg_types: Optional[Tuple[type, ...]] = None
+
+    def matches(self, src: Optional[Endpoint], dst: Optional[Endpoint],
+                msg: RapidMessage) -> bool:
+        if self.src is not None and src != self.src:
+            return False
+        if self.dst is not None and dst != self.dst:
+            return False
+        if self.msg_types is not None and not isinstance(msg, self.msg_types):
+            return False
+        return True
+
+
+@dataclass(frozen=True)
+class Rule:
+    """Base: a link selector, an application side, and open/heal windows."""
+
+    match: LinkMatch = LinkMatch()
+    at: str = EGRESS
+    windows: Tuple[Window, ...] = _ALWAYS
+
+    def active_at(self, t_ms: int) -> bool:
+        return any(
+            start <= t_ms and (end is None or t_ms < end)
+            for start, end in self.windows
+        )
+
+
+@dataclass(frozen=True)
+class DropRule(Rule):
+    """Drop each matching message independently with ``probability``."""
+
+    probability: float = 1.0
+
+
+@dataclass(frozen=True)
+class PartitionRule(Rule):
+    """Deterministic one-way cut while a window is open (iptables INPUT)."""
+
+
+@dataclass(frozen=True)
+class FlipFlopRule(Rule):
+    """The paper's flip-flop failure: the link alternates cut/healed every
+    half ``period_ms``, starting cut at ``start_ms`` (within the windows)."""
+
+    period_ms: int = 2000
+    start_ms: int = 0
+
+    def active_at(self, t_ms: int) -> bool:
+        if t_ms < self.start_ms or not super().active_at(t_ms):
+            return False
+        half = max(1, self.period_ms // 2)
+        return ((t_ms - self.start_ms) // half) % 2 == 0
+
+
+@dataclass(frozen=True)
+class DelayRule(Rule):
+    """Extra one-way latency: ``base_ms`` plus uniform [0, jitter_ms]."""
+
+    base_ms: int = 0
+    jitter_ms: int = 0
+
+
+@dataclass(frozen=True)
+class DuplicateRule(Rule):
+    """Deliver a second copy of each matching message with ``probability``."""
+
+    probability: float = 0.0
+
+
+@dataclass(frozen=True)
+class ReorderRule(Rule):
+    """Hold back each matching message with ``probability`` by a uniform
+    [1, max_extra_ms] extra delay, letting later traffic overtake it."""
+
+    probability: float = 0.0
+    max_extra_ms: int = 100
+
+
+class FaultPlan:
+    """A seeded, declarative fault schedule (pure data, reusable across runs).
+
+    Builder methods append immutable rules and return ``self``::
+
+        plan = (FaultPlan(seed=7)
+                .partition_one_way(dst=victim)                  # from t=0 on
+                .flip_flop(period_ms=4000, dst=other)
+                .drop(0.2, msg_types=(ProbeMessage,))
+                .delay(base_ms=10, jitter_ms=5, src=a, dst=b))
+    """
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = int(seed)
+        self.rules: List[Rule] = []
+
+    def _add(self, rule: Rule) -> "FaultPlan":
+        assert rule.at in (EGRESS, INGRESS), rule.at
+        self.rules.append(rule)
+        return self
+
+    @staticmethod
+    def _match(src, dst, msg_types) -> LinkMatch:
+        return LinkMatch(
+            src=src, dst=dst,
+            msg_types=tuple(msg_types) if msg_types is not None else None,
+        )
+
+    def drop(self, probability: float, src: Optional[Endpoint] = None,
+             dst: Optional[Endpoint] = None, msg_types=None,
+             windows: Tuple[Window, ...] = _ALWAYS,
+             at: str = EGRESS) -> "FaultPlan":
+        assert 0.0 <= probability <= 1.0, probability
+        return self._add(DropRule(
+            match=self._match(src, dst, msg_types), at=at, windows=windows,
+            probability=probability,
+        ))
+
+    def partition_one_way(self, src: Optional[Endpoint] = None,
+                          dst: Optional[Endpoint] = None,
+                          windows: Tuple[Window, ...] = _ALWAYS,
+                          at: str = EGRESS) -> "FaultPlan":
+        return self._add(PartitionRule(
+            match=self._match(src, dst, None), at=at, windows=windows,
+        ))
+
+    def flip_flop(self, period_ms: int, src: Optional[Endpoint] = None,
+                  dst: Optional[Endpoint] = None, start_ms: int = 0,
+                  windows: Tuple[Window, ...] = _ALWAYS,
+                  at: str = EGRESS) -> "FaultPlan":
+        assert period_ms >= 2, period_ms
+        return self._add(FlipFlopRule(
+            match=self._match(src, dst, None), at=at, windows=windows,
+            period_ms=period_ms, start_ms=start_ms,
+        ))
+
+    def delay(self, base_ms: int, jitter_ms: int = 0,
+              src: Optional[Endpoint] = None, dst: Optional[Endpoint] = None,
+              msg_types=None, windows: Tuple[Window, ...] = _ALWAYS,
+              at: str = EGRESS) -> "FaultPlan":
+        assert base_ms >= 0 and jitter_ms >= 0
+        return self._add(DelayRule(
+            match=self._match(src, dst, msg_types), at=at, windows=windows,
+            base_ms=base_ms, jitter_ms=jitter_ms,
+        ))
+
+    def duplicate(self, probability: float, src: Optional[Endpoint] = None,
+                  dst: Optional[Endpoint] = None, msg_types=None,
+                  windows: Tuple[Window, ...] = _ALWAYS,
+                  at: str = EGRESS) -> "FaultPlan":
+        assert 0.0 <= probability <= 1.0, probability
+        return self._add(DuplicateRule(
+            match=self._match(src, dst, msg_types), at=at, windows=windows,
+            probability=probability,
+        ))
+
+    def reorder(self, probability: float, max_extra_ms: int = 100,
+                src: Optional[Endpoint] = None,
+                dst: Optional[Endpoint] = None, msg_types=None,
+                windows: Tuple[Window, ...] = _ALWAYS,
+                at: str = EGRESS) -> "FaultPlan":
+        assert 0.0 <= probability <= 1.0, probability
+        assert max_extra_ms >= 1
+        return self._add(ReorderRule(
+            match=self._match(src, dst, msg_types), at=at, windows=windows,
+            probability=probability, max_extra_ms=max_extra_ms,
+        ))
+
+
+@dataclass
+class Decision:
+    """What the plane does to one message."""
+
+    drop: bool = False
+    delay_ms: int = 0
+    duplicates: int = 0
+    reordered: bool = False
+
+
+class Nemesis:
+    """One armed instance of a plan for one run: epoch, decision streams,
+    counters. Create one per cluster run; mint decorators from it."""
+
+    def __init__(self, plan: FaultPlan, scheduler: Scheduler,
+                 metrics: Optional[Metrics] = None) -> None:
+        self.plan = plan
+        self.scheduler = scheduler
+        self.metrics = metrics if metrics is not None else global_metrics()
+        self._epoch: Optional[int] = None
+        # (rule index, src str, dst str) -> decisions drawn so far
+        self._seq: Dict[Tuple[int, str, str], int] = {}
+        self._lock = threading.Lock()
+
+    # -- clock ---------------------------------------------------------------
+
+    def arm(self, epoch_ms: Optional[int] = None) -> "Nemesis":
+        """Pin plan-time zero (default: now). Windows are relative to this;
+        re-arming after bootstrap starts the schedule from a healthy view."""
+        self._epoch = (
+            epoch_ms if epoch_ms is not None else self.scheduler.now_ms()
+        )
+        return self
+
+    def plan_now_ms(self) -> int:
+        if self._epoch is None:
+            self.arm()
+        return self.scheduler.now_ms() - self._epoch
+
+    # -- decorators ----------------------------------------------------------
+
+    def client(self, inner: IMessagingClient, address: Optional[Endpoint] = None,
+               settings: Optional[Settings] = None) -> "NemesisClient":
+        return NemesisClient(inner, self, address=address, settings=settings)
+
+    def server(self, inner: IMessagingServer,
+               address: Endpoint) -> "NemesisServer":
+        return NemesisServer(inner, self, address)
+
+    # -- decisions -----------------------------------------------------------
+
+    def _draw(self, rule_idx: int, src: str, dst: str) -> float:
+        key = (rule_idx, src, dst)
+        with self._lock:
+            n = self._seq.get(key, 0)
+            self._seq[key] = n + 1
+        return _u01(self.plan.seed, rule_idx, src, dst, n)
+
+    def retry_rng(self, address: Optional[Endpoint]) -> random.Random:
+        """Per-sender seeded rng for backoff jitter draws."""
+        tag = str(address).encode() if address is not None else b"?"
+        return random.Random(self.plan.seed ^ zlib.crc32(tag))
+
+    def decide(self, src: Optional[Endpoint], dst: Optional[Endpoint],
+               msg: RapidMessage, at: str) -> Decision:
+        t = self.plan_now_ms()
+        out = Decision()
+        src_s, dst_s = str(src), str(dst)
+        for idx, rule in enumerate(self.plan.rules):
+            if rule.at != at or not rule.match.matches(src, dst, msg):
+                continue
+            if not rule.active_at(t):
+                continue
+            if isinstance(rule, (PartitionRule, FlipFlopRule)):
+                out.drop = True
+            elif isinstance(rule, DropRule):
+                if self._draw(idx, src_s, dst_s) < rule.probability:
+                    out.drop = True
+            elif isinstance(rule, DelayRule):
+                jitter = (
+                    int(self._draw(idx, src_s, dst_s) * (rule.jitter_ms + 1))
+                    if rule.jitter_ms > 0 else 0
+                )
+                out.delay_ms += rule.base_ms + jitter
+            elif isinstance(rule, DuplicateRule):
+                if self._draw(idx, src_s, dst_s) < rule.probability:
+                    out.duplicates += 1
+            elif isinstance(rule, ReorderRule):
+                if self._draw(idx, src_s, dst_s) < rule.probability:
+                    held = 1 + int(
+                        self._draw(idx, src_s, dst_s) * rule.max_extra_ms
+                    )
+                    out.delay_ms += min(held, rule.max_extra_ms)
+                    out.reordered = True
+        return out
+
+
+def _pipe(src: Promise, dst: Promise) -> None:
+    if dst.done():
+        return
+    exc = src.exception()
+    if exc is not None:
+        dst.try_set_exception(exc)
+    else:
+        dst.try_set_result(src._result)  # noqa: SLF001 -- promise-internal copy
+
+
+class NemesisClient(IMessagingClient):
+    """Egress fault application + uniformly hardened send_message.
+
+    ``send_message`` re-homes the retry loop at this layer: every attempt
+    traverses the fault plane once, attempts are spaced by the settings
+    backoff policy, and the whole exchange is bounded by the per-message-type
+    deadline (``Settings.deadline_for``) on the scheduler's clock --
+    identical semantics over every wrapped transport.
+    """
+
+    def __init__(self, inner: IMessagingClient, nemesis: Nemesis,
+                 address: Optional[Endpoint] = None,
+                 settings: Optional[Settings] = None) -> None:
+        self.inner = inner
+        self.address = (
+            address if address is not None else getattr(inner, "address", None)
+        )
+        self._nem = nemesis
+        inherited = getattr(inner, "_settings", None)
+        self._settings = (
+            settings if settings is not None
+            else inherited if inherited is not None else Settings()
+        )
+
+    def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        return call_with_retries(
+            lambda: self._attempt(remote, msg),
+            self._settings.message_retries,
+            scheduler=self._nem.scheduler,
+            policy=self._settings.retry_policy(),
+            deadline_ms=self._settings.deadline_for(msg),
+            rng=self._nem.retry_rng(self.address),
+            metrics=self._nem.metrics,
+        )
+
+    def send_message_best_effort(self, remote: Endpoint,
+                                 msg: RapidMessage) -> Promise:
+        return self._attempt(remote, msg)
+
+    def _attempt(self, remote: Endpoint, msg: RapidMessage) -> Promise:
+        d = self._nem.decide(self.address, remote, msg, EGRESS)
+        metrics = self._nem.metrics
+        if d.drop:
+            metrics.incr("nemesis_dropped")
+            # dropped on the wire: the sender only ever sees its per-message
+            # deadline expire, exactly like the in-process fabric's filters
+            out: Promise = Promise()
+            timeout = self._settings.timeout_for(msg)
+            self._nem.scheduler.schedule(
+                timeout,
+                lambda: out.try_set_exception(TimeoutError(
+                    f"nemesis dropped {type(msg).__name__} to {remote}"
+                )),
+            )
+            return out
+        for _ in range(d.duplicates):
+            metrics.incr("nemesis_duplicated")
+            self.inner.send_message_best_effort(remote, msg)
+        if d.delay_ms > 0:
+            metrics.incr(
+                "nemesis_reordered" if d.reordered else "nemesis_delayed"
+            )
+            out = Promise()
+            self._nem.scheduler.schedule(
+                d.delay_ms,
+                lambda: self.inner.send_message_best_effort(
+                    remote, msg
+                ).add_callback(lambda p: _pipe(p, out)),
+            )
+            return out
+        metrics.incr("nemesis_passed")
+        return self.inner.send_message_best_effort(remote, msg)
+
+    def shutdown(self) -> None:
+        self.inner.shutdown()
+
+
+class _NemesisServiceFilter:
+    """Ingress fault application, inserted between the real server and its
+    MembershipService: ``handle_message`` is the one dispatch seam every
+    transport shares, so wrapping the service faults them all identically."""
+
+    def __init__(self, service, nemesis: Nemesis, address: Endpoint) -> None:
+        self._service = service
+        self._nem = nemesis
+        self._address = address
+
+    def handle_message(self, msg: RapidMessage) -> Promise:
+        src = getattr(msg, "sender", None)
+        d = self._nem.decide(src, self._address, msg, INGRESS)
+        metrics = self._nem.metrics
+        if d.drop:
+            metrics.incr("nemesis_dropped")
+            return Promise()  # never completes -> the sender times out
+        for _ in range(d.duplicates):
+            metrics.incr("nemesis_duplicated")
+            self._service.handle_message(msg)
+        if d.delay_ms > 0:
+            metrics.incr(
+                "nemesis_reordered" if d.reordered else "nemesis_delayed"
+            )
+            out: Promise = Promise()
+            self._nem.scheduler.schedule(
+                d.delay_ms,
+                lambda: self._service.handle_message(msg).add_callback(
+                    lambda p: _pipe(p, out)
+                ),
+            )
+            return out
+        metrics.incr("nemesis_passed")
+        return self._service.handle_message(msg)
+
+    def __getattr__(self, name):
+        return getattr(self._service, name)
+
+
+class NemesisServer(IMessagingServer):
+    """Server-side decorator: passes lifecycle through and interposes the
+    ingress fault filter in front of the MembershipService."""
+
+    def __init__(self, inner: IMessagingServer, nemesis: Nemesis,
+                 address: Endpoint) -> None:
+        self.inner = inner
+        self.address = address
+        self._nem = nemesis
+
+    def start(self) -> None:
+        self.inner.start()
+
+    def shutdown(self) -> None:
+        self.inner.shutdown()
+
+    def set_membership_service(self, service) -> None:
+        self.inner.set_membership_service(
+            _NemesisServiceFilter(service, self._nem, self.address)
+        )
+
+
+# --------------------------------------------------------------------------
+# Device-plane compilation
+# --------------------------------------------------------------------------
+
+
+class UnsupportedDeviceFault(ValueError):
+    """The rule has no device-plane analogue (see replay_on_simulator)."""
+
+
+def _device_rules(plan: FaultPlan, round_ms: int) -> List[Tuple[int, Rule]]:
+    """The device-compilable subset, validated.
+
+    The device plane models the FD probe fabric: one-way ingress cuts
+    (``one_way_ingress_partition``), lossy ingress (``ingress_loss``) and
+    their schedules. Delays shorter than one round, duplicates and
+    reorderings are absorbed by the round abstraction (a probe exchange is
+    idempotent and completes within its round), so those compile to no-ops;
+    anything the round model cannot absorb raises, loudly, instead of
+    silently diverging from the protocol plane.
+    """
+    out: List[Tuple[int, Rule]] = []
+    for idx, rule in enumerate(plan.rules):
+        if isinstance(rule, (DuplicateRule, ReorderRule)):
+            continue  # idempotent / intra-round: invisible to the round model
+        if isinstance(rule, DelayRule):
+            if rule.base_ms + rule.jitter_ms >= round_ms:
+                raise UnsupportedDeviceFault(
+                    f"delay rule {idx} exceeds one device round ({round_ms} "
+                    "ms); use Simulator.delay_broadcasts for round-scale "
+                    "latency"
+                )
+            continue  # sub-round latency is absorbed by the round model
+        if rule.match.src is not None:
+            raise UnsupportedDeviceFault(
+                f"rule {idx}: per-source link faults have no device "
+                "analogue (the probe mask is per destination)"
+            )
+        if rule.match.msg_types is not None and not any(
+            issubclass(ProbeMessage, t) for t in rule.match.msg_types
+        ):
+            raise UnsupportedDeviceFault(
+                f"rule {idx}: only probe-affecting faults compile to the "
+                "device probe mask (dissemination loss is "
+                "Simulator.drop_broadcasts)"
+            )
+        out.append((idx, rule))
+    return out
+
+
+def _boundaries(rules: List[Tuple[int, Rule]], horizon_ms: int,
+                round_ms: int) -> List[int]:
+    """Plan times (relative, within the horizon) where the active fault set
+    can change: window edges plus flip-flop phase edges."""
+    edges = {0, horizon_ms}
+    for _, rule in rules:
+        for start, end in rule.windows:
+            if start < horizon_ms:
+                edges.add(max(0, start))
+            if end is not None and end < horizon_ms:
+                edges.add(end)
+        if isinstance(rule, FlipFlopRule):
+            half = max(1, rule.period_ms // 2)
+            t = rule.start_ms
+            while t < horizon_ms:
+                if t >= 0:
+                    edges.add(t)
+                t += half
+    return sorted(edges)
+
+
+def endpoint_slots(sim) -> Dict[Endpoint, int]:
+    """Endpoint -> slot for every seated identity of a Simulator."""
+    cluster = sim.cluster
+    return {
+        Endpoint(
+            bytes(cluster.hostnames[i, : cluster.host_lengths[i]]),
+            int(cluster.ports[i]),
+        ): i
+        for i in range(sim.config.capacity)
+    }
+
+
+def apply_plan_at(sim, plan: FaultPlan, t_ms: int,
+                  slots: Optional[Dict[Endpoint, int]] = None) -> None:
+    """Set the simulator's fault arrays to the plan's state at plan-time
+    ``t_ms``: partitions/flip-flops -> probe-drop targets, probabilistic
+    drops -> per-destination ingress loss."""
+    import numpy as np
+
+    slots = slots if slots is not None else endpoint_slots(sim)
+    round_ms = sim.config.fd_interval_ms // sim.config.rounds_per_interval
+    sim.clear_link_faults()
+    cut: List[int] = []
+    for idx, rule in _device_rules(plan, round_ms):
+        if not rule.active_at(t_ms):
+            continue
+        if rule.match.dst is not None:
+            targets = [slots[rule.match.dst]]
+        else:
+            targets = [s for s in range(sim.config.capacity) if sim.active[s]]
+        if isinstance(rule, (PartitionRule, FlipFlopRule)):
+            cut.extend(targets)
+        elif isinstance(rule, DropRule):
+            sim.ingress_loss(np.asarray(targets), rule.probability)
+    if cut:
+        sim.one_way_ingress_partition(np.asarray(sorted(set(cut))))
+
+
+def replay_on_simulator(sim, plan: FaultPlan, duration_ms: int,
+                        decision_batch: int = 8) -> list:
+    """Replay ``plan`` on the device plane for ``duration_ms`` of protocol
+    time (plan-time zero = the simulator's current ``virtual_ms``), driving
+    the fault arrays through every schedule boundary. Returns the
+    ViewChangeRecords decided within the horizon."""
+    slots = endpoint_slots(sim)
+    round_ms = sim.config.fd_interval_ms // sim.config.rounds_per_interval
+    rules = _device_rules(plan, round_ms)
+    epoch = sim.virtual_ms
+    prior_changes = len(sim.view_changes)
+    times = _boundaries(rules, duration_ms, round_ms)
+    for seg_start, seg_end in zip(times, times[1:]):
+        apply_plan_at(sim, plan, seg_start, slots)
+        target = epoch + seg_end
+        while sim.virtual_ms < target:
+            remaining = math.ceil((target - sim.virtual_ms) / round_ms)
+            rec = sim.run_until_decision(
+                max_rounds=remaining, batch=min(decision_batch, remaining)
+            )
+            if rec is None:
+                break  # budget burned with no decision; next segment
+    return sim.view_changes[prior_changes:]

@@ -169,6 +169,13 @@ def encode(request_no: int, msg: Any) -> bytes:
     if len(body) >= _BODY_MEMO_MIN:
         global _body_memo_bytes
         with _body_memo_lock:
+            # two threads can race to pack the same message: the insert
+            # replaces the loser's entry, so its bytes must come off the
+            # budget inside the same critical section or the accounting
+            # drifts up and evicts live entries early
+            prior = _body_memo.get(id(msg))
+            if prior is not None:
+                _body_memo_bytes -= len(prior[1])
             _body_memo[id(msg)] = (msg, body)
             _body_memo_bytes += len(body)
             # count AND bytes caps: the memo strongly pins message objects

@@ -305,7 +305,6 @@ class _GatewayNetwork:
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="gateway-liveness", daemon=True
         )
-        self._monitor.start()
         self._dialers = ThreadPoolExecutor(
             max_workers=self.DIAL_WORKERS, thread_name_prefix="gateway-dial"
         )
@@ -321,6 +320,10 @@ class _GatewayNetwork:
             )
             for i in range(4)
         ]
+        # started last: the monitor loop dereferences _dialers (and a first
+        # refresh can race construction), so every executor must be assigned
+        # before the thread runs
+        self._monitor.start()
 
     def attach_handler(self, handler) -> None:
         self._handlers.append(handler)

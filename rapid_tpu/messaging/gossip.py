@@ -100,9 +100,15 @@ class GossipBroadcaster(IBroadcaster):
         # None) while KEEPING the dedup key, so dedup safety is unaffected
         # and pulls for dropped payloads stay best-effort (unanswered, the
         # puller retries against a fresher advertiser).
-        self._payload_keys: "deque[Tuple[int, int]]" = deque()
-        self._stored_payloads = 0  # LIVE stored envelopes (deque may hold
-        # stale keys for entries evicted from _seen or re-stored later)
+        # (key, store generation) in store order. A key may appear more than
+        # once (stored, nulled, re-stored): the generation stamps which
+        # store a deque slot refers to, so only the LIVE generation's slot
+        # can evict a payload -- re-seen ids evict oldest-first instead of
+        # a stale slot nulling the fresh payload.
+        self._payload_keys: "deque[Tuple[Tuple[int, int], int]]" = deque()
+        self._payload_gen: dict = {}  # key -> generation of its live payload
+        self._gen = 0
+        self._stored_payloads = 0  # LIVE stored envelopes
 
     # -- IBroadcaster --------------------------------------------------------
 
@@ -172,23 +178,38 @@ class GossipBroadcaster(IBroadcaster):
         else:
             self._seen[key] = (1, first_seen, stored)
         if stored is not None and (prior is None or prior[2] is None):
-            self._payload_keys.append(key)
+            self._gen += 1
+            self._payload_gen[key] = self._gen
+            self._payload_keys.append((key, self._gen))
             self._stored_payloads += 1
         cap = max(_SEEN_CAP, 4 * len(self._members))
         while len(self._seen) > cap:
             _, entry = next(iter(self._seen.items()))
             if now - entry[1] < _SEEN_MIN_AGE_S:
                 break  # everything old enough is gone; let the table grow
-            _, evicted = self._seen.popitem(last=False)
+            evicted_key, evicted = self._seen.popitem(last=False)
             if evicted[2] is not None:
                 self._stored_payloads -= 1
-        # hard payload ceiling, counted over LIVE stored envelopes (the
-        # deque can hold stale keys; popping one without a live payload
-        # must not count against the budget, or fresh payloads get nulled
-        # while the true count is below the cap)
+                self._payload_gen.pop(evicted_key, None)
+        # compact the deque head: slots whose generation is no longer live
+        # (entry left _seen via age eviction, or was re-stored under a newer
+        # generation) are dead weight -- without this the deque grows without
+        # bound under sustained age-based turnover
+        while self._payload_keys and (
+            self._payload_gen.get(self._payload_keys[0][0])
+            != self._payload_keys[0][1]
+        ):
+            self._payload_keys.popleft()
+        # hard payload ceiling, counted over LIVE stored envelopes: only the
+        # slot carrying a key's live generation may null its payload, so a
+        # re-stored id keeps its fresh payload until its own turn comes up
+        # oldest-first
         while self._stored_payloads > cap and self._payload_keys:
-            stale_key = self._payload_keys.popleft()
+            stale_key, gen = self._payload_keys.popleft()
+            if self._payload_gen.get(stale_key) != gen:
+                continue  # superseded or already evicted
             entry = self._seen.get(stale_key)
+            del self._payload_gen[stale_key]
             if entry is not None and entry[2] is not None:
                 self._seen[stale_key] = (entry[0], entry[1], None)
                 self._stored_payloads -= 1

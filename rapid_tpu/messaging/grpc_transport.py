@@ -24,7 +24,7 @@ from .. import types as T
 from ..runtime.futures import Promise
 from ..settings import Settings
 from .base import IMessagingClient, IMessagingServer
-from .retries import call_with_retries
+from .retries import call_with_retries, wall_scheduler
 from .wire_schema import GRPC_METHOD_PATH, MSG
 
 LOG = logging.getLogger(__name__)
@@ -492,6 +492,14 @@ class GrpcClient(IMessagingClient):
         return out
 
     def send_message(self, remote: T.Endpoint, msg: T.RapidMessage) -> Promise:
+        if self._settings.retry_base_delay_ms > 0:
+            return call_with_retries(
+                lambda: self._send_once(remote, msg),
+                self._settings.message_retries,
+                scheduler=wall_scheduler(),
+                policy=self._settings.retry_policy(),
+                deadline_ms=self._settings.deadline_for(msg),
+            )
         return call_with_retries(
             lambda: self._send_once(remote, msg), self._settings.message_retries
         )

@@ -12,6 +12,8 @@ first-class -- they subsume the reference's test interceptors
 from __future__ import annotations
 
 import logging
+import random
+import zlib
 from typing import Callable, Dict, List, Optional
 
 from ..runtime.futures import Promise
@@ -177,10 +179,16 @@ class InProcessClient(IMessagingClient):
     (GrpcClient.java:102-131)."""
 
     def __init__(self, address: Endpoint, network: InProcessNetwork,
-                 settings: Optional[Settings] = None) -> None:
+                 settings: Optional[Settings] = None,
+                 rng: Optional[random.Random] = None) -> None:
         self.address = address
         self._network = network
         self._settings = settings if settings is not None else Settings()
+        # jitter draws; content-seeded (not id/hash-salted) so virtual-time
+        # runs replay bit-identically across processes
+        self._rng = rng if rng is not None else random.Random(
+            zlib.crc32(address.hostname) ^ address.port
+        )
         self._shutdown = False
 
     def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
@@ -188,6 +196,10 @@ class InProcessClient(IMessagingClient):
         return call_with_retries(
             lambda: self._network.deliver(self.address, remote, msg, timeout),
             self._settings.message_retries,
+            scheduler=self._network.scheduler,
+            policy=self._settings.retry_policy(),
+            deadline_ms=self._settings.deadline_for(msg),
+            rng=self._rng,
         )
 
     def send_message_best_effort(self, remote: Endpoint, msg: RapidMessage) -> Promise:

@@ -28,7 +28,7 @@ from ..settings import Settings
 from ..types import Endpoint, NodeStatus, ProbeMessage, ProbeResponse, RapidMessage
 from .base import IMessagingClient, IMessagingServer
 from .codec import HEADER, decode, encode
-from .retries import call_with_retries
+from .retries import call_with_retries, wall_scheduler
 
 LOG = logging.getLogger(__name__)
 
@@ -367,9 +367,22 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
             remote,
         )
 
+    def _retry_kwargs(self, deadline_ms: int) -> dict:
+        """Backoff/deadline wiring for the hardened retry combinator: only a
+        nonzero settings backoff pays for the shared wall-clock scheduler."""
+        if self._settings.retry_base_delay_ms <= 0:
+            return {}
+        return {
+            "scheduler": wall_scheduler(),
+            "policy": self._settings.retry_policy(),
+            "deadline_ms": deadline_ms,
+        }
+
     def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
         return call_with_retries(
-            lambda: self._send_once(remote, msg), self._settings.message_retries
+            lambda: self._send_once(remote, msg),
+            self._settings.message_retries,
+            **self._retry_kwargs(self._settings.deadline_for(msg)),
         )
 
     def send_message_with_timeout(
@@ -383,6 +396,9 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
         return call_with_retries(
             lambda: self._send_once(remote, msg, timeout_ms),
             self._settings.message_retries,
+            **self._retry_kwargs(
+                timeout_ms * (self._settings.message_retries + 1)
+            ),
         )
 
     def send_message_best_effort(self, remote: Endpoint, msg: RapidMessage) -> Promise:

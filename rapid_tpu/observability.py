@@ -42,6 +42,17 @@ class Metrics:
         self._counters.clear()
 
 
+# Process-wide default registry for components that outlive any one Cluster:
+# the nemesis fault plane (faults.py) counts injected faults here unless
+# given a registry ("nemesis_*" counters), and the retry combinator counts
+# "retry_*" when handed one. Tests snapshot/reset it around a run.
+_GLOBAL_METRICS = Metrics()
+
+
+def global_metrics() -> Metrics:
+    return _GLOBAL_METRICS
+
+
 @dataclass
 class Span:
     name: str

@@ -18,6 +18,15 @@ class Settings:
     probe_message_timeout_ms: int = 1000
     message_retries: int = 5
 
+    # Retry backoff between attempts (messaging/retries.py). The reference
+    # resubscribes immediately (Retries.java:44-91), which the 0 default
+    # preserves; a nonzero base delay turns on capped exponential backoff
+    # with the chosen jitter discipline, spaced through the scheduler seam
+    # so virtual-time runs stay deterministic.
+    retry_base_delay_ms: int = 0
+    retry_max_delay_ms: int = 4000
+    retry_jitter: str = "decorrelated"
+
     # Protocol engine (MembershipService.java:75-77)
     failure_detector_interval_ms: int = 1000
     batching_window_ms: int = 100
@@ -38,6 +47,11 @@ class Settings:
             f"fd_policy must be 'cumulative' or 'windowed', got "
             f"{self.fd_policy!r}"
         )
+        assert self.retry_jitter in ("decorrelated", "none"), (
+            f"retry_jitter must be 'decorrelated' or 'none', got "
+            f"{self.retry_jitter!r}"
+        )
+        assert 0 <= self.retry_base_delay_ms <= self.retry_max_delay_ms
 
     # Consensus fallback (FastPaxos.java:46)
     consensus_fallback_base_delay_ms: int = 1000
@@ -55,3 +69,19 @@ class Settings:
         if isinstance(msg, ProbeMessage):
             return self.probe_message_timeout_ms
         return self.message_timeout_ms
+
+    def retry_policy(self):
+        """The backoff schedule these settings describe (RetryPolicy)."""
+        from .messaging.retries import RetryPolicy
+
+        return RetryPolicy(
+            base_delay_ms=self.retry_base_delay_ms,
+            max_delay_ms=self.retry_max_delay_ms,
+            jitter=self.retry_jitter,
+        )
+
+    def deadline_for(self, msg) -> int:
+        """Overall per-message-type send deadline across every retry: the
+        budget the legacy immediate-resubscribe loop consumed in the worst
+        case, now enforced explicitly however the attempts are spaced."""
+        return self.timeout_for(msg) * (self.message_retries + 1)

@@ -48,6 +48,23 @@ from ..sim.engine import (
 NODES_AXIS = "nodes"
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions: the top-level binding (and its
+    ``check_vma`` knob) landed in 0.5.x; older jaxlibs ship it as
+    ``jax.experimental.shard_map`` with the equivalent ``check_rep`` knob."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def make_mesh(
     n_devices: int | None = None,
     shape: Tuple[int, ...] | None = None,
@@ -301,7 +318,7 @@ def make_sharded_run(
     """Build the jitted multi-device round loop: scan of shard_map'd rounds."""
     state_specs, input_specs, axes, axis_sizes = _mesh_specs(config, mesh)
 
-    body = jax.shard_map(
+    body = _shard_map(
         functools.partial(_sharded_round, config, axes, axis_sizes, random_loss),
         mesh=mesh,
         in_specs=(state_specs, input_specs),
@@ -359,7 +376,7 @@ def make_sharded_run_until(
         final, _ = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
         return final
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         run_until,
         mesh=mesh,
         in_specs=(state_specs, input_specs, P()),

@@ -76,6 +76,7 @@ class Simulator:
         seed: int = 0,
         mesh=None,
         speculate: bool = True,
+        identities=None,
     ) -> None:
         """``mesh``: a jax.sharding.Mesh (from shard.engine.make_mesh) to run
         the round loop sharded over multiple devices -- per-edge state
@@ -86,7 +87,13 @@ class Simulator:
 
         ``speculate``: overlap view-change precomputation with the decision
         fetch (_speculate_view_change). Semantically invisible; the flag
-        exists so differential tests can pin that invisibility."""
+        exists so differential tests can pin that invisibility.
+
+        ``identities``: optional [(hostname bytes, port, id_high, id_low)]
+        seated into slots 0.. before any state is built, replacing the
+        synthesized identities -- the cross-plane parity entry: seat the
+        protocol plane's exact endpoints and NodeIds and the configuration
+        ids fold bit-identically on both planes."""
         capacity = capacity if capacity is not None else n_nodes
         assert n_nodes <= capacity
         self.config = config if config is not None else SimConfig(capacity=capacity)
@@ -102,6 +109,10 @@ class Simulator:
             )
         self.mesh = mesh
         self.cluster = VirtualCluster.synthesize(capacity, self.config.k, seed=seed)
+        if identities is not None:
+            assert len(identities) <= capacity
+            for slot, (host, port, id_high, id_low) in enumerate(identities):
+                self.cluster.assign_identity(slot, host, port, id_high, id_low)
         self.active = np.zeros(capacity, dtype=bool)
         self.active[:n_nodes] = True
         self.alive = self.active.copy()

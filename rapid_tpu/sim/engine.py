@@ -116,6 +116,11 @@ class SimConfig:
             f"fd_window must be in [1, 16] (window bitmask is uint16), got "
             f"{self.fd_window}"
         )
+        assert 1 <= self.fd_threshold <= 255, (
+            f"fd_threshold must be in [1, 255] (the per-edge failure counter "
+            f"is uint8 and saturates at 255, so a larger threshold would "
+            f"never fire), got {self.fd_threshold}"
+        )
 
     @property
     def proposal_rows(self) -> int:

@@ -177,6 +177,44 @@ def scenario_flip_flop_with_join_wave(n, capacity, seed):
     }
 
 
+def scenario_nemesis_smoke(n=1000, plan_seed=7):
+    """One seeded FaultPlan compiled onto the device plane's fault arrays
+    (rapid_tpu/faults.py): a 1% wave of one-way partitions whose windows
+    open 2 s into the run, driven through every schedule boundary by
+    replay_on_simulator. The same FaultPlan class drives the in-process and
+    TCP transports (tests/test_faults.py pins the three-plane parity)."""
+    from rapid_tpu.faults import FaultPlan, endpoint_slots, replay_on_simulator
+    from rapid_tpu.sim.driver import Simulator
+
+    sim = Simulator(n, seed=plan_seed)
+    by_slot = {slot: ep for ep, slot in endpoint_slots(sim).items()}
+    rng = np.random.default_rng(plan_seed)
+    victims = sorted(
+        int(v) for v in rng.choice(n, size=max(1, n // 100), replace=False)
+    )
+    plan = FaultPlan(seed=plan_seed)
+    for v in victims:
+        plan.partition_one_way(dst=by_slot[v], windows=((2000, None),))
+    t0 = time.perf_counter()
+    records = replay_on_simulator(sim, plan, duration_ms=60_000)
+    wall = time.perf_counter() - t0
+    cut = sorted({int(c) for rec in records for c in rec.cut})
+    return {
+        "config": (
+            f"nemesis smoke: {len(victims)} windowed one-way partitions "
+            f"(plan seed {plan_seed})"
+        ),
+        "n": n,
+        "virtual_ms": records[-1].virtual_time_ms if records else None,
+        "wall_s": round(wall, 3),
+        "cut_ok": bool(cut == victims),
+        "config_id_ok": bool(
+            records
+            and records[-1].configuration_id == recomputed_config_id(sim)
+        ),
+    }
+
+
 def main() -> None:
     if "--tpu" not in sys.argv:
         # pin the CPU backend via the CONFIG value (an injected accelerator
@@ -185,12 +223,21 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if "--fault-plan" in sys.argv:
+        # replay one seeded nemesis FaultPlan on the device plane and exit:
+        #   python scenarios.py --fault-plan [seed]
+        at = sys.argv.index("--fault-plan")
+        arg = sys.argv[at + 1] if len(sys.argv) > at + 1 else ""
+        plan_seed = int(arg) if arg.lstrip("-").isdigit() else 7
+        print(json.dumps(scenario_nemesis_smoke(plan_seed=plan_seed)))
+        return
     results = [
         scenario_10_node_cross_plane(),
         scenario_crash(1000, 1, 100, "1k virtual nodes, single crash-stop fault"),
         scenario_crash(10_000, 100, 200, "10k virtual nodes, 1% correlated crash burst"),
         scenario_one_way_loss(50_000, 500, 300),
         scenario_flip_flop_with_join_wave(100_000, 100_100, 400),
+        scenario_nemesis_smoke(),
     ]
     if "--scale-1m" in sys.argv:
         # first-class targets at 10x the north-star scale (VERDICT r4 item

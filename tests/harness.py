@@ -45,6 +45,18 @@ class ClusterHarness:
         self.servers: Dict[Endpoint, InProcessServer] = {}
         # optional dissemination swap: factory(client, rng) -> IBroadcaster
         self.broadcaster_factory = None
+        # optional armed fault plane (with_faults); wraps every node built
+        self.nemesis = None
+
+    def with_faults(self, plan) -> "ClusterHarness":
+        """Arm a FaultPlan over this harness's virtual-time fabric: every
+        node built afterwards gets its client/server pair wrapped in the
+        nemesis decorators. Call ``self.nemesis.arm()`` again after bootstrap
+        to restart the plan's windows from a healthy view."""
+        from rapid_tpu.faults import Nemesis
+
+        self.nemesis = Nemesis(plan, self.scheduler)
+        return self
 
     def addr(self, i: int) -> Endpoint:
         return Endpoint.from_parts("127.0.0.1", BASE_PORT + i)
@@ -55,11 +67,14 @@ class ClusterHarness:
                  subscriptions=None) -> ClusterBuilder:
         server = InProcessServer(addr, self.network)
         self.servers[addr] = server
+        client = InProcessClient(addr, self.network, self.settings)
+        if self.nemesis is not None:
+            client = self.nemesis.client(client, address=addr,
+                                         settings=self.settings)
+            server = self.nemesis.server(server, addr)
         builder = (
             ClusterBuilder(addr)
-            .set_messaging_client_and_server(
-                InProcessClient(addr, self.network, self.settings), server
-            )
+            .set_messaging_client_and_server(client, server)
             .use_scheduler(self.scheduler)
             .use_settings(self.settings)
             .use_rng(random.Random(self.rng.getrandbits(64)))

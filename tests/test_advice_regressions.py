@@ -1,0 +1,206 @@
+"""Closing regression tests for the ADVICE.md findings fixed in this PR.
+
+One test per finding, each constructed to fail on the pre-fix code:
+
+1. gossip: the pushpull payload-store deque no longer grows without bound
+   under age-driven dedup-table turnover (head compaction);
+2. gossip: payload-ceiling eviction is oldest-first by store *generation*,
+   so a re-stored id keeps its fresh payload until its own turn;
+3. codec: two threads racing to pack the same large message no longer
+   double-count its bytes against the body-memo budget;
+4. sim: SimConfig rejects fd_threshold values the uint8 failure counter
+   could never reach;
+5. gateway: the liveness monitor thread only starts after the dial/delivery
+   executors it dereferences are assigned.
+"""
+
+import random
+import threading
+
+import pytest
+
+from rapid_tpu.messaging import codec
+from rapid_tpu.messaging import gossip as gossip_mod
+from rapid_tpu.messaging.gossip import GossipBroadcaster
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.types import (
+    Endpoint,
+    GossipEnvelope,
+    JoinResponse,
+    JoinStatusCode,
+    NodeId,
+    ProbeMessage,
+)
+
+ME = Endpoint.from_parts("10.1.0.1", 9)
+PEER = Endpoint.from_parts("10.1.0.2", 9)
+
+
+class _NullClient:
+    def send_message_best_effort(self, remote, msg):
+        return Promise.completed(None)
+
+
+def _pushpull(fanout=2):
+    b = GossipBroadcaster(
+        _NullClient(), ME, fanout=fanout, mode="pushpull",
+        rng=random.Random(0),
+    )
+    b.set_membership([ME, PEER])
+    return b
+
+
+def _envelope(i):
+    return GossipEnvelope(
+        sender=PEER, gossip_id=NodeId(i, ~i), ttl=3,
+        payload=ProbeMessage(sender=PEER),
+    )
+
+
+def test_gossip_payload_deque_bounded_under_table_turnover(monkeypatch):
+    """ADVICE: age-evicted dedup entries left dead slots in _payload_keys
+    forever; the deque must stay proportional to the LIVE store, not to the
+    total envelope history."""
+    monkeypatch.setattr(gossip_mod, "_SEEN_CAP", 8)
+    # negative min age: every entry is immediately old enough to evict
+    monkeypatch.setattr(gossip_mod, "_SEEN_MIN_AGE_S", -1.0)
+    b = _pushpull()
+    for i in range(200):
+        b.receive(_envelope(i))
+    assert len(b._seen) <= 8
+    # pre-fix: ~200 dead slots; post-fix: bounded by the live store
+    assert len(b._payload_keys) <= 2 * 8
+    # every remaining slot refers to a live generation
+    assert all(
+        b._payload_gen.get(key) == gen for key, gen in b._payload_keys
+    )
+
+
+def test_gossip_payload_ceiling_evicts_oldest_first_across_restores(
+    monkeypatch,
+):
+    """ADVICE: without store generations, a re-stored id's stale deque slot
+    could null its FRESH payload out of order. Eviction must consume ids
+    strictly oldest-store-first."""
+    monkeypatch.setattr(gossip_mod, "_SEEN_CAP", 4)
+    # huge min age: the dedup table never evicts, so the payload ceiling
+    # (not table turnover) is what reclaims storage
+    monkeypatch.setattr(gossip_mod, "_SEEN_MIN_AGE_S", 1e9)
+    b = _pushpull()
+    b.set_membership([ME])  # cap = max(_SEEN_CAP, 4 * |members|) = 4
+
+    def key(i):
+        return (i, ~i)
+
+    def stored(i):
+        entry = b._seen.get(key(i))
+        return entry is not None and entry[2] is not None
+
+    for i in range(1, 5):
+        b.receive(_envelope(i))  # e1..e4 stored, at the ceiling
+    assert all(stored(i) for i in range(1, 5))
+    b.receive(_envelope(5))  # over the ceiling: e1 (oldest) is nulled
+    assert not stored(1) and all(stored(i) for i in range(2, 6))
+    # e1 seen again: re-stored under a NEW generation; the ceiling must now
+    # take e2 (the oldest live store), not the freshly re-stored e1
+    b.receive(_envelope(1))
+    assert stored(1) and not stored(2)
+    b.receive(_envelope(6))  # next oldest is e3
+    assert not stored(3)
+    assert stored(1) and stored(4) and stored(5) and stored(6)
+
+
+def test_codec_body_memo_bytes_not_double_counted_on_pack_race():
+    """ADVICE: two threads racing encode() on the same large message both
+    packed and both added their bytes; the replaced entry's bytes must come
+    off the budget. A barrier inside packb forces the lost-race interleaving
+    deterministically."""
+    msg = JoinResponse(
+        sender=ME, status_code=JoinStatusCode.SAFE_TO_JOIN,
+        configuration_id=1,
+        endpoints=tuple(
+            Endpoint.from_parts("10.9.%d.%d" % (i // 250, i % 250), 4000 + i)
+            for i in range(4000)
+        ),
+        identifiers=(NodeId(1, 2),),
+    )
+    real_packb = codec.msgpack.packb
+    barrier = threading.Barrier(2, timeout=20)
+
+    def racing_packb(payload, **kw):
+        body = real_packb(payload, **kw)
+        barrier.wait()  # both threads pack before either inserts
+        return body
+
+    with codec._body_memo_lock:
+        bytes_before = codec._body_memo_bytes
+    errors = []
+    frames = []
+
+    def encode_once(request_no):
+        try:
+            frames.append(codec.encode(request_no, msg))
+        except Exception as e:  # noqa: BLE001 -- surfaced via the assert below
+            errors.append(e)
+
+    codec.msgpack.packb = racing_packb
+    try:
+        threads = [
+            threading.Thread(target=encode_once, args=(i,)) for i in (1, 2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        codec.msgpack.packb = real_packb
+    try:
+        assert not errors, errors
+        body_len = len(frames[0]) - codec.ENVELOPE.size
+        assert body_len >= codec._BODY_MEMO_MIN  # the memo path actually ran
+        with codec._body_memo_lock:
+            # pre-fix: 2 * body_len (the loser's insert double-counted)
+            assert codec._body_memo_bytes - bytes_before == body_len
+    finally:
+        with codec._body_memo_lock:
+            entry = codec._body_memo.pop(id(msg), None)
+            if entry is not None:
+                codec._body_memo_bytes -= len(entry[1])
+
+
+def test_sim_config_rejects_unreachable_fd_threshold():
+    """ADVICE: the per-edge failure counter is uint8; a threshold past 255
+    would silently never fire. Constructing such a config must fail."""
+    from rapid_tpu.sim.engine import SimConfig
+
+    SimConfig(capacity=4)  # defaults fine
+    SimConfig(capacity=4, fd_threshold=255)  # inclusive upper bound
+    with pytest.raises(AssertionError):
+        SimConfig(capacity=4, fd_threshold=256)
+    with pytest.raises(AssertionError):
+        SimConfig(capacity=4, fd_threshold=0)
+
+
+def test_gateway_monitor_thread_starts_after_executors(monkeypatch):
+    """ADVICE: the liveness monitor was started before the dial/delivery
+    executors existed; a promptly-scheduled first refresh crashed on the
+    missing attributes. Run the thread body synchronously inside start()
+    (the worst-case scheduling) and require the executors to be there."""
+    from rapid_tpu.messaging import gateway as gw
+
+    seen = {}
+
+    def probe_loop(self):
+        seen["dialers"] = hasattr(self, "_dialers")
+        seen["delivery"] = hasattr(self, "_delivery")
+
+    monkeypatch.setattr(gw._GatewayNetwork, "_monitor_loop", probe_loop)
+    monkeypatch.setattr(threading.Thread, "start", lambda self: self.run())
+    net = gw._GatewayNetwork(None, None)
+    try:
+        assert seen == {"dialers": True, "delivery": True}
+    finally:
+        net._stop.set()
+        net._dialers.shutdown(wait=False)
+        for lane in net._delivery:
+            lane.shutdown(wait=False)

@@ -1,0 +1,549 @@
+"""Nemesis fault plane + hardened retry machinery.
+
+Pins the ISSUE acceptance criteria: decision streams are deterministic and
+replayable from the plan seed alone; `call_with_retries` backoff/deadline
+behavior is exact on the virtual clock; and one seeded FaultPlan replayed on
+(a) the in-process transport, (b) the TCP transport, and (c) the device
+plane's fault arrays yields identical cut sets and configuration ids.
+"""
+
+import random
+import time
+
+import pytest
+
+from harness import ClusterHarness, free_port_base
+from rapid_tpu import ClusterBuilder, Endpoint, Settings
+from rapid_tpu.faults import (
+    EGRESS,
+    INGRESS,
+    FaultPlan,
+    Nemesis,
+    UnsupportedDeviceFault,
+    _device_rules,
+    replay_on_simulator,
+)
+from rapid_tpu.messaging.retries import (
+    RetryDeadlineExceeded,
+    RetryPolicy,
+    call_with_retries,
+)
+from rapid_tpu.messaging.tcp import TcpClientServer
+from rapid_tpu.observability import Metrics, global_metrics
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.runtime.scheduler import RealScheduler, VirtualScheduler
+from rapid_tpu.types import ProbeMessage, Response
+
+A = Endpoint.from_parts("10.0.0.1", 50)
+B = Endpoint.from_parts("10.0.0.2", 50)
+
+
+# ---------------------------------------------------------------------------
+# call_with_retries: exact virtual-time schedules
+# ---------------------------------------------------------------------------
+
+
+def _scripted_attempt(scheduler, outcomes):
+    """attempt() recording each call's virtual time; outcomes are popped in
+    order -- an Exception fails the promise, anything else completes it."""
+    times = []
+
+    def attempt():
+        times.append(scheduler.now_ms())
+        out = outcomes.pop(0)
+        p = Promise()
+        if isinstance(out, Exception):
+            p.try_set_exception(out)
+        else:
+            p.try_set_result(out)
+        return p
+
+    return attempt, times
+
+
+def test_backoff_schedule_exact_in_virtual_time():
+    sched = VirtualScheduler()
+    attempt, times = _scripted_attempt(
+        sched, [RuntimeError("x")] * 4 + ["ok"]
+    )
+    p = call_with_retries(
+        attempt, 4, scheduler=sched,
+        policy=RetryPolicy(base_delay_ms=100, max_delay_ms=1000, jitter="none"),
+    )
+    assert sched.run_until(p.done, timeout_ms=60_000)
+    assert p.exception() is None and p.peek() == "ok"
+    # doubling from the base, uncapped within this horizon
+    assert times == [0, 100, 300, 700, 1500]
+
+
+def test_backoff_respects_max_delay_cap():
+    sched = VirtualScheduler()
+    attempt, times = _scripted_attempt(
+        sched, [RuntimeError("x")] * 4 + ["ok"]
+    )
+    p = call_with_retries(
+        attempt, 4, scheduler=sched,
+        policy=RetryPolicy(base_delay_ms=100, max_delay_ms=300, jitter="none"),
+    )
+    assert sched.run_until(p.done, timeout_ms=60_000)
+    assert times == [0, 100, 300, 600, 900]  # 100, 200, 300, 300
+
+
+def test_retries_exhausted_fails_with_last_error():
+    sched = VirtualScheduler()
+    last = RuntimeError("final")
+    attempt, times = _scripted_attempt(
+        sched, [RuntimeError("a"), RuntimeError("b"), last]
+    )
+    metrics = Metrics()
+    p = call_with_retries(
+        attempt, 2, scheduler=sched,
+        policy=RetryPolicy(base_delay_ms=100, jitter="none"),
+        metrics=metrics,
+    )
+    assert sched.run_until(p.done, timeout_ms=60_000)
+    assert p.exception() is last
+    assert times == [0, 100, 300]
+    assert metrics.get("retry_attempts") == 3
+    assert metrics.get("retry_exhausted") == 1
+
+
+def test_deadline_fails_fast_without_sleeping_past_it():
+    sched = VirtualScheduler()
+    cause = RuntimeError("down")
+    attempt, times = _scripted_attempt(sched, [cause] * 10)
+    metrics = Metrics()
+    p = call_with_retries(
+        attempt, 9, scheduler=sched,
+        policy=RetryPolicy(base_delay_ms=100, jitter="none"),
+        deadline_ms=250, metrics=metrics,
+    )
+    assert sched.run_until(p.done, timeout_ms=60_000)
+    exc = p.exception()
+    assert isinstance(exc, RetryDeadlineExceeded)
+    assert exc.__cause__ is cause
+    # attempt at 0 fails -> retry at 100 fails -> next delay (200) would land
+    # at 300 >= 250: the deadline is declared AT 100, not slept through
+    assert times == [0, 100]
+    assert sched.now_ms() == 100
+    assert metrics.get("retry_deadline_exceeded") == 1
+
+
+def test_default_policy_is_legacy_immediate_resubscribe():
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        p = Promise()
+        if len(calls) < 3:
+            p.try_set_exception(RuntimeError("x"))
+        else:
+            p.try_set_result("ok")
+        return p
+
+    # no scheduler, no policy, no deadline: completes synchronously
+    p = call_with_retries(attempt, 5)
+    assert p.done() and p.peek() == "ok"
+    assert len(calls) == 3
+
+
+def test_backoff_without_scheduler_is_rejected():
+    with pytest.raises(AssertionError):
+        call_with_retries(
+            lambda: Promise.completed(1), 1,
+            policy=RetryPolicy(base_delay_ms=10),
+        )
+
+
+def test_decorrelated_jitter_is_seed_deterministic_and_bounded():
+    policy = RetryPolicy(base_delay_ms=50, max_delay_ms=10_000)
+
+    def delays(seed):
+        rng = random.Random(seed)
+        prev, out = 0, []
+        for _ in range(16):
+            prev = policy.next_delay_ms(prev, rng)
+            out.append(prev)
+        return out
+
+    assert delays(3) == delays(3)
+    assert delays(3) != delays(4)
+    seq = delays(3)
+    prev = 0
+    for d in seq:
+        assert 50 <= d <= min(10_000, max(50, prev * 3))
+        prev = d
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / Nemesis decision streams
+# ---------------------------------------------------------------------------
+
+
+def _decision_stream(seed, n=64):
+    nem = Nemesis(
+        FaultPlan(seed=seed)
+        .drop(0.5)
+        .duplicate(0.3)
+        .reorder(0.25, max_extra_ms=40),
+        VirtualScheduler(), metrics=Metrics(),
+    ).arm(0)
+    return [nem.decide(A, B, ProbeMessage(sender=A), EGRESS) for _ in range(n)]
+
+
+def test_decision_stream_is_plan_seed_deterministic():
+    s1, s2 = _decision_stream(9), _decision_stream(9)
+    assert s1 == s2
+    assert _decision_stream(9) != _decision_stream(10)
+    # the stream actually exercises every fault class
+    assert any(d.drop for d in s1) and any(not d.drop for d in s1)
+    assert any(d.duplicates for d in s1)
+    assert any(d.reordered for d in s1)
+
+
+def test_decisions_are_independent_of_link_interleaving():
+    """Each link owns its sequence numbers: interleaving draws for another
+    link must not perturb this link's stream."""
+    plain = _decision_stream(9)
+    nem = Nemesis(
+        FaultPlan(seed=9).drop(0.5).duplicate(0.3).reorder(0.25, max_extra_ms=40),
+        VirtualScheduler(), metrics=Metrics(),
+    ).arm(0)
+    interleaved = []
+    for _ in range(64):
+        interleaved.append(nem.decide(A, B, ProbeMessage(sender=A), EGRESS))
+        nem.decide(B, A, ProbeMessage(sender=B), EGRESS)  # noise on B->A
+    assert interleaved == plain
+
+
+def test_windows_and_flip_flop_schedules():
+    plan = (
+        FaultPlan(seed=0)
+        .drop(1.0, windows=((500, 700),))
+        .flip_flop(period_ms=2000, dst=B, start_ms=1000)
+    )
+    windowed, ff = plan.rules
+    assert not windowed.active_at(499)
+    assert windowed.active_at(500) and windowed.active_at(699)
+    assert not windowed.active_at(700)
+    # flip-flop: cut at [1000, 2000), healed [2000, 3000), cut again ...
+    assert not ff.active_at(0) and not ff.active_at(999)
+    assert ff.active_at(1000) and ff.active_at(1999)
+    assert not ff.active_at(2000) and not ff.active_at(2999)
+    assert ff.active_at(3000)
+    # plan time before the arm epoch (negative) is always fault-free: this
+    # is what lets a run bootstrap cleanly before arming the schedule
+    assert not windowed.active_at(-1) and not ff.active_at(-1)
+
+
+class _RecordingClient:
+    def __init__(self, scheduler):
+        self.sched = scheduler
+        self.sent = []  # (virtual time, remote, msg)
+
+    def send_message_best_effort(self, remote, msg):
+        self.sent.append((self.sched.now_ms(), remote, msg))
+        return Promise.completed(Response())
+
+    def send_message(self, remote, msg):
+        return self.send_message_best_effort(remote, msg)
+
+    def shutdown(self):
+        pass
+
+
+def test_nemesis_client_drop_times_out_on_message_timeout():
+    sched = VirtualScheduler()
+    settings = Settings()
+    nem = Nemesis(FaultPlan(seed=1).partition_one_way(dst=B), sched,
+                  metrics=Metrics()).arm(0)
+    inner = _RecordingClient(sched)
+    client = nem.client(inner, address=A, settings=settings)
+    p = client.send_message_best_effort(B, ProbeMessage(sender=A))
+    sched.run_for(settings.probe_message_timeout_ms - 1)
+    assert not p.done() and inner.sent == []
+    sched.run_for(2)
+    assert p.done() and isinstance(p.exception(), TimeoutError)
+    assert inner.sent == []  # dropped on the wire, never forwarded
+    assert nem.metrics.get("nemesis_dropped") == 1
+
+
+def test_nemesis_client_delay_and_duplicate():
+    sched = VirtualScheduler()
+    nem = Nemesis(
+        FaultPlan(seed=1).delay(base_ms=250, dst=B).duplicate(1.0, dst=B),
+        sched, metrics=Metrics(),
+    ).arm(0)
+    inner = _RecordingClient(sched)
+    client = nem.client(inner, address=A, settings=Settings())
+    msg = ProbeMessage(sender=A)
+    p = client.send_message_best_effort(B, msg)
+    # the duplicate copy goes out immediately; the original is held 250 ms
+    assert [t for t, _, _ in inner.sent] == [0]
+    sched.run_for(249)
+    assert len(inner.sent) == 1 and not p.done()
+    sched.run_for(2)
+    assert [t for t, _, _ in inner.sent] == [0, 250]
+    assert p.done() and p.exception() is None
+    assert nem.metrics.get("nemesis_duplicated") == 1
+    assert nem.metrics.get("nemesis_delayed") == 1
+
+
+def test_nemesis_ingress_drop_applies_at_the_server():
+    sched = VirtualScheduler()
+    nem = Nemesis(
+        FaultPlan(seed=1).partition_one_way(dst=B, at=INGRESS), sched,
+        metrics=Metrics(),
+    ).arm(0)
+
+    class _Service:
+        def __init__(self):
+            self.handled = []
+
+        def handle_message(self, msg):
+            self.handled.append(msg)
+            return Promise.completed(Response())
+
+    class _Server:
+        def __init__(self):
+            self.service = None
+
+        def start(self):
+            pass
+
+        def shutdown(self):
+            pass
+
+        def set_membership_service(self, service):
+            self.service = service
+
+    service, server = _Service(), _Server()
+    wrapped = nem.server(server, B)
+    wrapped.set_membership_service(service)
+    p = server.service.handle_message(ProbeMessage(sender=A))
+    assert service.handled == [] and not p.done()
+    assert nem.metrics.get("nemesis_dropped") == 1
+
+
+# ---------------------------------------------------------------------------
+# cluster-level: deterministic replay on the in-process fabric
+# ---------------------------------------------------------------------------
+
+
+def _run_probabilistic_cut(n=4):
+    """Bootstrap n nodes on real pingpong FDs, then arm a 70% probe-loss
+    fault toward one victim; the cumulative FD threshold cuts it. Returns
+    what the survivors decided."""
+    h = ClusterHarness(seed=3, use_static_fd=False)
+    victim = h.addr(n - 1)
+    h.with_faults(
+        FaultPlan(seed=11).drop(0.7, dst=victim, msg_types=(ProbeMessage,))
+    )
+    h.nemesis.arm(epoch_ms=1 << 40)  # dormant during bootstrap
+    h.create_cluster(n, parallel=False)
+    h.wait_and_verify_agreement(n)
+    h.nemesis.arm()
+    vic = h.instances.pop(victim)
+    try:
+        h.wait_and_verify_agreement(n - 1)
+        survivor = h.instances[h.addr(0)]
+        return (
+            tuple(survivor.get_memberlist()),
+            survivor.get_current_configuration_id(),
+        )
+    finally:
+        vic.shutdown()
+        h.shutdown()
+
+
+def test_inprocess_probabilistic_faults_replay_identically():
+    before = global_metrics().get("nemesis_dropped")
+    first = _run_probabilistic_cut()
+    assert global_metrics().get("nemesis_dropped") > before
+    assert first == _run_probabilistic_cut()
+
+
+# ---------------------------------------------------------------------------
+# device-plane compilation
+# ---------------------------------------------------------------------------
+
+
+def test_device_compilation_validates_rules():
+    # absorbed by the round model: fine
+    ok = (
+        FaultPlan(seed=0)
+        .partition_one_way(dst=B)
+        .drop(0.2)
+        .duplicate(0.5)
+        .reorder(0.5)
+        .delay(base_ms=5)
+    )
+    assert [idx for idx, _ in _device_rules(ok, round_ms=1000)] == [0, 1]
+    # a delay of a round or more cannot be absorbed
+    with pytest.raises(UnsupportedDeviceFault):
+        _device_rules(FaultPlan(seed=0).delay(base_ms=1000), round_ms=1000)
+    # per-source faults have no device analogue (mask is per destination)
+    with pytest.raises(UnsupportedDeviceFault):
+        _device_rules(FaultPlan(seed=0).partition_one_way(src=A), round_ms=1000)
+    # non-probe-affecting drops do not touch the probe mask
+    with pytest.raises(UnsupportedDeviceFault):
+        _device_rules(
+            FaultPlan(seed=0).drop(0.5, msg_types=(Response,)), round_ms=1000
+        )
+
+
+def test_flip_flop_windows_drive_the_device_fault_arrays():
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.faults import apply_plan_at, endpoint_slots
+
+    sim = Simulator(4, seed=2)
+    slots = endpoint_slots(sim)
+    victim_ep = next(ep for ep, s in slots.items() if s == 3)
+    plan = FaultPlan(seed=0).flip_flop(period_ms=2000, dst=victim_ep)
+    apply_plan_at(sim, plan, t_ms=500, slots=slots)
+    assert sim._ingress_partitioned == {3}
+    apply_plan_at(sim, plan, t_ms=1500, slots=slots)  # healed half-period
+    assert sim._ingress_partitioned == set()
+    apply_plan_at(sim, plan, t_ms=2500, slots=slots)
+    assert sim._ingress_partitioned == {3}
+
+
+# ---------------------------------------------------------------------------
+# the flagship: one plan, three planes, identical cuts and config ids
+# ---------------------------------------------------------------------------
+
+
+def _wait_real(predicate, what, deadline_s=60.0):
+    end = time.time() + deadline_s
+    while time.time() < end:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class _PortedHarness(ClusterHarness):
+    """ClusterHarness on an arbitrary port base, so the in-process run uses
+    the exact endpoints the TCP run will bind."""
+
+    def __init__(self, base, **kw):
+        self._base = base
+        super().__init__(**kw)
+
+    def addr(self, i):
+        return Endpoint.from_parts("127.0.0.1", self._base + i)
+
+
+def test_one_fault_plan_three_planes_identical_decisions():
+    """ISSUE acceptance: a seeded FaultPlan (one-way partition of one node)
+    replayed on the in-process transport, the TCP transport, and the device
+    plane produces the same cut set and the same configuration id."""
+    n = 5
+    cluster_seed = 77
+    base = free_port_base(n)
+    victim = Endpoint.from_parts("127.0.0.1", base + n - 1)
+
+    def plan():
+        return FaultPlan(seed=7).partition_one_way(dst=victim)
+
+    # (a) in-process transport, virtual time ------------------------------
+    h = _PortedHarness(base, seed=cluster_seed, use_static_fd=False)
+    h.with_faults(plan())
+    h.nemesis.arm(epoch_ms=1 << 40)
+    h.start_seed(0)
+    for i in range(1, n):
+        h.join(i)
+        h.wait_and_verify_agreement(i + 1)
+    full_cfg = (
+        h.instances[h.addr(0)]._membership_service._view.get_configuration()
+    )
+    h.nemesis.arm()  # plan time zero = now: the partition opens
+    vic = h.instances.pop(victim)
+    try:
+        h.wait_and_verify_agreement(n - 1)
+        survivor = h.instances[h.addr(0)]
+        ip_members = tuple(survivor.get_memberlist())
+        ip_config = survivor.get_current_configuration_id()
+    finally:
+        vic.shutdown()
+        h.shutdown()
+    assert victim not in ip_members and len(ip_members) == n - 1
+
+    # (b) TCP sockets, real time: same endpoints, same per-node rng
+    # derivation as the harness -> identical NodeIds -----------------------
+    srng = random.Random(cluster_seed)
+    node_seeds = [srng.getrandbits(64) for _ in range(n)]
+    settings = Settings(
+        failure_detector_interval_ms=50,
+        batching_window_ms=20,
+        consensus_fallback_base_delay_ms=200,
+        probe_message_timeout_ms=100,
+    )
+    nem = Nemesis(plan(), RealScheduler(name="nemesis-tcp-test"),
+                  metrics=Metrics())
+    nem.arm(epoch_ms=nem.scheduler.now_ms() + (1 << 40))
+    clusters = []
+
+    def build(i, seed_ep=None):
+        addr = Endpoint.from_parts("127.0.0.1", base + i)
+        transport = TcpClientServer(addr, settings)
+        builder = (
+            ClusterBuilder(addr)
+            .use_settings(settings)
+            .set_messaging_client_and_server(
+                nem.client(transport, address=addr, settings=settings),
+                nem.server(transport, addr),
+            )
+            .use_rng(random.Random(node_seeds[i]))
+        )
+        if seed_ep is None:
+            return builder.start()
+        return builder.join(seed_ep, timeout=30)
+
+    try:
+        clusters.append(build(0))
+        for i in range(1, n):
+            clusters.append(build(i, clusters[0].listen_address))
+            size = i + 1
+            _wait_real(
+                lambda: all(
+                    c.get_membership_size() == size for c in clusters
+                ),
+                f"TCP join convergence to {size}",
+            )
+        nem.arm()
+        survivors = clusters[:-1]
+        _wait_real(
+            lambda: all(
+                c.get_membership_size() == n - 1 for c in survivors
+            ),
+            "TCP cut convergence",
+        )
+        tcp_members = tuple(survivors[0].get_memberlist())
+        tcp_config = survivors[0].get_current_configuration_id()
+        tcp_ids = (
+            survivors[0]._membership_service._view.get_configuration().node_ids
+        )
+    finally:
+        for c in clusters:
+            c.shutdown()
+
+    assert set(tcp_ids) == set(full_cfg.node_ids)
+    assert tcp_members == ip_members
+    assert tcp_config == ip_config
+
+    # (c) device plane: seat the same identities, replay the same plan ----
+    from rapid_tpu.sim.driver import Simulator
+
+    identities = [
+        (ep.hostname, ep.port, nid.high, nid.low)
+        for ep, nid in zip(
+            (Endpoint.from_parts("127.0.0.1", base + i) for i in range(n)),
+            full_cfg.node_ids,
+        )
+    ]
+    sim = Simulator(n, seed=5, identities=identities)
+    records = replay_on_simulator(sim, plan(), duration_ms=40_000)
+    assert len(records) == 1
+    assert [int(s) for s in records[0].cut] == [n - 1]
+    assert records[0].configuration_id == ip_config == tcp_config
