@@ -67,6 +67,47 @@ WATCHDOG_S = 20 * 60
 _PROGRESS: dict = {"headline": None, "backend": None, "sweep": []}
 
 
+def _stable_view_hist() -> "dict | None":
+    """Virtual-time time_to_stable_view_ms histogram accumulated across every
+    simulator this process ran (headline + sweep), pulled off the global
+    registry. None when nothing was recorded (e.g. the device layer stubbed
+    out in the contract tests)."""
+    try:
+        from rapid_tpu.observability import global_metrics
+
+        snap = global_metrics().histogram("time_to_stable_view_ms", plane="sim")
+        return snap if snap["count"] else None
+    except Exception:  # noqa: BLE001 -- telemetry must never sink the artifact
+        return None
+
+
+def _flag_value(flag: str) -> "str | None":
+    """Tolerant --flag VALUE / --flag=VALUE scan. argparse would choke on
+    pytest's argv when the contract tests call main() in-process."""
+    argv = sys.argv[1:]
+    for i, arg in enumerate(argv):
+        if arg == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith(flag + "="):
+            return arg.split("=", 1)[1]
+    return None
+
+
+def _write_telemetry() -> None:
+    """Optional --trace-out / --metrics-out exports of the run's telemetry."""
+    trace_out, metrics_out = _flag_value("--trace-out"), _flag_value("--metrics-out")
+    if trace_out is None and metrics_out is None:
+        return
+    from rapid_tpu.observability import write_chrome_trace, write_prometheus
+
+    if trace_out is not None:
+        write_chrome_trace(trace_out)
+        print(f"bench.py: wrote Chrome trace to {trace_out}", file=sys.stderr, flush=True)
+    if metrics_out is not None:
+        write_prometheus(metrics_out)
+        print(f"bench.py: wrote Prometheus text to {metrics_out}", file=sys.stderr, flush=True)
+
+
 def _emit_json(headline: dict, backend: str, sweep: list) -> None:
     merged = list(sweep) + [
         {
@@ -86,6 +127,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "vs_baseline": round(headline["value"] / BASELINE_MS, 4),
                 "backend": backend,
                 "sweep": merged,
+                "time_to_stable_view_ms": _stable_view_hist(),
             }
         ),
         flush=True,
@@ -253,6 +295,7 @@ def main() -> None:
     }
     sweep = run_sweep(backend, seed=42)
     _emit_json(_PROGRESS["headline"], backend, sweep)
+    _write_telemetry()
     print(
         f"# membership={N_NODES}->{record.membership_size} cut={len(record.cut)} nodes "
         f"virtual_time={record.virtual_time_ms}ms config_id={record.configuration_id} "

@@ -61,6 +61,17 @@ def main() -> None:
         "very large view takes longer: the full configuration must be "
         "shipped and the member's rings built)",
     )
+    parser.add_argument(
+        "--metrics-out",
+        help="path rewritten once per status tick with the Prometheus text "
+        "exposition of this agent's metrics (point node_exporter's textfile "
+        "collector or a file-based scraper at it)",
+    )
+    parser.add_argument(
+        "--trace-out",
+        help="path written on shutdown with a Chrome trace_event JSON of the "
+        "agent's spans (load in Perfetto / chrome://tracing)",
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
 
@@ -161,8 +172,18 @@ def main() -> None:
                 cluster.get_current_configuration_id(),
                 [str(m) for m in members] if len(members) <= 32 else "...",
             )
+            if args.metrics_out:
+                from rapid_tpu.observability import write_prometheus
+
+                write_prometheus(args.metrics_out)
     except KeyboardInterrupt:
         cluster.leave_gracefully()
+    finally:
+        if args.trace_out:
+            from rapid_tpu.observability import write_chrome_trace
+
+            write_chrome_trace(args.trace_out)
+            log.info("wrote Chrome trace to %s", args.trace_out)
 
 
 if __name__ == "__main__":

@@ -25,7 +25,7 @@ from .messaging.base import IMessagingClient, IMessagingServer
 from .metadata import FrozenMetadata
 from .monitoring.base import IEdgeFailureDetectorFactory
 from .monitoring.pingpong import PingPongFailureDetectorFactory
-from .observability import Metrics
+from .observability import Metrics, Tracer, global_metrics
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
 from .runtime.scheduler import Scheduler
@@ -45,12 +45,16 @@ H = 9
 L = 4
 RETRIES = 5
 
-# Process-wide join-health counters (regression guard for seed starvation:
-# a seed that answers phase 1 within the deadline keeps
-# ``join.phase1_no_response`` at zero; ``join.exhausted`` counts joins that
-# burned all RETRIES attempts). Protocol-legal retries -- CONFIG_CHANGED,
-# UUID redraws, phase-2 races -- are deliberately NOT counted here.
-JOIN_METRICS = Metrics()
+# Join-health counters (regression guard for seed starvation: a seed that
+# answers phase 1 within the deadline keeps ``join.phase1_no_response`` at
+# zero; ``join.exhausted`` counts joins that burned all RETRIES attempts).
+# Protocol-legal retries -- CONFIG_CHANGED, UUID redraws, phase-2 races --
+# are deliberately NOT counted here. Promoted onto the telemetry plane: a
+# builder with an injected registry (``use_metrics``) counts there (so tests
+# stop leaking state into each other); otherwise counts land on the
+# process-global registry, which this module-level alias re-exports for
+# existing importers.
+JOIN_METRICS = global_metrics()
 
 
 class JoinException(RuntimeError):
@@ -141,6 +145,8 @@ class ClusterBuilder:
         self._scheduler: Optional[Scheduler] = None
         self._rng: Optional[random.Random] = None
         self._broadcaster_factory = None
+        self._metrics: Optional[Metrics] = None
+        self._tracer: Optional[Tracer] = None
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -178,6 +184,19 @@ class ClusterBuilder:
         """Seeded randomness for deterministic runs (node IDs, broadcast
         shuffles, consensus jitter)."""
         self._rng = rng
+        return self
+
+    def use_metrics(self, metrics: Metrics) -> "ClusterBuilder":
+        """Inject the metrics registry for this node (join diagnostics,
+        failure detectors, and the MembershipService all count there).
+        Default: a per-node registry attached to ``global_metrics()``."""
+        self._metrics = metrics
+        return self
+
+    def use_tracer(self, tracer: Tracer) -> "ClusterBuilder":
+        """Inject the span tracer for this node. Default: a per-node tracer
+        attached to ``global_tracer()``."""
+        self._tracer = tracer
         return self
 
     def set_broadcaster_factory(self, factory) -> "ClusterBuilder":
@@ -233,10 +252,12 @@ class ClusterBuilder:
                 self._listen_address, client,
                 window=self._settings.fd_window,
                 threshold=self._settings.fd_window_threshold,
+                metrics=self._metrics,
             )
         return PingPongFailureDetectorFactory(
             self._listen_address, client,
             failure_threshold=self._settings.fd_failure_threshold,
+            metrics=self._metrics,
         )
 
     def start(self) -> Cluster:
@@ -260,6 +281,8 @@ class ClusterBuilder:
             subscriptions=self._subscriptions,
             rng=rng,
             broadcaster=self._broadcaster(client, rng),
+            metrics=self._metrics,
+            tracer=self._tracer,
         )
         server.set_membership_service(service)
         server.start()
@@ -279,9 +302,10 @@ class ClusterBuilder:
         server.start()
         result: Promise = Promise()
         state = {"node_id": NodeId.random(rng), "attempt": 0}
+        join_metrics = self._metrics if self._metrics is not None else JOIN_METRICS
 
         def fail_all(reason: str) -> None:
-            JOIN_METRICS.incr("join.exhausted")
+            join_metrics.incr("join.exhausted")
             server.shutdown()
             client.shutdown()
             resources.shutdown()
@@ -304,7 +328,7 @@ class ClusterBuilder:
             if p.exception() is not None:
                 # the seed never answered within the join deadline -- the
                 # starvation signature, distinct from protocol-legal retries
-                JOIN_METRICS.incr("join.phase1_no_response")
+                join_metrics.incr("join.phase1_no_response")
                 next_attempt(f"phase 1 failed: {p.exception()}")
                 return
             response = p.peek()
@@ -384,6 +408,8 @@ class ClusterBuilder:
                 subscriptions=self._subscriptions,
                 rng=rng,
                 broadcaster=self._broadcaster(client, rng),
+                metrics=self._metrics,
+                tracer=self._tracer,
             )
             server.set_membership_service(service)
             result.set_result(

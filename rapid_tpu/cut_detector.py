@@ -18,12 +18,13 @@ Semantics preserved exactly:
 
 from __future__ import annotations
 
-from typing import Dict, List, Set, TYPE_CHECKING
+from typing import Dict, List, Optional, Set, TYPE_CHECKING
 
 from .types import AlertMessage, EdgeStatus, Endpoint
 
 if TYPE_CHECKING:  # pragma: no cover
     from .membership import MembershipView
+    from .observability import Metrics, Tracer
 
 K_MIN = 3
 
@@ -37,12 +38,19 @@ class MultiNodeCutDetector:
         self.k = k
         self.h = h
         self.l = l
+        # telemetry plane (optional): bound by the owning MembershipService
+        self._metrics: Optional["Metrics"] = None
+        self._tracer: Optional["Tracer"] = None
         self._proposal_count = 0
         self._updates_in_progress = 0
         self._reports_per_host: Dict[Endpoint, Dict[int, Endpoint]] = {}
         self._proposal: Set[Endpoint] = set()
         self._pre_proposal: Set[Endpoint] = set()
         self._seen_link_down_events = False
+
+    def bind_telemetry(self, metrics: "Metrics", tracer: "Tracer") -> None:
+        self._metrics = metrics
+        self._tracer = tracer
 
     @property
     def num_proposals(self) -> int:
@@ -82,6 +90,10 @@ class MultiNodeCutDetector:
                 self._proposal_count += 1
                 ret = list(self._proposal)
                 self._proposal.clear()
+                if self._metrics is not None:
+                    self._metrics.incr("cut.proposals_emitted")
+                if self._tracer is not None:
+                    self._tracer.event("cut_detected", size=len(ret))
                 return ret
         return []
 

@@ -16,6 +16,7 @@ import random
 from typing import Callable, Dict, List, Optional, Set
 
 from .messaging.base import IBroadcaster, IMessagingClient
+from .observability import Metrics, Tracer
 from .paxos import Paxos, Proposal
 from .runtime.scheduler import ScheduledTask, Scheduler
 from .types import (
@@ -43,7 +44,11 @@ class FastPaxos:
         on_decide: Callable[[List[Endpoint]], None],
         consensus_fallback_base_delay_ms: int = BASE_DELAY_MS,
         rng: Optional[random.Random] = None,
+        metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
+        self._metrics = metrics
+        self._tracer = tracer
         self._my_addr = my_addr
         self._configuration_id = configuration_id
         self._n = membership_size
@@ -72,7 +77,7 @@ class FastPaxos:
         self._on_decided_wrapped = on_decided_wrapped
         self._paxos = Paxos(
             my_addr, configuration_id, membership_size, client, broadcaster,
-            on_decided_wrapped,
+            on_decided_wrapped, metrics=metrics, tracer=tracer,
         )
 
     @property
@@ -106,11 +111,17 @@ class FastPaxos:
         if self._decided:
             return
         self._votes_received.add(msg.sender)
+        if self._metrics is not None:
+            self._metrics.incr("consensus.fast_round_votes")
         count = self._votes_per_proposal.get(msg.endpoints, 0) + 1
         self._votes_per_proposal[msg.endpoints] = count
         f = (self._n - 1) // 4  # Fast Paxos resiliency
         if len(self._votes_received) >= self._n - f:
             if count >= self._n - f:
+                if self._metrics is not None:
+                    self._metrics.incr("consensus.fast_decisions")
+                if self._tracer is not None:
+                    self._tracer.event("fast_decision", votes=count)
                 self._on_decided_wrapped(list(msg.endpoints))
             # else: fast round may not succeed; fallback will recover
 
@@ -133,6 +144,8 @@ class FastPaxos:
     def start_classic_paxos_round(self) -> None:
         """Fallback entry: classic rounds start at round 2 (FastPaxos.java:189-195)."""
         if not self._decided:
+            if self._metrics is not None:
+                self._metrics.incr("consensus.classic_rounds_started")
             self._paxos.start_phase1a(2)
 
     def _random_delay_ms(self) -> int:

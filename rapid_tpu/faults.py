@@ -400,8 +400,11 @@ class NemesisClient(IMessagingClient):
     def _attempt(self, remote: Endpoint, msg: RapidMessage) -> Promise:
         d = self._nem.decide(self.address, remote, msg, EGRESS)
         metrics = self._nem.metrics
+        # labeled by fault application point and message type; unlabeled
+        # reads (metrics.get("nemesis_dropped")) sum across the label sets
+        kind = type(msg).__name__
         if d.drop:
-            metrics.incr("nemesis_dropped")
+            metrics.incr("nemesis_dropped", at="egress", msg=kind)
             # dropped on the wire: the sender only ever sees its per-message
             # deadline expire, exactly like the in-process fabric's filters
             out: Promise = Promise()
@@ -414,11 +417,12 @@ class NemesisClient(IMessagingClient):
             )
             return out
         for _ in range(d.duplicates):
-            metrics.incr("nemesis_duplicated")
+            metrics.incr("nemesis_duplicated", at="egress", msg=kind)
             self.inner.send_message_best_effort(remote, msg)
         if d.delay_ms > 0:
             metrics.incr(
-                "nemesis_reordered" if d.reordered else "nemesis_delayed"
+                "nemesis_reordered" if d.reordered else "nemesis_delayed",
+                at="egress", msg=kind,
             )
             out = Promise()
             self._nem.scheduler.schedule(
@@ -428,7 +432,7 @@ class NemesisClient(IMessagingClient):
                 ).add_callback(lambda p: _pipe(p, out)),
             )
             return out
-        metrics.incr("nemesis_passed")
+        metrics.incr("nemesis_passed", at="egress", msg=kind)
         return self.inner.send_message_best_effort(remote, msg)
 
     def shutdown(self) -> None:
@@ -449,15 +453,17 @@ class _NemesisServiceFilter:
         src = getattr(msg, "sender", None)
         d = self._nem.decide(src, self._address, msg, INGRESS)
         metrics = self._nem.metrics
+        kind = type(msg).__name__
         if d.drop:
-            metrics.incr("nemesis_dropped")
+            metrics.incr("nemesis_dropped", at="ingress", msg=kind)
             return Promise()  # never completes -> the sender times out
         for _ in range(d.duplicates):
-            metrics.incr("nemesis_duplicated")
+            metrics.incr("nemesis_duplicated", at="ingress", msg=kind)
             self._service.handle_message(msg)
         if d.delay_ms > 0:
             metrics.incr(
-                "nemesis_reordered" if d.reordered else "nemesis_delayed"
+                "nemesis_reordered" if d.reordered else "nemesis_delayed",
+                at="ingress", msg=kind,
             )
             out: Promise = Promise()
             self._nem.scheduler.schedule(
@@ -467,7 +473,7 @@ class _NemesisServiceFilter:
                 ),
             )
             return out
-        metrics.incr("nemesis_passed")
+        metrics.incr("nemesis_passed", at="ingress", msg=kind)
         return self._service.handle_message(msg)
 
     def __getattr__(self, name):

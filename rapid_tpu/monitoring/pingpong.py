@@ -12,9 +12,10 @@ times before counting as failure (:44,100-106).
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable, Deque
+from typing import Callable, Deque, Optional
 
 from ..messaging.base import IMessagingClient
+from ..observability import Metrics, global_metrics
 from ..runtime.futures import Promise
 from ..types import Endpoint, NodeStatus, ProbeMessage, ProbeResponse
 from .base import IEdgeFailureDetectorFactory
@@ -31,12 +32,14 @@ class PingPongFailureDetector:
         client: IMessagingClient,
         notifier: Callable[[], None],
         failure_threshold: int = FAILURE_THRESHOLD,
+        metrics: Optional[Metrics] = None,
     ) -> None:
         self._address = address
         self._subject = subject
         self._client = client
         self._notifier = notifier
         self._failure_threshold = failure_threshold
+        self._metrics = metrics if metrics is not None else global_metrics()
         self._failure_count = 0
         self._bootstrap_response_count = 0
         self._notified = False
@@ -50,37 +53,44 @@ class PingPongFailureDetector:
             self._notified = True
             self._notifier()
         else:
+            self._metrics.incr("fd.probes")
             self._client.send_message_best_effort(
                 self._subject, self._probe
             ).add_callback(self._on_probe_done)
 
+    def _record_failure(self) -> None:
+        self._failure_count += 1
+        self._metrics.incr("fd.probe_failures")
+
     def _on_probe_done(self, promise: Promise) -> None:
         if promise.exception() is not None:
-            self._failure_count += 1
+            self._record_failure()
             return
         response = promise.peek()
         if not isinstance(response, ProbeResponse):
-            self._failure_count += 1
+            self._record_failure()
             return
         if response.status == NodeStatus.BOOTSTRAPPING:
             self._bootstrap_response_count += 1
             if self._bootstrap_response_count > BOOTSTRAP_COUNT_THRESHOLD:
-                self._failure_count += 1
+                self._record_failure()
 
 
 class PingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
     def __init__(self, address: Endpoint, client: IMessagingClient,
-                 failure_threshold: int = FAILURE_THRESHOLD) -> None:
+                 failure_threshold: int = FAILURE_THRESHOLD,
+                 metrics: Optional[Metrics] = None) -> None:
         self._address = address
         self._client = client
         self._failure_threshold = failure_threshold
+        self._metrics = metrics
 
     def create_instance(
         self, subject: Endpoint, notifier: Callable[[], None]
     ) -> Callable[[], None]:
         return PingPongFailureDetector(
             self._address, subject, self._client, notifier,
-            self._failure_threshold,
+            self._failure_threshold, metrics=self._metrics,
         )
 
 
@@ -90,8 +100,9 @@ class WindowedPingPongFailureDetector(PingPongFailureDetector):
     code's cumulative counter remains the parity default."""
 
     def __init__(self, address, subject, client, notifier,
-                 window: int = 10, threshold: float = 0.4) -> None:
-        super().__init__(address, subject, client, notifier)
+                 window: int = 10, threshold: float = 0.4,
+                 metrics: Optional[Metrics] = None) -> None:
+        super().__init__(address, subject, client, notifier, metrics=metrics)
         self._window: Deque[bool] = deque(maxlen=window)
         self._threshold = threshold
 
@@ -113,14 +124,16 @@ class WindowedPingPongFailureDetector(PingPongFailureDetector):
 
 class WindowedPingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
     def __init__(self, address: Endpoint, client: IMessagingClient,
-                 window: int = 10, threshold: float = 0.4) -> None:
+                 window: int = 10, threshold: float = 0.4,
+                 metrics: Optional[Metrics] = None) -> None:
         self._address = address
         self._client = client
         self._window = window
         self._threshold = threshold
+        self._metrics = metrics
 
     def create_instance(self, subject, notifier):
         return WindowedPingPongFailureDetector(
             self._address, subject, self._client, notifier,
-            self._window, self._threshold,
+            self._window, self._threshold, metrics=self._metrics,
         )

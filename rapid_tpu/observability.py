@@ -1,56 +1,449 @@
-"""Tracing and metrics.
+"""The telemetry plane: labeled metrics, hierarchical tracing, exporters.
 
 The reference has no tracing/metrics subsystem (SURVEY.md §5.1: jacoco +
 surefire wall-times only; §5.5: four subscription events are the whole
 observable surface). Since this framework's headline metric is
 time-to-stable-view, observability is first-class here:
 
-- ``Metrics``: cheap named counters, used by the protocol plane (messages by
-  type, alerts, proposals, view changes) and the simulator (rounds, device
-  dispatches).
-- ``Tracer``: wall/virtual-time spans with a single flat log, suitable for
-  both the event-driven protocol plane and the round-driven simulator.
+- ``Metrics``: thread-safe counters, gauges, and fixed-bucket histograms
+  keyed by ``(name, labels)``. Per-``Cluster``/``Simulator`` instances get
+  their own registry attached (via weakref) to the process-global one, so
+  exporters see every plane merged while ``snapshot()``/``get()`` stay
+  per-instance. ``NullMetrics`` is the no-op registry used to measure
+  telemetry overhead.
+- ``Tracer``: wall/virtual-time spans with parent ids and a contextvar-based
+  current span, bounded by a ring buffer (``dropped`` counts evictions).
+  Per-instance tracers attach to the process-global one the same way, so a
+  single Chrome trace carries protocol, simulator, and fault-plane spans on
+  one timeline.
+- ``StableViewTimer``: derives per-view-change latency histograms
+  (detection -> decision -> view-installed) on a caller-supplied clock --
+  virtual ms on both the event-driven plane and the simulator, so the
+  ``time_to_stable_view_ms`` distributions are directly comparable.
+- Exporters: Chrome ``trace_event`` JSON (Perfetto-loadable; simulator spans
+  additionally plotted on a virtual-time track), Prometheus text exposition
+  (``rapid_*``-prefixed, labeled), and a JSON snapshot.
 - ``device_trace``: context manager around jax.profiler for capturing a TPU
   trace of the simulation hot loop (view in TensorBoard/XProf).
+
+Metric names are ``snake.dot`` strings from ``METRIC_CATALOG`` (enforced by
+tools/check.py's metric-name lint); label conventions are documented in
+ARCHITECTURE.md's "Telemetry plane" section.
 """
 
 from __future__ import annotations
 
-import collections
 import contextlib
+import contextvars
+import itertools
+import json
+import re
+import threading
 import time
+import weakref
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+# --------------------------------------------------------------------------- #
+# Metric name catalog
+# --------------------------------------------------------------------------- #
+
+# Every incr/observe call site in rapid_tpu/ must use one of these names (or
+# a name under one of METRIC_PREFIXES); tools/check.py fails unknown names.
+# Kept flat and exhaustive on purpose: the catalog doubles as the metric
+# documentation index referenced from ARCHITECTURE.md.
+METRIC_CATALOG = frozenset({
+    # protocol plane (service.py)
+    "alerts_enqueued",
+    "proposals",
+    "view_changes",
+    "view_changes_refused_missing_identity",
+    "fd.edge_failures",
+    # failure detectors (monitoring/)
+    "fd.probes",
+    "fd.probe_failures",
+    # cut detection (cut_detector.py)
+    "cut.proposals_emitted",
+    # consensus (fast_paxos.py / paxos.py)
+    "consensus.fast_round_votes",
+    "consensus.fast_decisions",
+    "consensus.classic_rounds_started",
+    "consensus.classic_decisions",
+    # join pipeline (cluster.py)
+    "join.exhausted",
+    "join.phase1_no_response",
+    # nemesis fault plane (faults.py)
+    "nemesis_dropped",
+    "nemesis_duplicated",
+    "nemesis_delayed",
+    "nemesis_reordered",
+    "nemesis_passed",
+    # retry combinator (messaging/retries.py)
+    "retry_attempts",
+    "retry_exhausted",
+    "retry_deadline_exceeded",
+    # simulator (sim/driver.py)
+    "rounds",
+    "device_dispatches",
+    "classic_coordinator_races",
+    "speculation_hits_fresh_state",
+    "speculation_hits_config_id",
+    # fault-array occupancy gauges (set once per flush, host mirrors only)
+    "sim.fault.crashed",
+    "sim.fault.ingress_partitioned",
+    "sim.fault.lossy",
+    "sim.membership_size",
+    "sim.pending_joiners",
+    # derived latency histograms (StableViewTimer, both planes)
+    "latency.detection_to_decision_ms",
+    "latency.decision_to_view_ms",
+    "time_to_stable_view_ms",
+})
+
+# Dynamic name families: an f-string call site is legal iff its literal head
+# starts with one of these prefixes (e.g. ``f"messages.{type_name}"``).
+METRIC_PREFIXES = ("messages.",)
+
+# Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).
+DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+)
+
+# One shared definition for the headline distribution on BOTH planes: the
+# acceptance criterion is that the simulator's and the protocol plane's
+# time_to_stable_view_ms histograms are bucket-for-bucket comparable.
+STABLE_VIEW_BUCKETS_MS: Tuple[float, ...] = (
+    10, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 15000, 30000, 60000,
+    120000,
+)
+
+
+# --------------------------------------------------------------------------- #
+# Histograms
+# --------------------------------------------------------------------------- #
+
+
+class Histogram:
+    """Fixed-bucket histogram (no locking of its own; the owning Metrics
+    serializes access). ``counts`` has one slot per bucket edge plus +Inf."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]) -> None:
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for i, edge in enumerate(self.buckets):  # noqa: B007
+            if value <= edge:
+                break
+        else:
+            i = len(self.buckets)
+        self.counts[i] += 1
+        self.sum += value
+        self.count += 1
+
+    def copy(self) -> "Histogram":
+        out = Histogram(self.buckets)
+        out.counts = list(self.counts)
+        out.sum = self.sum
+        out.count = self.count
+        return out
+
+    def merge(self, other: "Histogram") -> None:
+        if other.buckets != self.buckets:
+            return  # mismatched definitions never merge (catalog bug)
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.sum += other.sum
+        self.count += other.count
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "buckets": list(self.buckets),
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+
+# --------------------------------------------------------------------------- #
+# Metrics registry
+# --------------------------------------------------------------------------- #
+
+LabelItems = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> LabelItems:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _render(name: str, labels: LabelItems) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
 
 
 class Metrics:
-    """Process-wide counter registry (per-Cluster instances get their own)."""
+    """Thread-safe labeled registry (counters, gauges, histograms).
 
-    def __init__(self) -> None:
-        self._counters: Dict[str, int] = collections.defaultdict(int)
+    ``parent``: attach this registry (weakly) to another one; exporters
+    walking the parent's ``collect()`` see this registry's samples with
+    ``const_labels`` merged in. Per-Cluster/Simulator registries attach to
+    ``global_metrics()`` by default, so one Prometheus scrape covers every
+    plane while per-instance ``get``/``snapshot`` stay isolated.
+    """
 
-    def incr(self, name: str, amount: int = 1) -> None:
-        self._counters[name] += amount
+    def __init__(self, parent: Optional["Metrics"] = None,
+                 **const_labels: object) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, LabelItems], int] = {}
+        self._gauges: Dict[Tuple[str, LabelItems], float] = {}
+        self._histograms: Dict[Tuple[str, LabelItems], Histogram] = {}
+        self._const_labels: Dict[str, str] = {
+            k: str(v) for k, v in sorted(const_labels.items())
+        }
+        self._children: List["weakref.ref[Metrics]"] = []
+        # dead children's final samples, appended by GC finalizers and folded
+        # in lazily by _drain_absorbed(). The finalizer must NOT take _lock:
+        # cyclic GC can run inside this registry's own locked sections (any
+        # allocation can trigger it), and a lock-taking finalizer would then
+        # self-deadlock the thread. list.append is atomic and lock-free.
+        self._pending_absorbs: List[tuple] = []
+        if parent is not None:
+            parent.attach(self)
 
-    def get(self, name: str) -> int:
-        return self._counters.get(name, 0)
+    # -- registry tree ------------------------------------------------------
+
+    def attach(self, child: "Metrics") -> None:
+        """Attach ``child`` weakly: while alive it is merged into this
+        registry's ``collect()``; when garbage-collected, its final samples
+        are folded into this registry (the finalizer captures the child's
+        data dicts, not the child), so a shut-down Cluster's telemetry
+        survives into exports without the tree pinning dead components."""
+        with self._lock:
+            self._children = [r for r in self._children if r() is not None]
+            self._children.append(weakref.ref(child))
+        weakref.finalize(
+            child, self._pending_absorbs.append,
+            (child._counters, child._gauges, child._histograms,
+             dict(child._const_labels)),
+        )
+
+    def detach(self, child: "Metrics") -> None:
+        with self._lock:
+            self._children = [
+                r for r in self._children
+                if r() is not None and r() is not child
+            ]
+
+    def _drain_absorbed(self) -> None:
+        """Fold any dead children's queued samples into this registry.
+        Called from every read/collect path (never from GC) so absorbed
+        telemetry is visible by the time anyone looks."""
+        while self._pending_absorbs:
+            try:
+                counters, gauges, hists, const = self._pending_absorbs.pop(0)
+            except IndexError:  # pragma: no cover - concurrent drain
+                break
+            self._absorb(counters, gauges, hists, const)
+
+    def _absorb(self, counters: Dict, gauges: Dict, hists: Dict,
+                const: Dict[str, str]) -> None:
+        """Fold a dead child's samples into this registry, const labels
+        applied (the child's lock is irrelevant -- nothing else references
+        its dicts anymore)."""
+        with self._lock:
+            for (name, labels), value in counters.items():
+                key = (name, tuple(sorted({**const, **dict(labels)}.items())))
+                self._counters[key] = self._counters.get(key, 0) + value
+            for (name, labels), value in gauges.items():
+                key = (name, tuple(sorted({**const, **dict(labels)}.items())))
+                self._gauges[key] = value
+            for (name, labels), hist in hists.items():
+                key = (name, tuple(sorted({**const, **dict(labels)}.items())))
+                mine = self._histograms.get(key)
+                if mine is None:
+                    self._histograms[key] = hist.copy()
+                else:
+                    mine.merge(hist)
+
+    # -- recording ----------------------------------------------------------
+
+    def incr(self, name: str, amount: int = 1, **labels: object) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + amount
+
+    def set_gauge(self, name: str, value: float, **labels: object) -> None:
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = value
+
+    def observe(self, name: str, value: float,
+                buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_MS,
+                **labels: object) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            hist = self._histograms.get(key)
+            if hist is None:
+                hist = self._histograms[key] = Histogram(buckets)
+            hist.observe(value)
+
+    # -- reading ------------------------------------------------------------
+
+    def get(self, name: str, **labels: object) -> int:
+        """Exact ``(name, labels)`` counter; with no labels, the sum over
+        every label set of ``name`` (so legacy unlabeled reads keep working
+        after a call site gains labels)."""
+        self._drain_absorbed()
+        with self._lock:
+            if labels:
+                return self._counters.get((name, _label_key(labels)), 0)
+            return sum(
+                v for (n, _), v in self._counters.items() if n == name
+            )
+
+    def get_gauge(self, name: str, **labels: object) -> Optional[float]:
+        self._drain_absorbed()
+        with self._lock:
+            return self._gauges.get((name, _label_key(labels)))
+
+    def histogram(self, name: str, **labels: object) -> Optional[Dict[str, object]]:
+        """Merged snapshot of ``name`` over this registry AND its attached
+        children; ``labels`` filter as a subset (``plane="sim"`` matches any
+        series also carrying node/other labels). None if never observed."""
+        want = {k: str(v) for k, v in labels.items()}
+        merged: Optional[Histogram] = None
+        for kind, n, sample_labels, value in self.collect():
+            if kind != "histogram" or n != name:
+                continue
+            if any(sample_labels.get(k) != v for k, v in want.items()):
+                continue
+            if merged is None:
+                merged = value.copy()
+            else:
+                merged.merge(value)
+        return merged.snapshot() if merged is not None else None
 
     def snapshot(self) -> Dict[str, int]:
-        return dict(self._counters)
+        """Flat counter view of THIS registry (children excluded): unlabeled
+        counters keep their bare names, labeled ones render as
+        ``name{k=v,...}``. Existing consumers that parse dotted names (e.g.
+        experiments/message_load.py over ``messages.*``) are unaffected."""
+        self._drain_absorbed()
+        with self._lock:
+            return {
+                _render(name, labels): value
+                for (name, labels), value in self._counters.items()
+            }
+
+    def gauges(self) -> Dict[str, float]:
+        self._drain_absorbed()
+        with self._lock:
+            return {
+                _render(name, labels): value
+                for (name, labels), value in self._gauges.items()
+            }
+
+    def histograms(self) -> Dict[str, Dict[str, object]]:
+        self._drain_absorbed()
+        with self._lock:
+            return {
+                _render(name, labels): hist.snapshot()
+                for (name, labels), hist in self._histograms.items()
+            }
 
     def reset(self) -> None:
-        self._counters.clear()
+        """Atomically clear this registry's own series (children keep
+        theirs: they belong to live components). Queued dead-child samples
+        are discarded too -- reset means a clean slate."""
+        del self._pending_absorbs[:]
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._children = [r for r in self._children if r() is not None]
+
+    # -- export -------------------------------------------------------------
+
+    def collect(self) -> List[Tuple[str, str, Dict[str, str], object]]:
+        """Merged samples of this registry and every live child:
+        ``(kind, name, labels, value)`` with kind in counter/gauge/histogram
+        and const labels folded into each sample's labels."""
+        self._drain_absorbed()
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: h.copy() for k, h in self._histograms.items()}
+            children = [r() for r in self._children]
+        const = self._const_labels
+        out: List[Tuple[str, str, Dict[str, str], object]] = []
+        for (name, labels), value in counters.items():
+            out.append(("counter", name, {**const, **dict(labels)}, value))
+        for (name, labels), value in gauges.items():
+            out.append(("gauge", name, {**const, **dict(labels)}, value))
+        for (name, labels), hist in hists.items():
+            out.append(("histogram", name, {**const, **dict(labels)}, hist))
+        for child in children:
+            if child is not None:
+                for kind, name, labels, value in child.collect():
+                    out.append((kind, name, {**const, **labels}, value))
+        return out
+
+
+class NullMetrics(Metrics):
+    """No-op registry: the telemetry-overhead baseline (never attaches to
+    the global tree, records nothing)."""
+
+    def __init__(self) -> None:  # noqa: super-init intentional
+        super().__init__()
+
+    def incr(self, name: str, amount: int = 1, **labels: object) -> None:
+        pass
+
+    def set_gauge(self, name: str, value: float, **labels: object) -> None:
+        pass
+
+    def observe(self, name: str, value: float,
+                buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS_MS,
+                **labels: object) -> None:
+        pass
 
 
 # Process-wide default registry for components that outlive any one Cluster:
 # the nemesis fault plane (faults.py) counts injected faults here unless
-# given a registry ("nemesis_*" counters), and the retry combinator counts
-# "retry_*" when handed one. Tests snapshot/reset it around a run.
+# given a registry ("nemesis_*" counters), the retry combinator counts
+# "retry_*" when handed one, and per-instance registries attach here so
+# exporters see every plane. Tests snapshot/reset it around a run.
 _GLOBAL_METRICS = Metrics()
 
 
 def global_metrics() -> Metrics:
     return _GLOBAL_METRICS
+
+
+# --------------------------------------------------------------------------- #
+# Tracing
+# --------------------------------------------------------------------------- #
+
+_SPAN_IDS = itertools.count(1)
+_SPAN_ID_LOCK = threading.Lock()
+
+# One process-wide current-span so nesting works across tracer instances
+# (e.g. a fault-plane event inside a protocol-plane span): each task/thread
+# context carries its own value.
+_CURRENT_SPAN: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "rapid_tpu_current_span", default=None
+)
+
+
+def _next_span_id() -> int:
+    with _SPAN_ID_LOCK:
+        return next(_SPAN_IDS)
 
 
 @dataclass
@@ -61,36 +454,443 @@ class Span:
     virtual_start_ms: Optional[int] = None
     virtual_end_ms: Optional[int] = None
     attrs: Dict[str, object] = field(default_factory=dict)
+    span_id: int = 0
+    parent_id: Optional[int] = None
+    plane: str = "protocol"
+    track: str = "main"
 
     @property
     def wall_ms(self) -> float:
         return (self.wall_end_s - self.wall_start_s) * 1000.0
 
 
+DEFAULT_MAX_SPANS = 8192
+
+
 class Tracer:
-    def __init__(self) -> None:
+    """Span recorder with a bounded ring buffer.
+
+    ``spans`` is the ring (oldest evicted first; ``dropped`` counts
+    evictions). ``parent`` attaches this tracer (weakly) to another one so
+    ``collect_spans()`` on the parent -- and therefore the Chrome-trace
+    exporter -- sees every attached plane on one timeline. ``plane``/``track``
+    stamp each span for the exporter's process/thread grouping."""
+
+    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS,
+                 parent: Optional["Tracer"] = None,
+                 plane: str = "protocol", track: str = "main") -> None:
         self.spans: List[Span] = []
+        self._dropped_box = [0]  # boxed so the parent's finalizer sees it
+        self.plane = plane
+        self.track = track
+        self._max_spans = max_spans
+        self._lock = threading.Lock()
+        self._children: List["weakref.ref[Tracer]"] = []
+        # dead children's (spans, dropped_box), appended by GC finalizers --
+        # lock-free on purpose: cyclic GC can fire inside this tracer's own
+        # locked sections, so a lock-taking finalizer would self-deadlock.
+        self._pending_absorbs: List[tuple] = []
+        if parent is not None:
+            parent.attach(self)
+
+    @property
+    def dropped(self) -> int:
+        self._drain_absorbed()
+        return self._dropped_box[0]
+
+    # -- tracer tree --------------------------------------------------------
+
+    def attach(self, child: "Tracer") -> None:
+        """Attach ``child`` weakly; when it is garbage-collected its spans
+        fold into this tracer's (bounded) ring, so a shut-down component's
+        trace survives into exports."""
+        with self._lock:
+            self._children = [r for r in self._children if r() is not None]
+            self._children.append(weakref.ref(child))
+        weakref.finalize(
+            child, self._pending_absorbs.append,
+            (child.spans, child._dropped_box),
+        )
+
+    def _drain_absorbed(self) -> None:
+        """Fold dead children's queued spans into the ring (called from the
+        read paths, never from GC)."""
+        while self._pending_absorbs:
+            try:
+                spans, dropped_box = self._pending_absorbs.pop(0)
+            except IndexError:  # pragma: no cover - concurrent drain
+                break
+            for s in spans:
+                self._append(s)
+            with self._lock:
+                self._dropped_box[0] += dropped_box[0]
+
+    # -- recording ----------------------------------------------------------
+
+    def _new_span(self, name: str, virtual_ms: Optional[int],
+                  attrs: Dict[str, object]) -> Span:
+        parent = _CURRENT_SPAN.get()
+        return Span(
+            name=name,
+            wall_start_s=time.perf_counter(),
+            virtual_start_ms=virtual_ms,
+            attrs=attrs,
+            span_id=_next_span_id(),
+            parent_id=parent.span_id if parent is not None else None,
+            plane=self.plane,
+            track=self.track,
+        )
+
+    def _append(self, s: Span) -> None:
+        with self._lock:
+            if self._max_spans > 0 and len(self.spans) >= self._max_spans:
+                self.spans.pop(0)
+                self._dropped_box[0] += 1
+            self.spans.append(s)
 
     @contextlib.contextmanager
-    def span(self, name: str, virtual_ms: Optional[int] = None, **attrs) -> Iterator[Span]:
-        s = Span(name=name, wall_start_s=time.perf_counter(),
-                 virtual_start_ms=virtual_ms, attrs=dict(attrs))
+    def span(self, name: str, virtual_ms: Optional[int] = None,
+             **attrs: object) -> Iterator[Span]:
+        s = self._new_span(name, virtual_ms, dict(attrs))
+        token = _CURRENT_SPAN.set(s)
         try:
             yield s
         finally:
+            _CURRENT_SPAN.reset(token)
             s.wall_end_s = time.perf_counter()
-            self.spans.append(s)
+            self._append(s)
+
+    def begin(self, name: str, virtual_ms: Optional[int] = None,
+              **attrs: object) -> Span:
+        """Non-contextmanager start (paired with ``end``), for spans whose
+        close site is far from their open site (e.g. view-change application
+        that returns mid-function)."""
+        return self._new_span(name, virtual_ms, dict(attrs))
+
+    def end(self, s: Span, virtual_ms: Optional[int] = None) -> None:
+        s.wall_end_s = time.perf_counter()
+        if virtual_ms is not None:
+            s.virtual_end_ms = virtual_ms
+        self._append(s)
+
+    def event(self, name: str, virtual_ms: Optional[int] = None,
+              **attrs: object) -> Span:
+        """Zero-duration instant (still parented under the current span)."""
+        s = self._new_span(name, virtual_ms, dict(attrs))
+        s.wall_end_s = s.wall_start_s
+        s.virtual_end_ms = virtual_ms
+        self._append(s)
+        return s
+
+    # -- reading ------------------------------------------------------------
+
+    def collect_spans(self) -> List[Span]:
+        """This tracer's spans plus every live child's (exporter input)."""
+        self._drain_absorbed()
+        with self._lock:
+            out = list(self.spans)
+            children = [r() for r in self._children]
+        for child in children:
+            if child is not None:
+                out.extend(child.collect_spans())
+        return out
+
+    def span_tree(self) -> Dict[Optional[int], List[Span]]:
+        """parent span id -> children, root spans under None (a span whose
+        parent was evicted from the ring is re-rooted under None)."""
+        self._drain_absorbed()
+        with self._lock:
+            spans = list(self.spans)
+        known = {s.span_id for s in spans}
+        tree: Dict[Optional[int], List[Span]] = {}
+        for s in spans:
+            parent = s.parent_id if s.parent_id in known else None
+            tree.setdefault(parent, []).append(s)
+        return tree
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """Per-name aggregate: count, total/mean wall ms."""
+        self._drain_absorbed()
+        with self._lock:
+            spans = list(self.spans)
         agg: Dict[str, Dict[str, float]] = {}
-        for s in self.spans:
+        for s in spans:
             entry = agg.setdefault(s.name, {"count": 0, "total_ms": 0.0})
             entry["count"] += 1
             entry["total_ms"] += s.wall_ms
         for entry in agg.values():
             entry["mean_ms"] = entry["total_ms"] / entry["count"]
         return agg
+
+    def reset(self) -> None:
+        del self._pending_absorbs[:]
+        with self._lock:
+            self.spans.clear()
+            self._dropped_box[0] = 0
+            self._children = [r for r in self._children if r() is not None]
+
+
+_GLOBAL_TRACER = Tracer(plane="global", track="global")
+
+
+def global_tracer() -> Tracer:
+    return _GLOBAL_TRACER
+
+
+# --------------------------------------------------------------------------- #
+# Derived latency: detection -> decision -> view-installed
+# --------------------------------------------------------------------------- #
+
+
+class StableViewTimer:
+    """Per-view-change latency decomposition on a caller-supplied clock.
+
+    ``detection(t)`` marks the first failure/join signal since the last view
+    change (first call sticks); ``decision(t)`` marks when consensus decided
+    (last call wins -- a parked decision re-applies later); ``view_installed``
+    closes the cycle and records three histograms labeled with ``plane``:
+    detection->decision, decision->view, and the headline
+    ``time_to_stable_view_ms`` -- all on STABLE_VIEW_BUCKETS_MS so the
+    simulator (virtual clock) and the protocol plane (scheduler clock)
+    distributions are bucket-for-bucket comparable."""
+
+    def __init__(self, metrics: Metrics, plane: str,
+                 clock: Callable[[], int]) -> None:
+        self._metrics = metrics
+        self._plane = plane
+        self._clock = clock
+        self._detect_ms: Optional[int] = None
+        self._decide_ms: Optional[int] = None
+
+    def _now(self, now_ms: Optional[int]) -> int:
+        return int(now_ms if now_ms is not None else self._clock())
+
+    def detection(self, now_ms: Optional[int] = None) -> None:
+        if self._detect_ms is None:
+            self._detect_ms = self._now(now_ms)
+
+    def decision(self, now_ms: Optional[int] = None) -> None:
+        if self._detect_ms is not None:
+            self._decide_ms = self._now(now_ms)
+
+    def view_installed(self, now_ms: Optional[int] = None) -> None:
+        detect, decide = self._detect_ms, self._decide_ms
+        self._detect_ms = None
+        self._decide_ms = None
+        if detect is None:
+            return  # e.g. the initial view: nothing was detected
+        installed = self._now(now_ms)
+        if decide is None:
+            decide = installed
+        self._metrics.observe(
+            "latency.detection_to_decision_ms", decide - detect,
+            buckets=STABLE_VIEW_BUCKETS_MS, plane=self._plane,
+        )
+        self._metrics.observe(
+            "latency.decision_to_view_ms", installed - decide,
+            buckets=STABLE_VIEW_BUCKETS_MS, plane=self._plane,
+        )
+        self._metrics.observe(
+            "time_to_stable_view_ms", installed - detect,
+            buckets=STABLE_VIEW_BUCKETS_MS, plane=self._plane,
+        )
+
+
+# --------------------------------------------------------------------------- #
+# Exporters
+# --------------------------------------------------------------------------- #
+
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    sanitized = _PROM_NAME_RE.sub("_", name)
+    return sanitized if sanitized.startswith("rapid_") else f"rapid_{sanitized}"
+
+
+def _prom_label_value(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _prom_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{_prom_label_value(v)}"' for k, v in sorted(merged.items())
+    )
+    return f"{{{inner}}}"
+
+
+def _num(value: float) -> str:
+    if isinstance(value, float) and value.is_integer():
+        return str(int(value))
+    return str(value)
+
+
+def prometheus_text(metrics: Optional[Metrics] = None) -> str:
+    """Prometheus text exposition of a registry tree (default: the process
+    global, i.e. every attached Cluster/Simulator plane merged). Counters
+    gain ``_total``; histograms expand to ``_bucket``/``_sum``/``_count``
+    with inclusive ``le`` edges. Output is sorted for determinism."""
+    registry = metrics if metrics is not None else global_metrics()
+    counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
+    for kind, name, labels, value in registry.collect():
+        key = (name, tuple(sorted(labels.items())))
+        if kind == "counter":
+            counters[key] = counters.get(key, 0) + value
+        elif kind == "gauge":
+            gauges[key] = value
+        elif kind == "histogram":
+            if key in hists:
+                hists[key].merge(value)
+            else:
+                hists[key] = value.copy()
+    lines: List[str] = []
+    typed: set = set()
+
+    def type_line(name: str, kind: str) -> None:
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for (name, labels) in sorted(counters):
+        prom = f"{_prom_name(name)}_total"
+        type_line(prom, "counter")
+        lines.append(
+            f"{prom}{_prom_labels(dict(labels))} {_num(counters[(name, labels)])}"
+        )
+    for (name, labels) in sorted(gauges):
+        prom = _prom_name(name)
+        type_line(prom, "gauge")
+        lines.append(
+            f"{prom}{_prom_labels(dict(labels))} {_num(gauges[(name, labels)])}"
+        )
+    for (name, labels) in sorted(hists):
+        hist = hists[(name, labels)]
+        prom = _prom_name(name)
+        type_line(prom, "histogram")
+        cumulative = 0
+        for edge, count in zip(hist.buckets, hist.counts):
+            cumulative += count
+            lines.append(
+                f"{prom}_bucket"
+                f"{_prom_labels(dict(labels), {'le': _num(float(edge))})} "
+                f"{cumulative}"
+            )
+        cumulative += hist.counts[-1]
+        lines.append(
+            f"{prom}_bucket{_prom_labels(dict(labels), {'le': '+Inf'})} "
+            f"{cumulative}"
+        )
+        lines.append(f"{prom}_sum{_prom_labels(dict(labels))} {_num(hist.sum)}")
+        lines.append(f"{prom}_count{_prom_labels(dict(labels))} {hist.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, object]:
+    """Chrome ``trace_event`` JSON (load in Perfetto / chrome://tracing).
+
+    One process per plane; one thread per track (a protocol node's address,
+    the simulator, ...). Spans carrying virtual timestamps are ADDITIONALLY
+    plotted on a synthetic "virtual-time" process whose microseconds are
+    virtual milliseconds x1000, so protocol time lines up across planes
+    regardless of host wall-time jitter."""
+    root = tracer if tracer is not None else global_tracer()
+    spans = sorted(
+        root.collect_spans(), key=lambda s: (s.wall_start_s, s.span_id)
+    )
+    planes = sorted({s.plane for s in spans})
+    pid_of = {plane: i + 1 for i, plane in enumerate(planes)}
+    virtual_pid = len(planes) + 1
+    tracks = sorted({(s.plane, s.track) for s in spans})
+    tid_of = {pt: i + 1 for i, pt in enumerate(tracks)}
+    events: List[Dict[str, object]] = []
+    for plane, pid in pid_of.items():
+        events.append({
+            "ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": plane},
+        })
+    has_virtual = any(s.virtual_start_ms is not None for s in spans)
+    if has_virtual:
+        events.append({
+            "ph": "M", "pid": virtual_pid, "name": "process_name",
+            "args": {"name": "virtual-time (ms)"},
+        })
+    for (plane, track), tid in tid_of.items():
+        events.append({
+            "ph": "M", "pid": pid_of[plane], "tid": tid,
+            "name": "thread_name", "args": {"name": track},
+        })
+    t0 = min((s.wall_start_s for s in spans), default=0.0)
+    for s in spans:
+        args: Dict[str, object] = {str(k): v for k, v in s.attrs.items()}
+        args["span_id"] = s.span_id
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        ts = int(round((s.wall_start_s - t0) * 1e6))
+        dur = max(int(round((s.wall_end_s - s.wall_start_s) * 1e6)), 1)
+        events.append({
+            "name": s.name, "ph": "X", "pid": pid_of[s.plane],
+            "tid": tid_of[(s.plane, s.track)], "ts": ts, "dur": dur,
+            "args": args,
+        })
+        if s.virtual_start_ms is not None:
+            v_end = (
+                s.virtual_end_ms
+                if s.virtual_end_ms is not None
+                else s.virtual_start_ms
+            )
+            events.append({
+                "name": s.name, "ph": "X", "pid": virtual_pid,
+                "tid": tid_of[(s.plane, s.track)],
+                "ts": int(s.virtual_start_ms) * 1000,
+                "dur": max((int(v_end) - int(s.virtual_start_ms)) * 1000, 1),
+                "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def json_snapshot(metrics: Optional[Metrics] = None,
+                  tracer: Optional[Tracer] = None) -> Dict[str, object]:
+    """Everything in one JSON-serializable dict: merged counter/gauge/
+    histogram samples plus the span summary."""
+    registry = metrics if metrics is not None else global_metrics()
+    root = tracer if tracer is not None else global_tracer()
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Dict[str, object]] = {}
+    for kind, name, labels, value in registry.collect():
+        rendered = _render(name, tuple(sorted(labels.items())))
+        if kind == "counter":
+            counters[rendered] = counters.get(rendered, 0) + value
+        elif kind == "gauge":
+            gauges[rendered] = value
+        elif kind == "histogram":
+            hists[rendered] = value.snapshot()
+    return {
+        "counters": dict(sorted(counters.items())),
+        "gauges": dict(sorted(gauges.items())),
+        "histograms": dict(sorted(hists.items())),
+        "spans": root.summary(),
+        "spans_dropped": root.dropped,
+    }
+
+
+def write_prometheus(path: str, metrics: Optional[Metrics] = None) -> None:
+    with open(path, "w") as fh:
+        fh.write(prometheus_text(metrics))
+
+
+def write_chrome_trace(path: str, tracer: Optional[Tracer] = None) -> None:
+    with open(path, "w") as fh:
+        json.dump(chrome_trace(tracer), fh)
 
 
 @contextlib.contextmanager

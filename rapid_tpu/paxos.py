@@ -15,10 +15,13 @@ deterministic, (practically) unique per-node value preserves the protocol
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple, TYPE_CHECKING
 
 from .hashing import endpoint_hash
 from .messaging.base import IBroadcaster, IMessagingClient
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .observability import Metrics, Tracer
 from .types import (
     Endpoint,
     Phase1aMessage,
@@ -46,7 +49,11 @@ class Paxos:
         client: IMessagingClient,
         broadcaster: IBroadcaster,
         on_decide: Callable[[List[Endpoint]], None],
+        metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
+        self._metrics = metrics
+        self._tracer = tracer
         self._my_addr = my_addr
         self._configuration_id = configuration_id
         self._n = membership_size
@@ -149,6 +156,13 @@ class Paxos:
         in_rnd[msg.sender] = msg
         if len(in_rnd) > self._n // 2 and not self._decided:
             self._decided = True
+            if self._metrics is not None:
+                self._metrics.incr("consensus.classic_decisions")
+            if self._tracer is not None:
+                self._tracer.event(
+                    "classic_decision", round=msg.rnd.round,
+                    votes=len(in_rnd),
+                )
             self._on_decide(list(msg.endpoints))
 
     def register_fast_round_vote(self, vote: Proposal) -> None:

@@ -26,7 +26,13 @@ from .messaging.base import IBroadcaster, IMessagingClient
 from .messaging.unicast import UnicastToAllBroadcaster
 from .metadata import FrozenMetadata, MetadataManager
 from .monitoring.base import IEdgeFailureDetectorFactory
-from .observability import Metrics
+from .observability import (
+    Metrics,
+    StableViewTimer,
+    Tracer,
+    global_metrics,
+    global_tracer,
+)
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
 from .runtime.scheduler import ScheduledTask
@@ -78,6 +84,8 @@ class MembershipService:
         subscriptions: Optional[Dict[ClusterEvents, List[SubscriptionCallback]]] = None,
         rng: Optional[random.Random] = None,
         broadcaster: Optional[IBroadcaster] = None,
+        metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self._my_addr = my_addr
         self._cut_detection = cut_detector
@@ -103,7 +111,27 @@ class MembershipService:
             for event, callbacks in subscriptions.items():
                 self._subscriptions[event].extend(callbacks)
 
-        self.metrics = Metrics()
+        # Per-node registry/tracer attached (weakly) to the process-global
+        # plane so exporters see every node merged while per-instance
+        # snapshot()/get() stay isolated (telemetry plane, ARCHITECTURE.md).
+        self.metrics = (
+            metrics
+            if metrics is not None
+            else Metrics(parent=global_metrics(), plane="protocol",
+                         node=str(my_addr))
+        )
+        self.tracer = (
+            tracer
+            if tracer is not None
+            else Tracer(parent=global_tracer(), plane="protocol",
+                        track=str(my_addr))
+        )
+        # detection -> decision -> view-installed latency on the scheduler
+        # clock (virtual ms under the test harness, wall ms on real deploys)
+        self._stable_view = StableViewTimer(
+            self.metrics, "protocol", clock=self._scheduler.now_ms
+        )
+        self._cut_detection.bind_telemetry(self.metrics, self.tracer)
         self._joiners_to_respond_to: Dict[Endpoint, List[Promise]] = {}
         self._joiner_uuid: Dict[Endpoint, NodeId] = {}
         self._joiner_metadata: Dict[Endpoint, FrozenMetadata] = {}
@@ -275,48 +303,62 @@ class MembershipService:
         future: Promise = Promise()
 
         def task() -> None:
-            current_configuration_id = self._view.get_current_configuration_id()
-            membership_size = self._view.membership_size
-            valid_alerts = [
-                self._extract_joiner_details(msg)
-                for msg in batch.messages
-                if self._filter_alert(msg, membership_size, current_configuration_id)
-            ]
-            pending = self._pending_decision
-            if pending is not None and all(
-                self._view.is_host_present(node) or node in self._joiner_uuid
-                for node in pending
+            with self.tracer.span(
+                "alert_batch", virtual_ms=self._scheduler.now_ms(),
+                alerts=len(batch.messages),
             ):
-                # the refused decision's missing joiner identities have now
-                # arrived: apply the parked view change
-                LOG.info(
-                    "%s: joiner identities arrived; applying the parked "
-                    "view change", self._my_addr,
-                )
-                self._pending_decision = None
-                self._decide_view_change(pending)
-                future.set_result(Response())
-                return
-            if self._announced_proposal:
-                # We already initiated consensus and cannot go back on it.
-                future.set_result(Response())
-                return
-            proposal: Set[Endpoint] = set()
-            for alert in valid_alerts:
-                proposal.update(self._cut_detection.aggregate_for_proposal(alert))
-            proposal.update(self._cut_detection.invalidate_failing_edges(self._view))
-            if proposal:
-                self._announced_proposal = True
-                self.metrics.incr("proposals")
-                changes = self._node_status_changes(proposal)
-                self._fire(
-                    ClusterEvents.VIEW_CHANGE_PROPOSAL, current_configuration_id, changes
-                )
-                self._fast_paxos.propose(sorted(proposal, key=address_comparator_key))
+                self._handle_batched_alerts_task(batch)
             future.set_result(Response())
 
         self._resources.protocol_executor.execute(task)
         return future
+
+    def _handle_batched_alerts_task(self, batch: BatchedAlertMessage) -> None:
+        current_configuration_id = self._view.get_current_configuration_id()
+        membership_size = self._view.membership_size
+        valid_alerts = [
+            self._extract_joiner_details(msg)
+            for msg in batch.messages
+            if self._filter_alert(msg, membership_size, current_configuration_id)
+        ]
+        if valid_alerts:
+            # first admissible evidence of membership churn in this
+            # configuration starts the time-to-stable-view clock
+            self._stable_view.detection()
+        pending = self._pending_decision
+        if pending is not None and all(
+            self._view.is_host_present(node) or node in self._joiner_uuid
+            for node in pending
+        ):
+            # the refused decision's missing joiner identities have now
+            # arrived: apply the parked view change
+            LOG.info(
+                "%s: joiner identities arrived; applying the parked "
+                "view change", self._my_addr,
+            )
+            self._pending_decision = None
+            self._decide_view_change(pending)
+            return
+        if self._announced_proposal:
+            # We already initiated consensus and cannot go back on it.
+            return
+        proposal: Set[Endpoint] = set()
+        for alert in valid_alerts:
+            proposal.update(self._cut_detection.aggregate_for_proposal(alert))
+        proposal.update(self._cut_detection.invalidate_failing_edges(self._view))
+        if proposal:
+            self._announced_proposal = True
+            self.metrics.incr("proposals")
+            self.tracer.event(
+                "proposal", virtual_ms=self._scheduler.now_ms(),
+                size=len(proposal),
+                configuration_id=current_configuration_id,
+            )
+            changes = self._node_status_changes(proposal)
+            self._fire(
+                ClusterEvents.VIEW_CHANGE_PROPOSAL, current_configuration_id, changes
+            )
+            self._fast_paxos.propose(sorted(proposal, key=address_comparator_key))
 
     def _filter_alert(
         self, alert: AlertMessage, membership_size: int, current_configuration_id: int
@@ -384,6 +426,14 @@ class MembershipService:
     # ------------------------------------------------------------------ #
 
     def _decide_view_change(self, proposal: List[Endpoint]) -> None:
+        with self.tracer.span(
+            "view_change", virtual_ms=self._scheduler.now_ms(),
+            size=len(proposal),
+        ):
+            self._decide_view_change_locked(proposal)
+
+    def _decide_view_change_locked(self, proposal: List[Endpoint]) -> None:
+        self._stable_view.decision()
         # A decided proposal can reference a joiner whose UUID-carrying UP
         # alerts this node never processed (every alert delivery is
         # best-effort; the quorum of votes can arrive anyway). Applying a
@@ -437,6 +487,7 @@ class MembershipService:
         configuration_id = self._view.get_current_configuration_id()
         self.metrics.incr("view_changes")
         self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, status_changes)
+        self._stable_view.view_installed()
 
         self._cut_detection.clear()
         self._announced_proposal = False
@@ -462,6 +513,8 @@ class MembershipService:
             self._on_consensus_decide,
             consensus_fallback_base_delay_ms=self._settings.consensus_fallback_base_delay_ms,
             rng=self._rng,
+            metrics=self.metrics,
+            tracer=self.tracer,
         )
 
     def _on_consensus_decide(self, proposal: List[Endpoint]) -> None:
@@ -493,6 +546,12 @@ class MembershipService:
                 return  # stale notification from an old configuration
             if not self._view.is_host_present(subject):
                 return
+            self.metrics.incr("fd.edge_failures")
+            self.tracer.event(
+                "fd_signal", virtual_ms=self._scheduler.now_ms(),
+                subject=str(subject),
+            )
+            self._stable_view.detection()
             alert = AlertMessage(
                 edge_src=self._my_addr,
                 edge_dst=subject,
@@ -532,6 +591,10 @@ class MembershipService:
     def _enqueue_alert(self, msg: AlertMessage) -> None:
         self.metrics.incr("alerts_enqueued")
         self._last_enqueue_ms = self._scheduler.now_ms()
+        self.tracer.event(
+            "alert_enqueued", virtual_ms=self._last_enqueue_ms,
+            dst=str(msg.edge_dst), status=msg.edge_status.name,
+        )
         self._alert_send_queue.append(msg)
 
     def _alert_batcher_tick(self) -> None:

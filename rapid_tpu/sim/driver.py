@@ -27,7 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..observability import Metrics, Tracer
+from ..observability import (
+    Metrics,
+    StableViewTimer,
+    Tracer,
+    global_metrics,
+    global_tracer,
+)
 from .engine import (
     RoundInputs,
     SimConfig,
@@ -77,6 +83,8 @@ class Simulator:
         mesh=None,
         speculate: bool = True,
         identities=None,
+        metrics: Optional[Metrics] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         """``mesh``: a jax.sharding.Mesh (from shard.engine.make_mesh) to run
         the round loop sharded over multiple devices -- per-edge state
@@ -138,6 +146,11 @@ class Simulator:
         self.seed = seed
         self.speculate = speculate
         self.virtual_ms = 0
+        # telemetry injection (None -> per-sim registries attached to the
+        # process-global plane); stored as overrides so from_configuration's
+        # __new__ path reconstructs identically via _init_runtime_state
+        self._metrics_override = metrics
+        self._tracer_override = tracer
         self._init_runtime_state()
 
     def _init_runtime_state(self) -> None:
@@ -159,8 +172,24 @@ class Simulator:
         self._billed_rounds = 0  # rounds of this configuration already billed
         self._rounds_executed = 0  # host mirror of state.round (per config)
         self.view_changes: List[ViewChangeRecord] = []
-        self.metrics = Metrics()
-        self.tracer = Tracer()
+        metrics_override = getattr(self, "_metrics_override", None)
+        tracer_override = getattr(self, "_tracer_override", None)
+        self.metrics = (
+            metrics_override
+            if metrics_override is not None
+            else Metrics(parent=global_metrics(), plane="sim")
+        )
+        self.tracer = (
+            tracer_override
+            if tracer_override is not None
+            else Tracer(parent=global_tracer(), plane="sim", track="sim")
+        )
+        # detection -> decision -> view-installed on the VIRTUAL clock, with
+        # the same bucket edges as the protocol plane's StableViewTimer, so
+        # time_to_stable_view_ms distributions compare bucket-for-bucket
+        self._stable_view = StableViewTimer(
+            self.metrics, "sim", clock=lambda: self.virtual_ms
+        )
         # fault plane
         self._ingress_partitioned: Set[int] = set()
         self._drop_prob = np.zeros(capacity, dtype=np.float32)
@@ -276,6 +305,7 @@ class Simulator:
 
     def crash(self, node_ids: np.ndarray) -> None:
         """Crash-stop burst: nodes stop responding to probes and stop voting."""
+        self._stable_view.detection()
         self.alive[np.atleast_1d(node_ids)] = False
         # enqueue the liveness transfer now (async) so the decision loop's
         # dispatch never waits on a host->device round trip for it
@@ -295,6 +325,7 @@ class Simulator:
         the cut decides in ~1 round instead of waiting out the FD threshold.
         Leavers keep responding to probes until the view change removes them
         (a leaving process shuts down only after its notification round)."""
+        self._stable_view.detection()
         for node in np.atleast_1d(node_ids):
             node = int(node)
             assert self.active[node], f"node {node} is not a member"
@@ -309,6 +340,7 @@ class Simulator:
         how alerts broadcast by *real* processes (bridged via TpuSimMessaging)
         enter the simulated cut detector's report table. One-shot per
         configuration, like any other alert."""
+        self._stable_view.detection()
         self._injected_down[dst, list(rings)] = True
         self._down_reports_dev = None
 
@@ -367,6 +399,7 @@ class Simulator:
         """Asymmetric failure: probes TO these nodes are lost, their own
         traffic still flows (paper §7, iptables INPUT partitions). Persists
         across view changes until lifted."""
+        self._stable_view.detection()
         self._ingress_partitioned.update(int(i) for i in np.atleast_1d(node_ids))
         self._probe_drop_dev = None
 
@@ -566,6 +599,7 @@ class Simulator:
         alerts with the ring numbers the joiner assigned
         (MembershipService.java:229-251). Pending joiners re-attempt in every
         new configuration until admitted."""
+        self._stable_view.detection()
         for node in np.atleast_1d(node_ids):
             node = int(node)
             assert not self.active[node], f"node {node} already a member"
@@ -663,7 +697,9 @@ class Simulator:
                 # (the bridge's phase A) instead of a host-driven
                 # round-at-a-time loop; the scan path keeps per-batch stops
                 n = max_rounds - rounds_done
-            with self.tracer.span("device_rounds", virtual_ms=self.virtual_ms, rounds=n):
+            with self.tracer.span(
+                "device_rounds", virtual_ms=self.virtual_ms, rounds=n
+            ) as dispatch_span:
                 if self.mesh is not None:
                     # inputs are already placed under their dispatch shardings;
                     # the while_loop runner exits at the decision round (and,
@@ -717,6 +753,12 @@ class Simulator:
             )
             self._rounds_executed = int(round_np)
             self.metrics.incr("device_dispatches")
+            # close the span's virtual extent with the rounds that actually
+            # executed (billing happens later, at decision/announcement); the
+            # Span object is already recorded, so mutating it is enough
+            dispatch_span.virtual_end_ms = self.virtual_ms + (
+                self._rounds_executed - self._billed_rounds
+            ) * self._round_ms
             rounds_done += n
             if decided:
                 return self._apply_view_change(
@@ -944,6 +986,9 @@ class Simulator:
         fetched: Tuple[np.ndarray, int, int],  # (proposal[G,C], group, round)
     ) -> ViewChangeRecord:
         self.metrics.incr("view_changes")
+        vc_span = self.tracer.begin(
+            "view_change", virtual_ms=self.virtual_ms
+        )
         self._config_id = None  # membership / identifier history change below
         proposal_np, decided_group, decided_round = fetched
         # the winning proposal row's value is the decided cut
@@ -989,6 +1034,10 @@ class Simulator:
         )
         self._billed_rounds = 0
         self._rounds_executed = 0  # fresh configuration: state.round resets
+        # the consensus decision landed at the decided round; the view is
+        # installed once the fresh state below replaces the device plane --
+        # both stamped on the virtual clock for cross-plane comparability
+        self._stable_view.decision(self.virtual_ms)
         record = ViewChangeRecord(
             cut=np.flatnonzero(cut),
             added=added,
@@ -1006,6 +1055,28 @@ class Simulator:
         # history can grow afterwards, which changes the config-id fold even
         # for an identical active mask
         self._spec = None
+        self._stable_view.view_installed(self.virtual_ms)
+        # fault-array occupancy: host mirrors only, refreshed once per view
+        # change flush -- never a per-round device pull
+        self.metrics.set_gauge(
+            "sim.fault.crashed", int((self.active & ~self.alive).sum())
+        )
+        self.metrics.set_gauge(
+            "sim.fault.ingress_partitioned", len(self._ingress_partitioned)
+        )
+        self.metrics.set_gauge(
+            "sim.fault.lossy", int((self._drop_prob > 0).sum())
+        )
+        self.metrics.set_gauge("sim.membership_size", record.membership_size)
+        self.metrics.set_gauge(
+            "sim.pending_joiners", len(self._pending_joiners)
+        )
+        vc_span.attrs.update(
+            cut=len(record.cut), added=len(record.added),
+            removed=len(record.removed),
+            configuration_id=record.configuration_id,
+        )
+        self.tracer.end(vc_span, virtual_ms=self.virtual_ms)
         return record
 
     # ------------------------------------------------------------------ #

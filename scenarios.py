@@ -177,6 +177,55 @@ def scenario_flip_flop_with_join_wave(n, capacity, seed):
     }
 
 
+def scenario_nemesis_protocol(plan_seed=7, n=5):
+    """The protocol-plane leg of the nemesis run: the same FaultPlan class
+    (one-way partition of one node) armed over an in-process virtual-time
+    cluster with real ping-pong failure detectors. Rides the telemetry
+    plane: every node's spans/metrics attach to the process-global registry,
+    so a --trace-out/--metrics-out export carries this leg's protocol spans
+    and the simulator leg's device spans on one timeline."""
+    from rapid_tpu.faults import FaultPlan
+    from rapid_tpu.observability import global_metrics
+    sys.path.insert(0, "tests")
+    from harness import ClusterHarness
+
+    t0 = time.perf_counter()
+    h = ClusterHarness(seed=plan_seed, use_static_fd=False)
+    victim = h.addr(n - 1)
+    h.with_faults(FaultPlan(seed=plan_seed).partition_one_way(dst=victim))
+    h.nemesis.arm(epoch_ms=1 << 40)  # windows far away during bootstrap
+    h.start_seed(0)
+    for i in range(1, n):
+        h.join(i)
+        h.wait_and_verify_agreement(i + 1)
+    h.nemesis.arm()  # plan time zero = now: the partition opens
+    start_virtual = h.scheduler.now_ms()
+    vic = h.instances.pop(victim)
+    try:
+        h.wait_and_verify_agreement(n - 1)
+        virtual_ms = h.scheduler.now_ms() - start_virtual
+        survivors = set(h.instances[h.addr(0)].get_memberlist())
+    finally:
+        vic.shutdown()
+        h.shutdown()
+    stable_view = global_metrics().histogram(
+        "time_to_stable_view_ms", plane="protocol"
+    )
+    return {
+        "config": (
+            f"nemesis protocol plane: {n} in-process nodes, windowed "
+            f"one-way partition (plan seed {plan_seed})"
+        ),
+        "n": n,
+        "virtual_ms": virtual_ms,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": bool(victim not in survivors and len(survivors) == n - 1),
+        "stable_view_decisions": (
+            stable_view["count"] if stable_view is not None else 0
+        ),
+    }
+
+
 def scenario_nemesis_smoke(n=1000, plan_seed=7):
     """One seeded FaultPlan compiled onto the device plane's fault arrays
     (rapid_tpu/faults.py): a 1% wave of one-way partitions whose windows
@@ -215,6 +264,31 @@ def scenario_nemesis_smoke(n=1000, plan_seed=7):
     }
 
 
+def _flag_value(flag: str) -> str:
+    """Value of ``--flag PATH`` in sys.argv, or '' when absent."""
+    if flag not in sys.argv:
+        return ""
+    at = sys.argv.index(flag)
+    return sys.argv[at + 1] if len(sys.argv) > at + 1 else ""
+
+
+def _write_telemetry() -> None:
+    """Honor --trace-out / --metrics-out: export the process-global
+    telemetry plane (every scenario's protocol nodes + simulators merged).
+    The Chrome trace loads in Perfetto / chrome://tracing; the metrics file
+    is Prometheus text exposition (see ARCHITECTURE.md, Telemetry plane)."""
+    from rapid_tpu.observability import write_chrome_trace, write_prometheus
+
+    trace_out = _flag_value("--trace-out")
+    metrics_out = _flag_value("--metrics-out")
+    if trace_out:
+        write_chrome_trace(trace_out)
+        print(json.dumps({"trace_out": trace_out}))
+    if metrics_out:
+        write_prometheus(metrics_out)
+        print(json.dumps({"metrics_out": metrics_out}))
+
+
 def main() -> None:
     if "--tpu" not in sys.argv:
         # pin the CPU backend via the CONFIG value (an injected accelerator
@@ -224,12 +298,15 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
     if "--fault-plan" in sys.argv:
-        # replay one seeded nemesis FaultPlan on the device plane and exit:
-        #   python scenarios.py --fault-plan [seed]
-        at = sys.argv.index("--fault-plan")
-        arg = sys.argv[at + 1] if len(sys.argv) > at + 1 else ""
+        # replay one seeded nemesis FaultPlan on the protocol plane AND the
+        # device plane, then exit (with telemetry exports if requested):
+        #   python scenarios.py --fault-plan [seed] \
+        #       [--trace-out trace.json] [--metrics-out metrics.prom]
+        arg = _flag_value("--fault-plan")
         plan_seed = int(arg) if arg.lstrip("-").isdigit() else 7
+        print(json.dumps(scenario_nemesis_protocol(plan_seed=plan_seed)))
         print(json.dumps(scenario_nemesis_smoke(plan_seed=plan_seed)))
+        _write_telemetry()
         return
     results = [
         scenario_10_node_cross_plane(),
@@ -255,6 +332,7 @@ def main() -> None:
         )
     for result in results:
         print(json.dumps(result))
+    _write_telemetry()
 
 
 if __name__ == "__main__":

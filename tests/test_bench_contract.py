@@ -153,3 +153,36 @@ def test_sweep_isolates_per_size_failures(monkeypatch):
     assert by_n[1_000]["warmed_wall_ms"] == 50.0
     assert "boom" in by_n[10_000]["error"]
     assert by_n[1_000_000]["warmed_wall_ms"] == 50.0  # later sizes still ran
+
+
+def test_telemetry_overhead_within_budget():
+    """Instrumenting the sim loop must cost (close to) nothing: the warmed
+    decision loop with the real registry stays within 5% of an identical run
+    on NullMetrics, plus a small absolute allowance for timer noise (the
+    telemetry delta on a ~10ms loop is far below scheduler jitter)."""
+    import time
+
+    import numpy as np
+
+    from rapid_tpu.observability import Metrics, NullMetrics
+    from rapid_tpu.sim.driver import Simulator
+
+    def best_of(metrics_factory, runs=5):
+        best = float("inf")
+        for _ in range(runs):
+            sim = Simulator(64, seed=5, metrics=metrics_factory())
+            sim.ready()
+            sim.crash(np.array([3]))
+            t0 = time.perf_counter()
+            record = sim.run_until_decision(max_rounds=40)
+            best = min(best, time.perf_counter() - t0)
+            assert record is not None
+        return best
+
+    best_of(NullMetrics, runs=1)  # jit warmup, shapes shared by both sides
+    noop = best_of(NullMetrics)
+    instrumented = best_of(Metrics)  # detached registry: same record path
+    assert instrumented <= noop * 1.05 + 0.05, (
+        f"telemetry overhead: instrumented={instrumented * 1e3:.1f}ms "
+        f"noop={noop * 1e3:.1f}ms"
+    )

@@ -1,11 +1,37 @@
-"""Tracing/metrics subsystem."""
+"""Telemetry plane: labeled metrics, hierarchical tracing, exporters.
+
+Golden files under tests/golden/ pin the exporter wire formats
+(telemetry_prometheus.txt, telemetry_chrome_trace.json): both builders below
+use fixed timestamps/ids so the output is bit-reproducible.
+"""
+
+import gc
+import json
+import pathlib
+import threading
 
 import numpy as np
 
-from rapid_tpu.observability import Metrics, Tracer
+from rapid_tpu.faults import FaultPlan, Nemesis
+from rapid_tpu.observability import (
+    STABLE_VIEW_BUCKETS_MS,
+    Histogram,
+    Metrics,
+    Span,
+    StableViewTimer,
+    Tracer,
+    chrome_trace,
+    prometheus_text,
+)
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.runtime.scheduler import VirtualScheduler
+from rapid_tpu.settings import Settings
 from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.types import Endpoint, ProbeMessage, Response
 
 from harness import ClusterHarness
+
+GOLDEN = pathlib.Path(__file__).parent / "golden"
 
 
 def test_metrics_counters():
@@ -17,6 +43,72 @@ def test_metrics_counters():
     assert m.snapshot() == {"a": 3}
     m.reset()
     assert m.snapshot() == {}
+
+
+def test_labeled_counters_and_summed_get():
+    m = Metrics()
+    m.incr("x", at="egress")
+    m.incr("x", 2, at="ingress")
+    assert m.get("x", at="egress") == 1
+    assert m.get("x", at="ingress") == 2
+    # unlabeled read sums across label sets: legacy call sites keep working
+    # after a counter gains labels
+    assert m.get("x") == 3
+    assert m.snapshot() == {"x{at=egress}": 1, "x{at=ingress}": 2}
+
+
+def test_metrics_thread_safety():
+    m = Metrics()
+    n_threads, n_iters = 8, 1000
+
+    def worker():
+        for _ in range(n_iters):
+            m.incr("a")
+            m.observe("h", 1.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.get("a") == n_threads * n_iters
+    assert m.histograms()["h"]["count"] == n_threads * n_iters
+
+
+def test_histogram_bucket_edges_are_le_inclusive():
+    h = Histogram((1, 2, 10_000))
+    for v in (1, 1.0, 2, 2.5, 10_000, 10_001):
+        h.observe(v)
+    # value == edge lands IN that bucket (Prometheus le semantics)
+    assert h.counts == [2, 1, 2, 1]
+    assert h.count == 6
+    assert h.sum == 1 + 1.0 + 2 + 2.5 + 10_000 + 10_001
+    snap = h.snapshot()
+    assert snap["buckets"] == [1, 2, 10_000]
+    assert snap["counts"] == [2, 1, 2, 1]
+
+
+def test_registry_attach_collect_and_absorb():
+    parent = Metrics()
+    child = Metrics(parent=parent, node="n1")
+    child.incr("proposals")
+    child.observe("h", 5.0)
+    # live child: visible through collect() with const labels merged,
+    # invisible to the parent's own get()/snapshot()
+    samples = {
+        (kind, name, tuple(sorted(labels.items())))
+        for kind, name, labels, _ in parent.collect()
+    }
+    assert ("counter", "proposals", (("node", "n1"),)) in samples
+    assert parent.get("proposals") == 0
+    # dead child: final samples fold into the parent (finalizer absorb),
+    # so a shut-down component's telemetry survives into exports
+    del child
+    gc.collect()
+    assert parent.get("proposals") == 1
+    text = prometheus_text(parent)
+    assert 'rapid_proposals_total{node="n1"} 1' in text
+    assert 'rapid_h_count{node="n1"} 1' in text
 
 
 def test_tracer_spans_and_summary():
@@ -31,6 +123,115 @@ def test_tracer_spans_and_summary():
     assert t.spans[0].attrs == {"rounds": 2}
 
 
+def test_tracer_ring_overflow_counts_drops():
+    t = Tracer(max_spans=5)
+    for i in range(8):
+        t.event(f"e{i}")
+    assert len(t.spans) == 5
+    assert t.dropped == 3
+    assert [s.name for s in t.spans] == ["e3", "e4", "e5", "e6", "e7"]
+    t.reset()
+    assert t.spans == [] and t.dropped == 0
+
+
+def test_span_tree_reconstruction():
+    t = Tracer()
+    with t.span("outer") as outer:
+        with t.span("inner") as inner:
+            leaf = t.event("leaf")
+    assert inner.parent_id == outer.span_id
+    assert leaf.parent_id == inner.span_id
+    tree = t.span_tree()
+    assert [s.name for s in tree[None]] == ["outer"]
+    assert [s.name for s in tree[outer.span_id]] == ["inner"]
+    assert [s.name for s in tree[inner.span_id]] == ["leaf"]
+
+
+def test_child_tracer_spans_absorbed_on_gc():
+    root = Tracer(plane="global", track="global")
+    child = Tracer(parent=root, plane="protocol", track="n1")
+    child.event("cut_detected")
+    assert [s.name for s in root.collect_spans()] == ["cut_detected"]
+    del child
+    gc.collect()
+    assert [s.name for s in root.collect_spans()] == ["cut_detected"]
+    # the read path drained the dead child's spans into the root's own ring
+    assert [s.name for s in root.spans] == ["cut_detected"]
+
+
+# -- exporter golden files --------------------------------------------------
+
+
+def _golden_metrics() -> Metrics:
+    m = Metrics()
+    m.incr("proposals", 3)
+    m.incr("nemesis_dropped", 2, at="egress", msg="ProbeMessage")
+    m.set_gauge("sim.membership_size", 99, plane="sim")
+    m.observe("time_to_stable_view_ms", 120,
+              buckets=STABLE_VIEW_BUCKETS_MS, plane="sim")
+    m.observe("time_to_stable_view_ms", 4000,
+              buckets=STABLE_VIEW_BUCKETS_MS, plane="sim")
+    return m
+
+
+def _golden_tracers():
+    root = Tracer(plane="protocol", track="node-1")
+    root.spans.append(Span(
+        name="view_change", wall_start_s=1.0, wall_end_s=1.002,
+        virtual_start_ms=100, virtual_end_ms=150, attrs={"size": 3},
+        span_id=1, parent_id=None, plane="protocol", track="node-1",
+    ))
+    root.spans.append(Span(
+        name="cut_detected", wall_start_s=1.0005, wall_end_s=1.0005,
+        virtual_start_ms=110, virtual_end_ms=110, attrs={},
+        span_id=2, parent_id=1, plane="protocol", track="node-1",
+    ))
+    sim = Tracer(parent=root, plane="sim", track="sim")
+    sim.spans.append(Span(
+        name="device_rounds", wall_start_s=1.001, wall_end_s=1.01,
+        virtual_start_ms=0, virtual_end_ms=500, attrs={"rounds": 5},
+        span_id=3, parent_id=None, plane="sim", track="sim",
+    ))
+    return root, sim  # sim returned too: the attach is a weakref
+
+
+def test_prometheus_export_matches_golden():
+    assert prometheus_text(_golden_metrics()) == (
+        GOLDEN / "telemetry_prometheus.txt"
+    ).read_text()
+
+
+def test_chrome_trace_matches_golden():
+    root, _sim = _golden_tracers()
+    assert chrome_trace(root) == json.loads(
+        (GOLDEN / "telemetry_chrome_trace.json").read_text()
+    )
+
+
+def test_chrome_trace_planes_and_virtual_track():
+    root, _sim = _golden_tracers()
+    events = chrome_trace(root)["traceEvents"]
+    process_names = {
+        e["args"]["name"] for e in events if e.get("name") == "process_name"
+    }
+    assert process_names == {"protocol", "sim", "virtual-time (ms)"}
+    # virtual-track copies put ts at virtual_ms x1000
+    virtual_pid = next(
+        e["pid"] for e in events
+        if e.get("name") == "process_name"
+        and e["args"]["name"] == "virtual-time (ms)"
+    )
+    v = [e for e in events if e.get("ph") == "X" and e["pid"] == virtual_pid]
+    by_name = {e["name"]: e for e in v}
+    assert by_name["view_change"]["ts"] == 100 * 1000
+    assert by_name["view_change"]["dur"] == 50 * 1000
+    assert by_name["device_rounds"]["ts"] == 0
+    assert by_name["device_rounds"]["dur"] == 500 * 1000
+
+
+# -- per-plane integration --------------------------------------------------
+
+
 def test_simulator_records_metrics_and_spans():
     sim = Simulator(10, seed=1)
     sim.crash(np.array([3]))
@@ -41,6 +242,53 @@ def test_simulator_records_metrics_and_spans():
     assert snap["rounds"] >= 10
     assert snap["device_dispatches"] >= 1
     assert sim.tracer.summary()["device_rounds"]["count"] >= 1
+
+
+def test_virtual_and_wall_time_span_parity():
+    """Simulator spans carry BOTH clocks, and the two planes' stable-view
+    histograms share one bucket definition, so distributions line up."""
+    sim = Simulator(10, seed=1)
+    sim.crash(np.array([3]))
+    assert sim.run_until_decision(max_rounds=40) is not None
+    by_name = {}
+    for s in sim.tracer.spans:
+        by_name.setdefault(s.name, []).append(s)
+    for name in ("device_rounds", "view_change"):
+        for s in by_name[name]:
+            assert s.wall_end_s >= s.wall_start_s
+            assert s.virtual_start_ms is not None
+            assert s.virtual_end_ms >= s.virtual_start_ms
+    sim_hist = sim.metrics.histogram("time_to_stable_view_ms", plane="sim")
+    assert sim_hist is not None and sim_hist["count"] == 1
+    # protocol plane records onto the identical bucket edges
+    proto = Metrics()
+    timer = StableViewTimer(proto, "protocol", clock=lambda: 0)
+    timer.detection(0)
+    timer.decision(7)
+    timer.view_installed(12)
+    proto_hist = proto.histogram("time_to_stable_view_ms", plane="protocol")
+    assert proto_hist["buckets"] == sim_hist["buckets"]
+    assert proto_hist["buckets"] == list(STABLE_VIEW_BUCKETS_MS)
+    assert proto_hist["sum"] == 12.0
+
+
+def test_stable_view_timer_phases():
+    m = Metrics()
+    timer = StableViewTimer(m, "protocol", clock=lambda: 0)
+    timer.view_installed(5)  # nothing detected: no-op (initial view)
+    assert m.histograms() == {}
+    timer.detection(10)
+    timer.detection(99)  # first detection sticks
+    timer.decision(40)
+    timer.decision(60)  # last decision wins (parked decision re-applied)
+    timer.view_installed(70)
+    hists = m.histograms()
+    assert hists["latency.detection_to_decision_ms{plane=protocol}"]["sum"] == 50
+    assert hists["latency.decision_to_view_ms{plane=protocol}"]["sum"] == 10
+    assert hists["time_to_stable_view_ms{plane=protocol}"]["sum"] == 60
+    # the cycle reset: a second view change needs a fresh detection
+    timer.view_installed(80)
+    assert hists["time_to_stable_view_ms{plane=protocol}"]["count"] == 1
 
 
 def test_service_metrics():
@@ -56,3 +304,47 @@ def test_service_metrics():
         assert any(k.startswith("messages.") for k in snap)
     finally:
         h.shutdown()
+
+
+def test_service_traces_protocol_phases():
+    h = ClusterHarness(seed=1)
+    try:
+        seed = h.start_seed()
+        h.join(1)
+        h.wait_and_verify_agreement(2)
+        tracer = seed._membership_service.tracer
+        names = {s.name for s in tracer.spans}
+        assert {"alert_enqueued", "proposal", "view_change"} <= names
+        hist = seed._membership_service.metrics.histogram(
+            "time_to_stable_view_ms", plane="protocol"
+        )
+        assert hist is not None and hist["count"] >= 1
+    finally:
+        h.shutdown()
+
+
+def test_nemesis_counters_labeled_in_prometheus_export():
+    a = Endpoint.from_parts("10.0.0.1", 50)
+    b = Endpoint.from_parts("10.0.0.2", 50)
+    sched = VirtualScheduler()
+    metrics = Metrics()
+    nem = Nemesis(
+        FaultPlan(seed=1).partition_one_way(dst=b), sched, metrics=metrics
+    ).arm(0)
+
+    class _Sink:
+        def send_message_best_effort(self, remote, msg):
+            return Promise.completed(Response())
+
+        send_message = send_message_best_effort
+
+        def shutdown(self):
+            pass
+
+    client = nem.client(_Sink(), address=a, settings=Settings())
+    client.send_message_best_effort(b, ProbeMessage(sender=a))
+    text = prometheus_text(metrics)
+    assert (
+        'rapid_nemesis_dropped_total{at="egress",msg="ProbeMessage"} 1'
+        in text
+    )

@@ -20,6 +20,7 @@ Usage: python tools/check.py [paths...]   (default: the repo's source roots)
 from __future__ import annotations
 
 import ast
+import importlib.util
 import sys
 from pathlib import Path
 
@@ -30,6 +31,22 @@ DEFAULT_PATHS = ["rapid_tpu", "tests", "examples", "experiments", "tools",
 # modules where `print` is the intended UI (CLIs, benchmarks, experiments)
 PRINT_OK_ROOTS = ("examples", "experiments", "tools", "tests")
 PRINT_OK_FILES = {"bench.py", "scenarios.py", "__graft_entry__.py"}
+
+
+def _load_metric_catalog() -> "tuple[frozenset, tuple]":
+    """METRIC_CATALOG / METRIC_PREFIXES from rapid_tpu/observability.py,
+    loaded as a standalone module (observability.py is stdlib-only at module
+    level; importing the rapid_tpu package here would pull in jax)."""
+    spec = importlib.util.spec_from_file_location(
+        "_rapid_observability", REPO / "rapid_tpu" / "observability.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclass processing resolves __module__
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod.METRIC_CATALOG, mod.METRIC_PREFIXES
+
+
+METRIC_CATALOG, METRIC_PREFIXES = _load_metric_catalog()
 
 
 class Finding:
@@ -65,6 +82,11 @@ class Checker(ast.NodeVisitor):
         rel = path.relative_to(REPO)
         self.print_ok = (
             rel.parts[0] in PRINT_OK_ROOTS or rel.name in PRINT_OK_FILES
+        )
+        # the metric-name lint applies to library code only: test fixtures
+        # mint throwaway names, and observability.py defines the catalog
+        self.metric_names_checked = (
+            rel.parts[0] == "rapid_tpu" and rel.name != "observability.py"
         )
 
     def report(self, node: ast.AST, rule: str, msg: str) -> None:
@@ -193,7 +215,40 @@ class Checker(ast.NodeVisitor):
             and func.attr == "set_trace"
         ):
             self.report(node, "debugger", "debugger breakpoint left in code")
+        if (
+            self.metric_names_checked
+            and isinstance(func, ast.Attribute)
+            and func.attr in ("incr", "observe")
+            and node.args
+        ):
+            self._check_metric_name(node, node.args[0])
         self.generic_visit(node)
+
+    def _check_metric_name(self, call: ast.Call, arg: ast.expr) -> None:
+        """Every .incr()/.observe() call site in library code must use a
+        name from observability.METRIC_CATALOG (or a METRIC_PREFIXES
+        dynamic family, e.g. f"messages.{...}"). Dynamic names built from
+        variables are skipped -- the lint targets the literal call sites
+        where a typo would silently fork a metric series."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+            if name not in METRIC_CATALOG and not name.startswith(METRIC_PREFIXES):
+                self.report(
+                    call, "unknown-metric",
+                    f"metric name {name!r} not in observability.METRIC_CATALOG",
+                )
+        elif isinstance(arg, ast.JoinedStr):
+            head = arg.values[0] if arg.values else None
+            if not (
+                isinstance(head, ast.Constant)
+                and isinstance(head.value, str)
+                and head.value.startswith(METRIC_PREFIXES)
+            ):
+                self.report(
+                    call, "unknown-metric",
+                    "f-string metric name must start with a METRIC_PREFIXES "
+                    f"prefix ({', '.join(METRIC_PREFIXES)})",
+                )
 
 
 def check_file(path: Path) -> list[Finding]:
