@@ -134,6 +134,36 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
     )
 
 
+def _emit_outage_json(reason: str) -> None:
+    """rc-17 paths still owe the harness one well-formed JSON line: no
+    measurement happened, but ``"outage": true`` plus whatever CPU/sim-plane
+    telemetry accumulated before the tunnel died (histograms in particular)
+    lets the curve distinguish 'infrastructure down' from 'emitted nothing'
+    without parsing stderr."""
+    histograms = None
+    try:
+        from rapid_tpu.observability import json_snapshot
+
+        histograms = json_snapshot()["histograms"] or None
+    except Exception:  # noqa: BLE001 -- telemetry must never sink the artifact
+        histograms = None
+    print(
+        json.dumps(
+            {
+                "metric": "time_to_stable_view_100k_nodes_1pct_crash_sim",
+                "value": None,
+                "unit": "ms",
+                "outage": True,
+                "reason": reason,
+                "backend": _PROGRESS["backend"],
+                "time_to_stable_view_ms": _stable_view_hist(),
+                "histograms": histograms,
+            }
+        ),
+        flush=True,
+    )
+
+
 def _on_watchdog() -> int:
     """The watchdog's decision, separated from os._exit for testability:
     with the headline already measured, the hang is in the sweep tail --
@@ -153,6 +183,7 @@ def _on_watchdog() -> int:
         if _PROGRESS["backend"] == "tpu" and headline["value"] > TPU_BUDGET_MS:
             return 18
         return 0
+    _emit_outage_json(f"watchdog after {WATCHDOG_S}s with no headline")
     print(
         f"bench.py watchdog: no result after {WATCHDOG_S}s -- the "
         "accelerator likely became unreachable mid-run (the TPU tunnel "
@@ -275,6 +306,9 @@ def main() -> None:
     _arm_watchdog()
     backend = probe_backend()
     if backend is None:
+        _emit_outage_json(
+            f"accelerator unreachable after {len(PROBE_TIMEOUTS_S)} probes"
+        )
         print(
             "bench.py: accelerator unreachable after "
             f"{len(PROBE_TIMEOUTS_S)} bounded probes -- the TPU tunnel's "

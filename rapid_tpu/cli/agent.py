@@ -10,16 +10,88 @@ cluster events and prints the membership once per second.
 """
 
 import argparse
+import json
 import logging
+import os
+import sys
+import tempfile
 import time
 
 from rapid_tpu import ClusterBuilder, ClusterEvents, Endpoint, Settings
 from rapid_tpu.messaging.tcp import TcpClientServer
 
 
+def _write_prometheus_atomic(path: str) -> None:
+    """Rewrite the exposition file atomically: a scraper that reads during a
+    tick sees either the previous complete file or the new complete file,
+    never a truncated one."""
+    from rapid_tpu.observability import prometheus_text
+
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=".prom-", dir=directory)
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(prometheus_text())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _print_status(target_raw: str, timeout_s: float) -> int:
+    """--status mode: one-shot ClusterStatusRequest against a live agent."""
+    from rapid_tpu.types import ClusterStatusRequest, ClusterStatusResponse
+
+    target = Endpoint.from_string(target_raw)
+    client = TcpClientServer(Endpoint(b"127.0.0.1", 0), Settings())
+    try:
+        reply = client.send_message(
+            target, ClusterStatusRequest(sender=client.address)
+        ).result(timeout_s)
+    finally:
+        client.shutdown()
+    if not isinstance(reply, ClusterStatusResponse):
+        sys.stdout.write(
+            f"{target_raw}: unexpected reply {type(reply).__name__}\n"
+        )
+        return 1
+    lines = [
+        f"{reply.sender}  config={reply.configuration_id}"
+        f"  members={reply.membership_size}",
+        f"  cut-detector: tracked={reply.reports_tracked}"
+        f" pre-proposal={reply.pre_proposal_size}"
+        f" proposal={reply.proposal_size}"
+        f" in-progress={reply.updates_in_progress}",
+        f"  consensus: decided={reply.consensus_decided}"
+        f" votes={reply.consensus_votes}",
+    ]
+    for name, value in zip(reply.metric_names, reply.metric_values):
+        lines.append(f"  metric {name} = {value}")
+    for raw in reply.journal:
+        try:
+            entry = json.loads(raw)
+            lines.append(
+                f"  journal [{entry.get('seq')}] {entry.get('kind')}"
+                f" @{entry.get('virtual_ms')}ms {entry.get('detail', {})}"
+            )
+        except (ValueError, TypeError):
+            lines.append(f"  journal {raw}")
+    sys.stdout.write("\n".join(lines) + "\n")
+    return 0
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description="rapid-tpu standalone agent")
-    parser.add_argument("--listen-address", required=True, help="host:port to listen on")
+    parser.add_argument(
+        "--status", metavar="ADDR",
+        help="client-only mode: query ADDR's cluster-status RPC (config id, "
+        "view size, cut-detector occupancy, consensus state, metrics digest, "
+        "journal tail), print it, and exit",
+    )
+    parser.add_argument("--listen-address", help="host:port to listen on")
     parser.add_argument("--seed-address", help="host:port of a seed to join")
     parser.add_argument(
         "--gateway-address",
@@ -72,8 +144,21 @@ def main() -> None:
         help="path written on shutdown with a Chrome trace_event JSON of the "
         "agent's spans (load in Perfetto / chrome://tracing)",
     )
+    parser.add_argument(
+        "--journal-out",
+        help="path written on shutdown with the flight-recorder journal "
+        "(JSON lines, newest last): the last N membership-relevant events "
+        "this node saw",
+    )
+    parser.add_argument("--status-timeout", type=float, default=5.0,
+                        help="seconds to wait in --status mode")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args()
+
+    if args.status:
+        raise SystemExit(_print_status(args.status, args.status_timeout))
+    if not args.listen_address:
+        parser.error("--listen-address is required (except in --status mode)")
 
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -173,9 +258,7 @@ def main() -> None:
                 [str(m) for m in members] if len(members) <= 32 else "...",
             )
             if args.metrics_out:
-                from rapid_tpu.observability import write_prometheus
-
-                write_prometheus(args.metrics_out)
+                _write_prometheus_atomic(args.metrics_out)
     except KeyboardInterrupt:
         cluster.leave_gracefully()
     finally:
@@ -184,6 +267,9 @@ def main() -> None:
 
             write_chrome_trace(args.trace_out)
             log.info("wrote Chrome trace to %s", args.trace_out)
+        if args.journal_out:
+            cluster.flight_recorder.dump(args.journal_out)
+            log.info("wrote flight-recorder journal to %s", args.journal_out)
 
 
 if __name__ == "__main__":

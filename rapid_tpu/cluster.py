@@ -25,7 +25,7 @@ from .messaging.base import IMessagingClient, IMessagingServer
 from .metadata import FrozenMetadata
 from .monitoring.base import IEdgeFailureDetectorFactory
 from .monitoring.pingpong import PingPongFailureDetectorFactory
-from .observability import Metrics, Tracer, global_metrics
+from .observability import FlightRecorder, Metrics, Tracer, global_metrics
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
 from .runtime.scheduler import Scheduler
@@ -94,6 +94,19 @@ class Cluster:
     def get_current_configuration_id(self) -> int:
         self._check_running()
         return self._membership_service.get_current_configuration_id()
+
+    def get_cluster_status(self):
+        """Local introspection snapshot (same shape the ClusterStatusRequest
+        RPC returns): config id, view size, cut-detector watermark occupancy,
+        consensus round state, metrics digest, and the journal tail."""
+        self._check_running()
+        return self._membership_service.cluster_status()
+
+    @property
+    def flight_recorder(self) -> FlightRecorder:
+        """The node's event journal; deliberately NOT gated on running so a
+        post-mortem can dump it after shutdown."""
+        return self._membership_service.recorder
 
     def register_subscription(
         self, event: ClusterEvents, callback: SubscriptionCallback
@@ -283,6 +296,10 @@ class ClusterBuilder:
             broadcaster=self._broadcaster(client, rng),
             metrics=self._metrics,
             tracer=self._tracer,
+            recorder=FlightRecorder(
+                node=str(self._listen_address),
+                clock=resources.scheduler.now_ms,
+            ),
         )
         server.set_membership_service(service)
         server.start()
@@ -303,9 +320,18 @@ class ClusterBuilder:
         result: Promise = Promise()
         state = {"node_id": NodeId.random(rng), "attempt": 0}
         join_metrics = self._metrics if self._metrics is not None else JOIN_METRICS
+        # the flight recorder outlives individual join attempts: created here
+        # so retry exhaustion is journaled even when no service ever exists,
+        # then handed to the MembershipService on success
+        recorder = FlightRecorder(
+            node=str(self._listen_address), clock=resources.scheduler.now_ms
+        )
 
         def fail_all(reason: str) -> None:
             join_metrics.incr("join.exhausted")
+            recorder.record(
+                "join_exhausted", reason=reason, attempts=state["attempt"]
+            )
             server.shutdown()
             client.shutdown()
             resources.shutdown()
@@ -410,6 +436,7 @@ class ClusterBuilder:
                 broadcaster=self._broadcaster(client, rng),
                 metrics=self._metrics,
                 tracer=self._tracer,
+                recorder=recorder,
             )
             server.set_membership_service(service)
             result.set_result(

@@ -56,6 +56,18 @@ class MultiNodeCutDetector:
     def num_proposals(self) -> int:
         return self._proposal_count
 
+    def occupancy(self) -> Dict[str, int]:
+        """Watermark occupancy for the introspection RPC: how many subjects
+        have reports at all, how many crossed L (unstable band), how many
+        crossed H (stable, awaiting the band to drain), and the in-progress
+        count that gates proposal emission."""
+        return {
+            "reports_tracked": len(self._reports_per_host),
+            "pre_proposal_size": len(self._pre_proposal),
+            "proposal_size": len(self._proposal),
+            "updates_in_progress": self._updates_in_progress,
+        }
+
     def aggregate_for_proposal(self, msg: AlertMessage) -> List[Endpoint]:
         """Apply one alert (all its ring numbers); returns emitted proposal or []."""
         proposals: List[Endpoint] = []

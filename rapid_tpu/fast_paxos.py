@@ -84,6 +84,11 @@ class FastPaxos:
     def decided(self) -> bool:
         return self._decided
 
+    @property
+    def votes_received(self) -> int:
+        """Distinct fast-round voters tallied so far (introspection RPC)."""
+        return len(self._votes_received)
+
     def propose(self, proposal: List[Endpoint], recovery_delay_ms: Optional[int] = None) -> None:
         """Vote for ``proposal`` in the fast round and schedule the classic-round
         fallback (FastPaxos.java:94-117)."""

@@ -19,6 +19,7 @@ from typing import Any, Dict, Tuple, Type
 import msgpack
 
 from .. import types as T
+from ..observability import TraceContext, stamp_trace_context, trace_context_of
 
 # Encode memo for LARGE tuples (a 100k-member JoinResponse's endpoint and
 # identifier streams): the gateway sends the same configuration content to
@@ -56,6 +57,8 @@ _TYPES: Tuple[Type, ...] = (
     T.ConsensusResponse,  # 14
     T.GossipEnvelope,  # 15
     T.FastRoundVoteBatch,  # 16
+    T.ClusterStatusRequest,  # 17
+    T.ClusterStatusResponse,  # 18
 )
 _TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
 
@@ -162,9 +165,20 @@ def encode(request_no: int, msg: Any) -> bytes:
     with _body_memo_lock:
         hit = _body_memo.get(id(msg))
         if hit is not None and hit[0] is msg:
+            # a memo hit reuses the body packed at first encode, including
+            # whatever trace context was stamped then -- restamping a
+            # memoized >=64 KB message later does not change wire bytes
+            # (acceptable: only huge JoinResponses reach this path, and
+            # their trace context is set before the first send)
             _body_memo.move_to_end(id(msg))
             return ENVELOPE.pack(request_no, tag) + hit[1]
     payload = {k: _enc(v) for k, v in _fields_of(msg).items()}
+    ctx = trace_context_of(msg)
+    if ctx is not None:
+        # reserved key, never a dataclass field name; decoders strip every
+        # "__"-prefixed top-level key, so peers that don't know this one
+        # (or future reserved keys) parse the frame unchanged
+        payload["__tc"] = ctx.to_wire()
     body = msgpack.packb(payload, use_bin_type=True)
     if len(body) >= _BODY_MEMO_MIN:
         global _body_memo_bytes
@@ -194,5 +208,16 @@ def decode(frame: bytes) -> Tuple[int, Any]:
     request_no, tag = ENVELOPE.unpack_from(frame)
     cls = _TYPES[tag]
     raw = msgpack.unpackb(frame[ENVELOPE.size :], raw=False)
-    kwargs = {name: _tupled(_dec(value)) for name, value in raw.items()}
-    return request_no, cls(**kwargs)
+    # "__"-prefixed top-level keys are envelope extensions (today: "__tc"
+    # trace context), not dataclass fields -- strip them all so frames from
+    # newer peers always construct cleanly
+    tc = raw.pop("__tc", None)
+    kwargs = {
+        name: _tupled(_dec(value))
+        for name, value in raw.items()
+        if not name.startswith("__")
+    }
+    msg = cls(**kwargs)
+    if tc is not None:
+        stamp_trace_context(msg, TraceContext.from_wire(tc))
+    return request_no, msg

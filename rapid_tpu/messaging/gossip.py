@@ -44,6 +44,11 @@ import time
 from collections import OrderedDict, deque
 from typing import List, Optional, Tuple
 
+from ..observability import (
+    current_trace_context,
+    stamp_trace_context,
+    trace_context_of,
+)
 from ..runtime.futures import Promise
 from ..types import Endpoint, GossipEnvelope, NodeId, RapidMessage
 from .base import IBroadcaster, IMessagingClient
@@ -122,6 +127,11 @@ class GossipBroadcaster(IBroadcaster):
         """Send to self + ``fanout`` random members; relays do the rest. The
         origin's own copy arrives through the transport like everyone
         else's (UnicastToAllBroadcaster's self-delivery semantics)."""
+        # trace injection mirrors the unicast broadcaster, but the codec only
+        # carries the TOP-LEVEL message's context -- so the wrapping envelope
+        # (not just the payload) must wear the stamp to survive serialization
+        if trace_context_of(msg) is None:
+            stamp_trace_context(msg, current_trace_context())
         env = GossipEnvelope(
             sender=self._my_addr,
             gossip_id=NodeId(
@@ -131,6 +141,7 @@ class GossipBroadcaster(IBroadcaster):
             ttl=self._ttl(),
             payload=msg,
         )
+        stamp_trace_context(env, trace_context_of(msg))
         return self._send(env, include_self=True)
 
     # -- relay plane ---------------------------------------------------------
@@ -156,6 +167,12 @@ class GossipBroadcaster(IBroadcaster):
         self._pending_pulls.pop(key, None)
         prior = self._seen.get(key)
         sightings, first_seen = (prior[0], prior[1]) if prior else (0, now)
+        # the inbound envelope carried the trace over the wire; put it back on
+        # the payload so local delivery sees it, and keep it on every derived
+        # envelope (relay, stored pull-answer) so downstream hops inherit it
+        ctx = trace_context_of(env)
+        if ctx is not None and trace_context_of(env.payload) is None:
+            stamp_trace_context(env.payload, ctx)
         relay: Optional[GossipEnvelope] = None
         if sightings < self._relay_budget and env.ttl > 0:
             relay = GossipEnvelope(
@@ -164,15 +181,20 @@ class GossipBroadcaster(IBroadcaster):
                 ttl=env.ttl - 1,
                 payload=env.payload,
             )
+            stamp_trace_context(relay, ctx)
         # pushpull answers later pulls from this store; eager never pulls
         stored = None
         if self._mode == "pushpull":
             stored = prior[2] if prior else None
             if stored is None:
-                stored = relay if relay is not None else GossipEnvelope(
-                    sender=self._my_addr, gossip_id=env.gossip_id, ttl=0,
-                    payload=env.payload,
-                )
+                if relay is not None:
+                    stored = relay
+                else:
+                    stored = GossipEnvelope(
+                        sender=self._my_addr, gossip_id=env.gossip_id, ttl=0,
+                        payload=env.payload,
+                    )
+                    stamp_trace_context(stored, ctx)
         if key in self._seen:  # preserve age order: do not move to the end
             self._seen[key] = (sightings + 1, first_seen, stored)
         else:

@@ -21,6 +21,7 @@ import grpc
 import grpc.aio
 
 from .. import types as T
+from ..observability import TraceContext, stamp_trace_context, trace_context_of
 from ..runtime.futures import Promise
 from ..settings import Settings
 from .base import IMessagingClient, IMessagingServer
@@ -144,12 +145,34 @@ def to_wire_request(msg: T.RapidMessage):
         p.endpoints.extend(_ep(e) for e in msg.endpoints)
     elif isinstance(msg, T.LeaveMessage):
         req.leaveMessage.sender.CopyFrom(_ep(msg.sender))
+    elif isinstance(msg, T.ClusterStatusRequest):
+        req.clusterStatusRequest.sender.CopyFrom(_ep(msg.sender))
     else:
         raise TypeError(f"not a request type: {type(msg).__name__}")
+    ctx = trace_context_of(msg)
+    if ctx is not None:
+        tc = req.traceCtx
+        tc.traceId = ctx.trace_id
+        tc.parentSpanId = ctx.parent_span_id
+        tc.origin = ctx.origin
+        tc.flags = ctx.flags
     return req
 
 
 def from_wire_request(req) -> T.RapidMessage:
+    msg = _from_wire_request_content(req)
+    if req.HasField("traceCtx"):
+        tc = req.traceCtx
+        stamp_trace_context(msg, TraceContext(
+            trace_id=int(tc.traceId),
+            parent_span_id=int(tc.parentSpanId),
+            origin=str(tc.origin),
+            flags=int(tc.flags),
+        ))
+    return msg
+
+
+def _from_wire_request_content(req) -> T.RapidMessage:
     which = req.WhichOneof("content")
     if which == "preJoinMessage":
         m = req.preJoinMessage
@@ -212,6 +235,10 @@ def from_wire_request(req) -> T.RapidMessage:
         )
     if which == "leaveMessage":
         return T.LeaveMessage(sender=_ep_back(req.leaveMessage.sender))
+    if which == "clusterStatusRequest":
+        return T.ClusterStatusRequest(
+            sender=_ep_back(req.clusterStatusRequest.sender)
+        )
     raise ValueError(f"empty RapidRequest envelope: {which}")
 
 
@@ -231,6 +258,20 @@ def to_wire_response(msg) :
         resp.probeResponse.status = int(msg.status)
     elif isinstance(msg, T.ConsensusResponse):
         resp.consensusResponse.SetInParent()
+    elif isinstance(msg, T.ClusterStatusResponse):
+        s = resp.clusterStatusResponse
+        s.sender.CopyFrom(_ep(msg.sender))
+        s.configurationId = msg.configuration_id
+        s.membershipSize = msg.membership_size
+        s.reportsTracked = msg.reports_tracked
+        s.preProposalSize = msg.pre_proposal_size
+        s.proposalSize = msg.proposal_size
+        s.updatesInProgress = msg.updates_in_progress
+        s.consensusDecided = int(msg.consensus_decided)
+        s.consensusVotes = msg.consensus_votes
+        s.metricNames.extend(msg.metric_names)
+        s.metricValues.extend(msg.metric_values)
+        s.journal.extend(msg.journal)
     else:  # Response / None -> empty ack
         resp.response.SetInParent()
     return resp
@@ -255,6 +296,22 @@ def from_wire_response(resp):
         return T.ProbeResponse(T.NodeStatus(resp.probeResponse.status))
     if which == "consensusResponse":
         return T.ConsensusResponse()
+    if which == "clusterStatusResponse":
+        m = resp.clusterStatusResponse
+        return T.ClusterStatusResponse(
+            sender=_ep_back(m.sender),
+            configuration_id=int(m.configurationId),
+            membership_size=int(m.membershipSize),
+            reports_tracked=int(m.reportsTracked),
+            pre_proposal_size=int(m.preProposalSize),
+            proposal_size=int(m.proposalSize),
+            updates_in_progress=int(m.updatesInProgress),
+            consensus_decided=bool(m.consensusDecided),
+            consensus_votes=int(m.consensusVotes),
+            metric_names=tuple(m.metricNames),
+            metric_values=tuple(int(v) for v in m.metricValues),
+            journal=tuple(m.journal),
+        )
     return T.Response()
 
 

@@ -9,6 +9,11 @@ from __future__ import annotations
 import random
 from typing import List, Optional
 
+from ..observability import (
+    current_trace_context,
+    stamp_trace_context,
+    trace_context_of,
+)
 from ..runtime.futures import Promise
 from ..types import Endpoint, RapidMessage
 from .base import IBroadcaster, IMessagingClient
@@ -21,6 +26,12 @@ class UnicastToAllBroadcaster(IBroadcaster):
         self._rng = rng if rng is not None else random.Random()
 
     def broadcast(self, msg: RapidMessage) -> List[Promise]:
+        # trace injection at the send seam: keep an explicit stamp (the
+        # service's churn context), else inherit the ambient span (e.g. a
+        # consensus vote broadcast from inside an alert_batch span). One
+        # stamp serves every recipient -- the same object fans out.
+        if trace_context_of(msg) is None:
+            stamp_trace_context(msg, current_trace_context())
         return [
             self._client.send_message_best_effort(recipient, msg)
             for recipient in self._recipients

@@ -98,7 +98,36 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
     "LeaveMessage": [("sender", "M:Endpoint", 1, False)],
     "ProbeMessage": [("sender", "M:Endpoint", 1, False), ("payload", "bytes", 3, True)],
     "ProbeResponse": [("status", "E:NodeStatus", 1, False)],
+    # rapid-tpu extensions (not in the reference rapid.proto). Proto3 peers
+    # that predate them ignore unknown fields/oneof entries natively, so the
+    # schema stays wire-compatible in both directions.
+    "TraceContext": [
+        ("traceId", "int64", 1, False),
+        ("parentSpanId", "int64", 2, False),
+        ("origin", "string", 3, False),
+        ("flags", "int32", 4, False),
+    ],
+    "ClusterStatusRequest": [("sender", "M:Endpoint", 1, False)],
+    "ClusterStatusResponse": [
+        ("sender", "M:Endpoint", 1, False),
+        ("configurationId", "int64", 2, False),
+        ("membershipSize", "int32", 3, False),
+        ("reportsTracked", "int32", 4, False),
+        ("preProposalSize", "int32", 5, False),
+        ("proposalSize", "int32", 6, False),
+        ("updatesInProgress", "int32", 7, False),
+        ("consensusDecided", "int32", 8, False),
+        ("consensusVotes", "int32", 9, False),
+        ("metricNames", "string", 10, True),
+        ("metricValues", "int64", 11, True),
+        ("journal", "string", 12, True),
+    ],
 }
+
+# Trace context rides OUTSIDE the request oneof (a sibling of `content`):
+# field 15 on RapidRequest, chosen above the reference's last oneof number
+# so a JVM peer's decoder skips it as an unknown field.
+TRACE_CTX_FIELD_NUMBER = 15
 
 # The oneof envelopes (rapid.proto:21-45): (field, message type, number)
 _REQUEST_ONEOF = [
@@ -112,12 +141,14 @@ _REQUEST_ONEOF = [
     ("phase2aMessage", "Phase2aMessage", 8),
     ("phase2bMessage", "Phase2bMessage", 9),
     ("leaveMessage", "LeaveMessage", 10),
+    ("clusterStatusRequest", "ClusterStatusRequest", 11),
 ]
 _RESPONSE_ONEOF = [
     ("joinResponse", "JoinResponse", 1),
     ("response", "Response", 2),
     ("consensusResponse", "ConsensusResponse", 3),
     ("probeResponse", "ProbeResponse", 4),
+    ("clusterStatusResponse", "ClusterStatusResponse", 5),
 ]
 
 _ENUMS = {
@@ -203,6 +234,10 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         oneof.name = "content"
         for name, type_name, number in entries:
             msg.field.append(_field(name, f"M:{type_name}", number, False, oneof_index=0))
+        if envelope_name == "RapidRequest":
+            msg.field.append(_field(
+                "traceCtx", "M:TraceContext", TRACE_CTX_FIELD_NUMBER, False,
+            ))
 
     service = file_proto.service.add()
     service.name = SERVICE

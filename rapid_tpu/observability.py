@@ -33,6 +33,7 @@ ARCHITECTURE.md's "Telemetry plane" section.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import contextvars
 import itertools
@@ -103,6 +104,36 @@ METRIC_CATALOG = frozenset({
 # Dynamic name families: an f-string call site is legal iff its literal head
 # starts with one of these prefixes (e.g. ``f"messages.{type_name}"``).
 METRIC_PREFIXES = ("messages.",)
+
+# Span names: every Tracer.span/begin/remote_span call site in rapid_tpu/
+# must use one of these (tools/check.py lints literal first arguments, same
+# discipline as METRIC_CATALOG).
+SPAN_CATALOG = frozenset({
+    "alert_batch",       # service.py: handling one BatchedAlertMessage
+    "view_change",       # service.py + sim/driver.py: installing a view
+    "device_rounds",     # sim/driver.py: a batch of device-dispatched rounds
+})
+
+# Instant-event and flight-recorder kinds: every Tracer.event and
+# FlightRecorder.record call site must use one of these.
+EVENT_CATALOG = frozenset({
+    # tracer instants
+    "fd_signal",         # edge failure detector fired
+    "alert_enqueued",    # alert queued for the next batch flush
+    "proposal",          # cut detector emitted a proposal
+    "cut_detected",      # H-th report crossed the watermark
+    "fast_decision",     # Fast Paxos decided without a classic round
+    "classic_decision",  # classic Paxos learner reached a majority
+    # flight-recorder journal kinds (membership-relevant happenings)
+    "alert_in",          # batched alerts received
+    "alert_out",         # batched alerts flushed to the broadcaster
+    "decision",          # consensus handed the service a proposal
+    "view_install",      # view change applied
+    "view_refused",      # view change refused (missing identity), parked
+    "join_exhausted",    # a join burned all RETRIES attempts
+    "kicked",            # this node was removed from the ring
+    "status_served",     # answered a ClusterStatusRequest
+})
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).
 DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
@@ -446,6 +477,70 @@ def _next_span_id() -> int:
         return next(_SPAN_IDS)
 
 
+@dataclass(frozen=True)
+class TraceContext:
+    """The cross-node trace coordinates a message carries on the wire.
+
+    ``trace_id`` names the whole causal chain (minted by the root span on
+    the node that detected the churn); ``parent_span_id`` is the sending
+    side's span, so the receiving side's ``remote_span`` parents under it;
+    ``origin`` is the sender's track (its address) for display; ``flags``
+    is reserved (0 today -- a sampling bit later). Serialized as a compact
+    4-list (msgpack ``__tc`` key / proto ``traceCtx`` message)."""
+
+    trace_id: int
+    parent_span_id: int
+    origin: str = ""
+    flags: int = 0
+
+    def to_wire(self) -> List[object]:
+        return [self.trace_id, self.parent_span_id, self.origin, self.flags]
+
+    @classmethod
+    def from_wire(cls, raw: object) -> Optional["TraceContext"]:
+        try:
+            trace_id, parent_span_id, origin, flags = raw  # type: ignore[misc]
+            return cls(int(trace_id), int(parent_span_id), str(origin),
+                       int(flags))
+        except (TypeError, ValueError):
+            return None  # malformed context never breaks message handling
+
+
+# Messages are frozen dataclasses; the trace context rides as a sidecar
+# attribute (object.__setattr__) so it stays invisible to dataclass fields,
+# equality, hashing, and the codec's field walk -- old peers simply never
+# see it.
+_TRACE_CTX_ATTR = "trace_ctx"
+
+
+def stamp_trace_context(msg: object, ctx: Optional[TraceContext]) -> object:
+    if ctx is not None:
+        try:
+            object.__setattr__(msg, _TRACE_CTX_ATTR, ctx)
+        except (AttributeError, TypeError):
+            pass  # slotted/immutable object: carriage degrades to none
+    return msg
+
+
+def trace_context_of(msg: object) -> Optional[TraceContext]:
+    ctx = getattr(msg, _TRACE_CTX_ATTR, None)
+    return ctx if isinstance(ctx, TraceContext) else None
+
+
+def current_trace_context(origin: str = "") -> Optional[TraceContext]:
+    """TraceContext for the ambient span (None outside any span): what a
+    send site stamps on an outgoing message unless it has an explicit
+    context of its own."""
+    cur = _CURRENT_SPAN.get()
+    if cur is None:
+        return None
+    return TraceContext(
+        trace_id=cur.trace_id or cur.span_id,
+        parent_span_id=cur.span_id,
+        origin=origin or cur.track,
+    )
+
+
 @dataclass
 class Span:
     name: str
@@ -458,6 +553,7 @@ class Span:
     parent_id: Optional[int] = None
     plane: str = "protocol"
     track: str = "main"
+    trace_id: int = 0
 
     @property
     def wall_ms(self) -> float:
@@ -530,15 +626,23 @@ class Tracer:
     def _new_span(self, name: str, virtual_ms: Optional[int],
                   attrs: Dict[str, object]) -> Span:
         parent = _CURRENT_SPAN.get()
+        span_id = _next_span_id()
         return Span(
             name=name,
             wall_start_s=time.perf_counter(),
             virtual_start_ms=virtual_ms,
             attrs=attrs,
-            span_id=_next_span_id(),
+            span_id=span_id,
             parent_id=parent.span_id if parent is not None else None,
             plane=self.plane,
             track=self.track,
+            # roots mint the trace id (their own span id: process-unique);
+            # children inherit, so one id names the whole causal chain
+            trace_id=(
+                (parent.trace_id or parent.span_id)
+                if parent is not None
+                else span_id
+            ),
         )
 
     def _append(self, s: Span) -> None:
@@ -581,6 +685,45 @@ class Tracer:
         s.virtual_end_ms = virtual_ms
         self._append(s)
         return s
+
+    # -- cross-node propagation ---------------------------------------------
+
+    def inject(self) -> Optional[TraceContext]:
+        """The context an outgoing message should carry: the ambient span's
+        coordinates with this tracer's track as the origin (None outside
+        any span -- unsolicited sends stay traceless)."""
+        return current_trace_context(origin=self.track)
+
+    @staticmethod
+    def extract(msg: object) -> Optional[TraceContext]:
+        """The context an incoming message carried (None if it had none or
+        the peer predates trace propagation)."""
+        return trace_context_of(msg)
+
+    @contextlib.contextmanager
+    def remote_span(self, name: str, ctx: Optional[TraceContext] = None,
+                    virtual_ms: Optional[int] = None,
+                    **attrs: object) -> Iterator[Span]:
+        """Like ``span`` but parented under a *remote* span: the receiving
+        half of a cross-node edge. With ``ctx=None`` this degrades to a
+        plain ``span`` (untraced peers cost nothing). The remote parent id
+        may not resolve locally -- ``span_tree`` re-roots such spans and
+        tools/tracecat.py stitches them back together by trace id, so a
+        duplicated or reordered message can at worst repeat an edge, never
+        corrupt parenting or accumulate state."""
+        if ctx is not None and ctx.origin:
+            attrs.setdefault("origin", ctx.origin)
+        s = self._new_span(name, virtual_ms, dict(attrs))
+        if ctx is not None:
+            s.parent_id = ctx.parent_span_id
+            s.trace_id = ctx.trace_id or s.trace_id
+        token = _CURRENT_SPAN.set(s)
+        try:
+            yield s
+        finally:
+            _CURRENT_SPAN.reset(token)
+            s.wall_end_s = time.perf_counter()
+            self._append(s)
 
     # -- reading ------------------------------------------------------------
 
@@ -694,6 +837,76 @@ class StableViewTimer:
             "time_to_stable_view_ms", installed - detect,
             buckets=STABLE_VIEW_BUCKETS_MS, plane=self._plane,
         )
+
+
+# --------------------------------------------------------------------------- #
+# Flight recorder
+# --------------------------------------------------------------------------- #
+
+DEFAULT_JOURNAL_CAPACITY = 256
+
+
+class FlightRecorder:
+    """Bounded journal of the last N membership-relevant events on one node.
+
+    A black box for post-mortems without a live scraper: each entry carries
+    a monotonic sequence number, the event kind (from ``EVENT_CATALOG``),
+    wall-clock seconds, the node's virtual/scheduler milliseconds, and a
+    small detail dict. The deque drops the oldest entry on overflow, so a
+    recorder can run forever. ``to_wire`` serializes the tail as JSON lines
+    (the form both the msgpack codec and the proto wire carry in
+    ``ClusterStatusResponse.journal``); ``dump`` writes the same lines to a
+    file on crash/exit."""
+
+    def __init__(self, capacity: int = DEFAULT_JOURNAL_CAPACITY,
+                 node: str = "",
+                 clock: Optional[Callable[[], int]] = None) -> None:
+        self.node = node
+        self._clock = clock
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._events: "collections.deque[Dict[str, object]]" = (
+            collections.deque(maxlen=max(1, capacity))
+        )
+
+    def record(self, kind: str, virtual_ms: Optional[int] = None,
+               **detail: object) -> Dict[str, object]:
+        if virtual_ms is None and self._clock is not None:
+            try:
+                virtual_ms = int(self._clock())
+            except Exception:  # noqa: BLE001 -- a dying clock never loses the event
+                virtual_ms = None
+        entry: Dict[str, object] = {
+            "seq": next(self._seq),
+            "kind": kind,
+            "wall_s": time.time(),
+            "virtual_ms": virtual_ms,
+            "node": self.node,
+            "detail": {str(k): v for k, v in detail.items()},
+        }
+        with self._lock:
+            self._events.append(entry)
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def tail(self, n: Optional[int] = None) -> List[Dict[str, object]]:
+        with self._lock:
+            events = list(self._events)
+        return events if n is None else events[-n:]
+
+    def to_wire(self, n: Optional[int] = None) -> Tuple[str, ...]:
+        return tuple(
+            json.dumps(entry, sort_keys=True, default=str)
+            for entry in self.tail(n)
+        )
+
+    def dump(self, path: str, n: Optional[int] = None) -> None:
+        with open(path, "w") as fh:
+            for line in self.to_wire(n):
+                fh.write(line + "\n")
 
 
 # --------------------------------------------------------------------------- #
@@ -834,6 +1047,8 @@ def chrome_trace(tracer: Optional[Tracer] = None) -> Dict[str, object]:
         args["span_id"] = s.span_id
         if s.parent_id is not None:
             args["parent_id"] = s.parent_id
+        if s.trace_id:
+            args["trace_id"] = s.trace_id
         ts = int(round((s.wall_start_s - t0) * 1e6))
         dur = max(int(round((s.wall_end_s - s.wall_start_s) * 1e6)), 1)
         events.append({

@@ -27,11 +27,15 @@ from .messaging.unicast import UnicastToAllBroadcaster
 from .metadata import FrozenMetadata, MetadataManager
 from .monitoring.base import IEdgeFailureDetectorFactory
 from .observability import (
+    FlightRecorder,
     Metrics,
     StableViewTimer,
+    TraceContext,
     Tracer,
     global_metrics,
     global_tracer,
+    stamp_trace_context,
+    trace_context_of,
 )
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
@@ -41,6 +45,8 @@ from .types import (
     AlertMessage,
     BatchedAlertMessage,
     CONSENSUS_MESSAGE_TYPES,
+    ClusterStatusRequest,
+    ClusterStatusResponse,
     ConsensusResponse,
     EdgeStatus,
     Endpoint,
@@ -86,6 +92,7 @@ class MembershipService:
         broadcaster: Optional[IBroadcaster] = None,
         metrics: Optional[Metrics] = None,
         tracer: Optional[Tracer] = None,
+        recorder: Optional[FlightRecorder] = None,
     ) -> None:
         self._my_addr = my_addr
         self._cut_detection = cut_detector
@@ -131,6 +138,20 @@ class MembershipService:
         self._stable_view = StableViewTimer(
             self.metrics, "protocol", clock=self._scheduler.now_ms
         )
+        # bounded black-box journal of membership-relevant events, served
+        # via the status RPC and dumpable on crash/exit
+        self.recorder = (
+            recorder
+            if recorder is not None
+            else FlightRecorder(node=str(my_addr), clock=self._scheduler.now_ms)
+        )
+        # the trace context of the churn this node is currently working on:
+        # minted by the local fd_signal root or adopted from the first
+        # traced alert/vote, carried onto outgoing alerts and the eventual
+        # view_change span, cleared when the view installs. One Optional --
+        # duplicated or reordered deliveries re-adopt idempotently (same
+        # trace id) and can never grow state.
+        self._churn_ctx: Optional[TraceContext] = None
         self._cut_detection.bind_telemetry(self.metrics, self.tracer)
         self._joiners_to_respond_to: Dict[Endpoint, List[Promise]] = {}
         self._joiner_uuid: Dict[Endpoint, NodeId] = {}
@@ -190,9 +211,45 @@ class MembershipService:
                 msg.sender, self._view.get_current_configuration_id()
             )
             return Promise.completed(Response())
+        if isinstance(msg, ClusterStatusRequest):
+            return self._handle_cluster_status(msg)
         if isinstance(msg, GossipEnvelope):
             return self._handle_gossip(msg)
         raise TypeError(f"unidentified request type {type(msg).__name__}")
+
+    def _handle_cluster_status(self, msg: ClusterStatusRequest) -> Promise:
+        """Introspection RPC: snapshot protocol state on the protocol
+        executor (the one thread that mutates it), so the answer is a
+        consistent cut even while consensus is in flight."""
+        future: Promise = Promise()
+
+        def task() -> None:
+            self.recorder.record("status_served", requester=str(msg.sender))
+            future.set_result(self.cluster_status())
+
+        self._resources.protocol_executor.execute(task)
+        return future
+
+    def cluster_status(self) -> ClusterStatusResponse:
+        """The local introspection snapshot (also reachable without the RPC:
+        Cluster.get_cluster_status). Only call on the protocol executor or
+        from a quiesced cluster."""
+        occupancy = self._cut_detection.occupancy()
+        digest = sorted(self.metrics.snapshot().items())
+        return ClusterStatusResponse(
+            sender=self._my_addr,
+            configuration_id=self._view.get_current_configuration_id(),
+            membership_size=self._view.membership_size,
+            reports_tracked=occupancy["reports_tracked"],
+            pre_proposal_size=occupancy["pre_proposal_size"],
+            proposal_size=occupancy["proposal_size"],
+            updates_in_progress=occupancy["updates_in_progress"],
+            consensus_decided=self._fast_paxos.decided,
+            consensus_votes=self._fast_paxos.votes_received,
+            metric_names=tuple(name for name, _ in digest),
+            metric_values=tuple(value for _, value in digest),
+            journal=self.recorder.to_wire(32),
+        )
 
     def _handle_gossip(self, env: GossipEnvelope) -> Promise:
         """Epidemic relay plane: hand the envelope to a gossip-aware
@@ -301,10 +358,31 @@ class MembershipService:
 
     def _handle_batched_alerts(self, batch: BatchedAlertMessage) -> Promise:
         future: Promise = Promise()
+        ctx = trace_context_of(batch)
 
         def task() -> None:
-            with self.tracer.span(
-                "alert_batch", virtual_ms=self._scheduler.now_ms(),
+            if (
+                ctx is not None
+                and self._churn_ctx is None
+                and any(
+                    m.configuration_id
+                    == self._view.get_current_configuration_id()
+                    for m in batch.messages
+                )
+            ):
+                # adopt the sender's churn trace so this node's own alerts,
+                # votes, and eventual view_change carry the same trace id.
+                # Idempotent under nemesis duplication/reordering, and gated
+                # on a current-configuration alert so a stale duplicate
+                # delivered AFTER the install cannot re-arm a completed
+                # trace onto the next churn.
+                self._churn_ctx = ctx
+            self.recorder.record(
+                "alert_in", sender=str(batch.sender),
+                alerts=len(batch.messages),
+            )
+            with self.tracer.remote_span(
+                "alert_batch", ctx=ctx, virtual_ms=self._scheduler.now_ms(),
                 alerts=len(batch.messages),
             ):
                 self._handle_batched_alerts_task(batch)
@@ -354,6 +432,10 @@ class MembershipService:
                 size=len(proposal),
                 configuration_id=current_configuration_id,
             )
+            self.recorder.record(
+                "proposal", size=len(proposal),
+                configuration_id=current_configuration_id,
+            )
             changes = self._node_status_changes(proposal)
             self._fire(
                 ClusterEvents.VIEW_CHANGE_PROPOSAL, current_configuration_id, changes
@@ -394,11 +476,32 @@ class MembershipService:
             self._joiner_metadata[alert.edge_dst] = alert.metadata
         return alert
 
+    def _adopt_churn_ctx(self, msg: RapidMessage) -> None:
+        """Adopt an incoming message's trace context as this node's churn
+        trace if it has none yet (a node can learn of churn from a quorum of
+        votes before -- or instead of -- any alert). Messages from another
+        configuration never adopt: a reordered or duplicated vote surfacing
+        after the install must not tag the next churn with a finished
+        trace."""
+        if self._churn_ctx is None:
+            config = getattr(
+                msg, "configuration_id",
+                self._view.get_current_configuration_id(),
+            )
+            if config != self._view.get_current_configuration_id():
+                return
+            ctx = trace_context_of(msg)
+            if ctx is not None:
+                self._churn_ctx = ctx
+
     def _handle_consensus(self, msg: RapidMessage) -> Promise:
         future: Promise = Promise()
-        self._resources.protocol_executor.execute(
-            lambda: future.set_result(self._fast_paxos.handle_messages(msg))
-        )
+
+        def task() -> None:
+            self._adopt_churn_ctx(msg)
+            future.set_result(self._fast_paxos.handle_messages(msg))
+
+        self._resources.protocol_executor.execute(task)
         return future
 
     def _handle_vote_batch(self, batch: FastRoundVoteBatch) -> Promise:
@@ -408,6 +511,7 @@ class MembershipService:
         future: Promise = Promise()
 
         def task() -> None:
+            self._adopt_churn_ctx(batch)
             for sender in batch.senders:
                 self._fast_paxos.handle_messages(
                     FastRoundPhase2bMessage(
@@ -426,8 +530,13 @@ class MembershipService:
     # ------------------------------------------------------------------ #
 
     def _decide_view_change(self, proposal: List[Endpoint]) -> None:
-        with self.tracer.span(
-            "view_change", virtual_ms=self._scheduler.now_ms(),
+        self.recorder.record("decision", size=len(proposal))
+        # the view_change span joins the churn's cross-node trace: same
+        # trace id as the fd_signal on whichever node detected the failure
+        # (ctx=None -- untraced churn -- degrades to a local root span)
+        with self.tracer.remote_span(
+            "view_change", ctx=self._churn_ctx,
+            virtual_ms=self._scheduler.now_ms(),
             size=len(proposal),
         ):
             self._decide_view_change_locked(proposal)
@@ -451,6 +560,9 @@ class MembershipService:
         ]
         if missing:
             self.metrics.incr("view_changes_refused_missing_identity")
+            self.recorder.record(
+                "view_refused", missing=[str(node) for node in missing],
+            )
             LOG.error(
                 "%s: refusing view change at config %d: no joiner identity "
                 "for %s (UP alerts lost); parked until the alerts land, "
@@ -486,11 +598,16 @@ class MembershipService:
 
         configuration_id = self._view.get_current_configuration_id()
         self.metrics.incr("view_changes")
+        self.recorder.record(
+            "view_install", configuration_id=configuration_id,
+            size=self._view.membership_size,
+        )
         self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, status_changes)
         self._stable_view.view_installed()
 
         self._cut_detection.clear()
         self._announced_proposal = False
+        self._churn_ctx = None  # this churn's trace is complete
         self._fast_paxos = self._new_fast_paxos()
         self._broadcaster.set_membership(self._view.get_ring(0))
 
@@ -498,6 +615,7 @@ class MembershipService:
             self._create_failure_detectors()
         else:
             # We were removed: gracefully self-evict.
+            self.recorder.record("kicked", configuration_id=configuration_id)
             self._fire(ClusterEvents.KICKED, configuration_id, status_changes)
 
         self._respond_to_joiners(proposal)
@@ -547,10 +665,20 @@ class MembershipService:
             if not self._view.is_host_present(subject):
                 return
             self.metrics.incr("fd.edge_failures")
-            self.tracer.event(
+            signal = self.tracer.event(
                 "fd_signal", virtual_ms=self._scheduler.now_ms(),
                 subject=str(subject),
             )
+            self.recorder.record("fd_signal", subject=str(subject))
+            if self._churn_ctx is None:
+                # this node detected the churn: its fd_signal roots the
+                # cross-node trace every downstream alert/vote/view_change
+                # will carry
+                self._churn_ctx = TraceContext(
+                    trace_id=signal.trace_id or signal.span_id,
+                    parent_span_id=signal.span_id,
+                    origin=str(self._my_addr),
+                )
             self._stable_view.detection()
             alert = AlertMessage(
                 edge_src=self._my_addr,
@@ -595,6 +723,7 @@ class MembershipService:
             "alert_enqueued", virtual_ms=self._last_enqueue_ms,
             dst=str(msg.edge_dst), status=msg.edge_status.name,
         )
+        stamp_trace_context(msg, self._churn_ctx)
         self._alert_send_queue.append(msg)
 
     def _alert_batcher_tick(self) -> None:
@@ -609,9 +738,19 @@ class MembershipService:
             return
         messages = tuple(self._alert_send_queue)
         self._alert_send_queue.clear()
-        self._broadcaster.broadcast(
-            BatchedAlertMessage(sender=self._my_addr, messages=messages)
-        )
+        batch = BatchedAlertMessage(sender=self._my_addr, messages=messages)
+        # the flush runs on a timer tick with no ambient span, so the batch
+        # carries the churn trace explicitly (falling back to whatever the
+        # first traced alert carried)
+        ctx = self._churn_ctx
+        if ctx is None:
+            ctx = next(
+                (c for c in map(trace_context_of, messages) if c is not None),
+                None,
+            )
+        stamp_trace_context(batch, ctx)
+        self.recorder.record("alert_out", alerts=len(messages))
+        self._broadcaster.broadcast(batch)
 
     # ------------------------------------------------------------------ #
     # Public surface

@@ -28,8 +28,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import (
+    FlightRecorder,
     Metrics,
     StableViewTimer,
+    TraceContext,
     Tracer,
     global_metrics,
     global_tracer,
@@ -190,6 +192,15 @@ class Simulator:
         self._stable_view = StableViewTimer(
             self.metrics, "sim", clock=lambda: self.virtual_ms
         )
+        # cross-plane trace parity: the first fault injection of a churn
+        # episode mints a trace context (the sim's fd_signal equivalent);
+        # the view_change span adopts it as a remote-span edge, exactly as a
+        # real member's view_change parents onto the detecting node's
+        # fd_signal. Cleared when the view installs.
+        self._churn_ctx: Optional[TraceContext] = None
+        self.recorder = FlightRecorder(
+            node="sim", clock=lambda: self.virtual_ms
+        )
         # fault plane
         self._ingress_partitioned: Set[int] = set()
         self._drop_prob = np.zeros(capacity, dtype=np.float32)
@@ -303,9 +314,24 @@ class Simulator:
     # Fault injection (BASELINE.json configs)
     # ------------------------------------------------------------------ #
 
+    def _fd_signal(self, **attrs: object) -> None:
+        """Root of a churn episode's trace on the sim plane: mirrors the
+        protocol plane's edge-FD signal so merged traces show one trace_id
+        from injection to view install regardless of plane."""
+        signal = self.tracer.event("fd_signal", virtual_ms=self.virtual_ms,
+                                   **attrs)
+        self.recorder.record("fd_signal", **attrs)
+        if self._churn_ctx is None:
+            self._churn_ctx = TraceContext(
+                trace_id=signal.trace_id or signal.span_id,
+                parent_span_id=signal.span_id,
+                origin="sim",
+            )
+
     def crash(self, node_ids: np.ndarray) -> None:
         """Crash-stop burst: nodes stop responding to probes and stop voting."""
         self._stable_view.detection()
+        self._fd_signal(cause="crash", nodes=len(np.atleast_1d(node_ids)))
         self.alive[np.atleast_1d(node_ids)] = False
         # enqueue the liveness transfer now (async) so the decision loop's
         # dispatch never waits on a host->device round trip for it
@@ -326,6 +352,7 @@ class Simulator:
         Leavers keep responding to probes until the view change removes them
         (a leaving process shuts down only after its notification round)."""
         self._stable_view.detection()
+        self._fd_signal(cause="leave", nodes=len(np.atleast_1d(node_ids)))
         for node in np.atleast_1d(node_ids):
             node = int(node)
             assert self.active[node], f"node {node} is not a member"
@@ -341,6 +368,7 @@ class Simulator:
         enter the simulated cut detector's report table. One-shot per
         configuration, like any other alert."""
         self._stable_view.detection()
+        self._fd_signal(cause="injected_report", dst=int(dst))
         self._injected_down[dst, list(rings)] = True
         self._down_reports_dev = None
 
@@ -989,6 +1017,14 @@ class Simulator:
         vc_span = self.tracer.begin(
             "view_change", virtual_ms=self.virtual_ms
         )
+        if self._churn_ctx is not None:
+            # remote-span edge: parent the install under the churn episode's
+            # root so the merged Chrome trace stitches injection -> install
+            vc_span.parent_id = self._churn_ctx.parent_span_id
+            vc_span.trace_id = (
+                self._churn_ctx.trace_id or vc_span.trace_id
+            )
+            vc_span.attrs.setdefault("origin", self._churn_ctx.origin)
         self._config_id = None  # membership / identifier history change below
         proposal_np, decided_group, decided_round = fetched
         # the winning proposal row's value is the decided cut
@@ -1077,6 +1113,12 @@ class Simulator:
             configuration_id=record.configuration_id,
         )
         self.tracer.end(vc_span, virtual_ms=self.virtual_ms)
+        self.recorder.record(
+            "view_install",
+            configuration_id=record.configuration_id,
+            size=record.membership_size,
+        )
+        self._churn_ctx = None  # next churn episode roots a fresh trace
         return record
 
     # ------------------------------------------------------------------ #

@@ -285,6 +285,45 @@ class GossipEnvelope:
     kind: int = 0
 
 
+@dataclass(frozen=True)
+class ClusterStatusRequest:
+    """Introspection RPC: ask any member for its view of the cluster.
+
+    Not in rapid.proto's reference surface -- an extension message carried
+    by every transport (the proto schema grows matching messages in
+    messaging/wire_schema.py). Answered synchronously from protocol state,
+    so it works while consensus is in flight and through the nemesis."""
+
+    sender: Endpoint
+
+
+@dataclass(frozen=True)
+class ClusterStatusResponse:
+    """One member's introspection snapshot.
+
+    Cut-detector occupancy mirrors the K/H/L watermark machinery:
+    ``reports_tracked`` = subjects with at least one report,
+    ``pre_proposal_size`` = subjects past L but below H, ``proposal_size``
+    = subjects past H awaiting a stable cut, ``updates_in_progress`` =
+    subjects between the watermarks blocking the cut. ``metric_names`` /
+    ``metric_values`` are a parallel-array counter digest (flat rendered
+    names, see Metrics.snapshot); ``journal`` is the flight recorder's tail
+    as JSON lines."""
+
+    sender: Endpoint
+    configuration_id: int
+    membership_size: int
+    reports_tracked: int = 0
+    pre_proposal_size: int = 0
+    proposal_size: int = 0
+    updates_in_progress: int = 0
+    consensus_decided: bool = False
+    consensus_votes: int = 0
+    metric_names: Tuple[str, ...] = ()
+    metric_values: Tuple[int, ...] = ()
+    journal: Tuple[str, ...] = ()
+
+
 # Any protocol request/response, for type annotations.
 RapidMessage = object
 

@@ -547,3 +547,94 @@ def test_one_fault_plan_three_planes_identical_decisions():
     assert len(records) == 1
     assert [int(s) for s in records[0].cut] == [n - 1]
     assert records[0].configuration_id == ip_config == tcp_config
+
+
+# ---------------------------------------------------------------------------
+# Trace propagation under faults: duplication, reordering, and one-way drops
+# must not corrupt span parenting or leak per-churn state
+# ---------------------------------------------------------------------------
+
+
+def _assert_churn_trace_hygiene(harness):
+    """After a converged churn: every member closed its episode (the one
+    Optional of per-churn state is None) and cross-node span parenting is
+    intact -- every traced alert_batch receive resolves to a REAL fd_signal
+    mint somewhere in the cluster, with the consistent (parent, trace) pair.
+    A duplicated or reordered delivery can at worst repeat such an edge;
+    it can never invent or rewrite one. (With simultaneous detection each
+    survivor mints its own root, so several trace ids per node is the
+    CORRECT shape here, not a fork.)"""
+    services = [
+        inst._membership_service for inst in harness.instances.values()
+    ]
+    minted = {}  # fd_signal span id -> the trace id that mint roots
+    for svc in services:
+        for s in svc.tracer.spans:
+            if s.name == "fd_signal":
+                minted[s.span_id] = s.trace_id or s.span_id
+    assert minted, "no member recorded an fd_signal for the churn"
+    for svc in services:
+        assert svc._churn_ctx is None  # no per-churn state survives install
+        assert any(
+            s.name == "view_change" and s.trace_id in set(minted.values())
+            for s in svc.tracer.spans
+        ), "a member's view_change did not join any minted churn trace"
+        for s in svc.tracer.spans:
+            # only spans that carried a REMOTE context (remote_span sets the
+            # origin attr from it); an untraced batch degrades to a local
+            # root span, which is not a cross-node edge
+            if s.name == "alert_batch" and "origin" in s.attrs:
+                assert s.parent_id in minted, (
+                    f"alert_batch parents under unknown span {s.parent_id}"
+                )
+                assert s.trace_id == minted[s.parent_id], (
+                    "alert_batch trace/parent pair was rewritten in flight"
+                )
+
+
+def test_trace_propagation_survives_duplication_and_reorder():
+    from rapid_tpu.observability import DEFAULT_JOURNAL_CAPACITY
+
+    plan = FaultPlan(seed=9).duplicate(0.3).reorder(0.3, max_extra_ms=50)
+    harness = ClusterHarness(seed=9).with_faults(plan)
+    try:
+        harness.create_cluster(5)
+        harness.wait_and_verify_agreement(5)
+        harness.fail_nodes([harness.addr(4)])
+        harness.wait_and_verify_agreement(4, timeout_ms=1_200_000)
+        _assert_churn_trace_hygiene(harness)
+        for instance in harness.instances.values():
+            svc = instance._membership_service
+            # duplicated deliveries never grow unbounded observability
+            # state: the journal stays within its ring capacity
+            assert len(svc.recorder) <= DEFAULT_JOURNAL_CAPACITY
+    finally:
+        harness.shutdown()
+
+
+def test_trace_propagation_survives_one_way_drops():
+    """One-way loss of alert dissemination between two survivors: every
+    batch node 1 sends node 2 is dropped, so node 2 learns of the churn
+    from the other members' (traced) batches and votes -- cross-node
+    parenting must still resolve and the episode must still close
+    everywhere. The plan is armed only after bootstrap (far-future epoch
+    during joins, the TCP parity test's pattern), because losing UP alerts
+    would starve joiner identities rather than exercise tracing."""
+    from rapid_tpu.types import BatchedAlertMessage
+
+    harness = ClusterHarness(seed=13)
+    plan = FaultPlan(seed=13).drop(
+        1.0, src=harness.addr(1), dst=harness.addr(2),
+        msg_types=(BatchedAlertMessage,),
+    )
+    harness.with_faults(plan)
+    harness.nemesis.arm(epoch_ms=1 << 40)  # hold fire during bootstrap
+    try:
+        harness.create_cluster(6)
+        harness.wait_and_verify_agreement(6)
+        harness.nemesis.arm()  # the one-way drop starts now
+        harness.fail_nodes([harness.addr(5)])
+        harness.wait_and_verify_agreement(5, timeout_ms=1_200_000)
+        _assert_churn_trace_hygiene(harness)
+    finally:
+        harness.shutdown()

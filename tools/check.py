@@ -33,20 +33,30 @@ PRINT_OK_ROOTS = ("examples", "experiments", "tools", "tests")
 PRINT_OK_FILES = {"bench.py", "scenarios.py", "__graft_entry__.py"}
 
 
-def _load_metric_catalog() -> "tuple[frozenset, tuple]":
-    """METRIC_CATALOG / METRIC_PREFIXES from rapid_tpu/observability.py,
-    loaded as a standalone module (observability.py is stdlib-only at module
-    level; importing the rapid_tpu package here would pull in jax)."""
+def _load_catalogs() -> "tuple[frozenset, tuple, frozenset, frozenset]":
+    """METRIC_CATALOG / METRIC_PREFIXES / SPAN_CATALOG / EVENT_CATALOG from
+    rapid_tpu/observability.py, loaded as a standalone module
+    (observability.py is stdlib-only at module level; importing the
+    rapid_tpu package here would pull in jax)."""
     spec = importlib.util.spec_from_file_location(
         "_rapid_observability", REPO / "rapid_tpu" / "observability.py"
     )
     mod = importlib.util.module_from_spec(spec)
     sys.modules[spec.name] = mod  # dataclass processing resolves __module__
     spec.loader.exec_module(mod)  # type: ignore[union-attr]
-    return mod.METRIC_CATALOG, mod.METRIC_PREFIXES
+    return (mod.METRIC_CATALOG, mod.METRIC_PREFIXES, mod.SPAN_CATALOG,
+            mod.EVENT_CATALOG)
 
 
-METRIC_CATALOG, METRIC_PREFIXES = _load_metric_catalog()
+METRIC_CATALOG, METRIC_PREFIXES, SPAN_CATALOG, EVENT_CATALOG = _load_catalogs()
+
+# tracer/journal call sites whose literal first argument must come from the
+# matching catalog: .span/.begin/.remote_span mint spans (SPAN_CATALOG),
+# .event mints instants and .record journals flight-recorder entries
+# (EVENT_CATALOG). A typo'd name would silently fork a trace/journal series
+# exactly like a typo'd metric name.
+SPAN_METHODS = ("span", "begin", "remote_span")
+EVENT_METHODS = ("event", "record")
 
 
 class Finding:
@@ -222,7 +232,33 @@ class Checker(ast.NodeVisitor):
             and node.args
         ):
             self._check_metric_name(node, node.args[0])
+        if (
+            self.metric_names_checked
+            and isinstance(func, ast.Attribute)
+            and func.attr in SPAN_METHODS + EVENT_METHODS
+            and node.args
+        ):
+            self._check_span_name(node, func.attr, node.args[0])
         self.generic_visit(node)
+
+    def _check_span_name(self, call: ast.Call, method: str,
+                         arg: ast.expr) -> None:
+        """Literal span names must be in SPAN_CATALOG, literal event/journal
+        kinds in EVENT_CATALOG. Dynamic names are skipped, same policy as the
+        metric lint."""
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            return
+        catalog, label = (
+            (SPAN_CATALOG, "SPAN_CATALOG")
+            if method in SPAN_METHODS
+            else (EVENT_CATALOG, "EVENT_CATALOG")
+        )
+        if arg.value not in catalog:
+            self.report(
+                call, "unknown-span",
+                f"{method}() name {arg.value!r} not in "
+                f"observability.{label}",
+            )
 
     def _check_metric_name(self, call: ast.Call, arg: ast.expr) -> None:
         """Every .incr()/.observe() call site in library code must use a

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""rapid-top: poll the cluster-status introspection RPC of live agents.
+
+Sends a ``ClusterStatusRequest`` to one or more members over the framed-TCP
+transport and renders each answer: configuration id, view size, cut-detector
+watermark occupancy, consensus round state, a compact metrics digest, and the
+tail of the node's flight-recorder journal. Because the request is answered
+on the protocol executor, the numbers are a consistent snapshot of that
+node's protocol state, and disagreement in ``config`` across members is
+itself the finding.
+
+    python tools/statusz.py 127.0.0.1:1234 127.0.0.1:1235
+    python tools/statusz.py --json --journal 10 127.0.0.1:1234
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # runnable as a script from anywhere in the tree
+    sys.path.insert(0, _REPO)
+
+from rapid_tpu import Endpoint, Settings  # noqa: E402
+from rapid_tpu.messaging.tcp import TcpClientServer  # noqa: E402
+from rapid_tpu.types import ClusterStatusRequest, ClusterStatusResponse  # noqa: E402
+
+
+def fetch_status(
+    client: TcpClientServer, target: Endpoint, timeout_s: float = 5.0
+) -> ClusterStatusResponse:
+    reply = client.send_message(
+        target, ClusterStatusRequest(sender=client.address)
+    ).result(timeout_s)
+    if not isinstance(reply, ClusterStatusResponse):
+        raise RuntimeError(
+            f"{target}: unexpected reply {type(reply).__name__}"
+        )
+    return reply
+
+
+def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
+    lines = [
+        f"{status.sender}  config={status.configuration_id}"
+        f"  members={status.membership_size}",
+        f"  cut-detector: tracked={status.reports_tracked}"
+        f" pre-proposal={status.pre_proposal_size}"
+        f" proposal={status.proposal_size}"
+        f" in-progress={status.updates_in_progress}",
+        f"  consensus: decided={status.consensus_decided}"
+        f" votes={status.consensus_votes}",
+    ]
+    for name, value in zip(status.metric_names, status.metric_values):
+        lines.append(f"  metric {name} = {value}")
+    tail = status.journal[-journal_lines:] if journal_lines else ()
+    for raw in tail:
+        try:
+            entry = json.loads(raw)
+            lines.append(
+                "  journal [{seq}] {kind} @{virtual_ms}ms {detail}".format(
+                    seq=entry.get("seq"), kind=entry.get("kind"),
+                    virtual_ms=entry.get("virtual_ms"),
+                    detail=entry.get("detail", {}),
+                )
+            )
+        except (ValueError, TypeError):
+            lines.append(f"  journal {raw}")
+    return "\n".join(lines)
+
+
+def to_json(status: ClusterStatusResponse) -> dict:
+    return {
+        "node": str(status.sender),
+        "configuration_id": status.configuration_id,
+        "membership_size": status.membership_size,
+        "reports_tracked": status.reports_tracked,
+        "pre_proposal_size": status.pre_proposal_size,
+        "proposal_size": status.proposal_size,
+        "updates_in_progress": status.updates_in_progress,
+        "consensus_decided": status.consensus_decided,
+        "consensus_votes": status.consensus_votes,
+        "metrics": dict(zip(status.metric_names, status.metric_values)),
+        "journal": [json.loads(line) for line in status.journal],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="poll rapid-tpu agents' cluster-status RPC"
+    )
+    parser.add_argument("targets", nargs="+", help="host:port of live agents")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit one JSON object per target")
+    parser.add_argument("--journal", type=int, default=5,
+                        help="journal tail lines to show (text mode)")
+    args = parser.parse_args(argv)
+    # client half only: no start() means no listening socket is ever bound
+    client = TcpClientServer(Endpoint(b"127.0.0.1", 0), Settings())
+    rc = 0
+    configs = set()
+    try:
+        for raw in args.targets:
+            target = Endpoint.from_string(raw)
+            try:
+                status = fetch_status(client, target, args.timeout)
+            except Exception as exc:  # noqa: BLE001 -- report and keep polling
+                print(f"{raw}: unreachable ({exc})", file=sys.stderr)
+                rc = 1
+                continue
+            configs.add(status.configuration_id)
+            if args.as_json:
+                print(json.dumps(to_json(status), sort_keys=True))
+            else:
+                print(render(status, journal_lines=args.journal))
+    finally:
+        client.shutdown()
+    if len(configs) > 1:
+        print(
+            f"WARNING: members disagree on configuration id: {sorted(configs)}",
+            file=sys.stderr,
+        )
+        rc = max(rc, 2)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
