@@ -81,6 +81,21 @@ def _stable_view_hist() -> "dict | None":
         return None
 
 
+def _placement_hist() -> "dict | None":
+    """Partitions-moved-per-rebalance histogram from the sim plane's
+    placement updates (populated by the sweep sizes that enable placement).
+    None when placement never ran."""
+    try:
+        from rapid_tpu.observability import global_metrics
+
+        snap = global_metrics().histogram(
+            "placement.partitions_moved", plane="sim"
+        )
+        return snap if snap["count"] else None
+    except Exception:  # noqa: BLE001 -- telemetry must never sink the artifact
+        return None
+
+
 def _flag_value(flag: str) -> "str | None":
     """Tolerant --flag VALUE / --flag=VALUE scan. argparse would choke on
     pytest's argv when the contract tests call main() in-process."""
@@ -128,6 +143,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "backend": backend,
                 "sweep": merged,
                 "time_to_stable_view_ms": _stable_view_hist(),
+                "placement_partitions_moved": _placement_hist(),
             }
         ),
         flush=True,
@@ -238,12 +254,17 @@ def probe_backend() -> "str | None":
     return None
 
 
-def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
+def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION,
+               placement_partitions: int = 0):
     """The single definition of the warmed measurement (shared with
     experiments/scaling_sweep.py so the published sweep can never drift from
     the headline): compile on an identical-shape run, then time a fresh
     simulator from fault injection to the decided view, asserting cut-set
-    parity. Returns (wall_ms, record, build_s, warmup_wall_s)."""
+    parity. ``placement_partitions`` > 0 additionally enables the placement
+    plane on the timed simulator (full map built before the clock starts;
+    the timed window then includes the incremental in-view-change rebalance,
+    which is the cost a placement-running deployment actually pays).
+    Returns (wall_ms, record, build_s, warmup_wall_s)."""
     from rapid_tpu.sim.driver import Simulator
 
     rng = np.random.default_rng(seed)
@@ -261,6 +282,8 @@ def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
 
     sim2 = Simulator(n_nodes, seed=seed + 4444)
     sim2.ready()  # drain construction from the device queue
+    if placement_partitions:
+        sim2.enable_placement(partitions=placement_partitions)
     victims2 = rng.choice(n_nodes, size=n_fail, replace=False)
     sim2.crash(victims2)
     t0 = time.perf_counter()
@@ -270,6 +293,11 @@ def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION):
     assert record is not None, "no decision reached"
     assert set(record.cut) == set(victims2), "cut-set parity violated"
     assert record.membership_size == n_nodes - len(victims2)
+    if placement_partitions:
+        diffs = sim2.placement_diffs
+        assert diffs, "placement enabled but no rebalance happened"
+        # minimal motion: every moved partition lost a replica to the cut
+        assert all(d.moved <= placement_partitions for d in diffs)
     return wall_ms, record, build_s, warm_wall
 
 
@@ -279,16 +307,24 @@ def run_sweep(backend: str, seed: int) -> list:
     _PROGRESS["sweep"] as they complete so the watchdog can emit a partial
     curve."""
     sizes = [1_000, 10_000, 1_000_000] if backend == "tpu" else [1_000, 10_000]
+    # placement rides along on the small sizes only: it exercises the
+    # in-view-change rebalance (and feeds the partitions-moved histogram in
+    # the JSON line) without perturbing the headline-compatible big points
+    placement_sizes = {1_000, 10_000}
     out = _PROGRESS["sweep"] = []
     for n in sizes:
+        partitions = 1024 if n in placement_sizes else 0
         try:
-            wall_ms, record, _, _ = warmed_run(n, seed=seed)
+            wall_ms, record, _, _ = warmed_run(
+                n, seed=seed, placement_partitions=partitions
+            )
             out.append(
                 {
                     "n": n,
                     "warmed_wall_ms": round(wall_ms, 1),
                     "virtual_ms": record.virtual_time_ms,
                     "cut_ok": True,  # asserted inside warmed_run
+                    "placement_partitions": partitions,
                 }
             )
         except AssertionError:

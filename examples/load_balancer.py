@@ -33,7 +33,7 @@ import numpy as np  # noqa: E402
 
 from rapid_tpu import ClusterBuilder, Cluster, Endpoint, Settings  # noqa: E402
 from rapid_tpu.events import ClusterEvents, NodeStatusChange  # noqa: E402
-from rapid_tpu.hashing import xxh64  # noqa: E402
+from rapid_tpu.placement import rendezvous_route, weight_seed  # noqa: E402
 from rapid_tpu.messaging.gateway import (  # noqa: E402
     GatewayRoutedClient,
     GatewaySwarmBroadcaster,
@@ -48,10 +48,11 @@ class ViewChangeRouter:
     VIEW_CHANGE events (the reference app surface: Cluster.java:98-140's
     getters plus registerSubscription).
 
-    Rendezvous hashing: key k goes to argmax over backends b of
-    xxhash64(key_bytes, seed=hash(b)). Removing a backend only remaps the
-    keys that were on it -- the property that makes a single multi-node cut
-    a single rebalance."""
+    Rendezvous hashing via the placement plane's helpers
+    (rapid_tpu.placement.rendezvous_route): key k goes to the backend with
+    the highest seeded hash of k. Removing a backend only remaps the keys
+    that were on it -- the property that makes a single multi-node cut a
+    single rebalance."""
 
     def __init__(self, cluster: Cluster, self_address: Endpoint) -> None:
         self._self = self_address
@@ -70,10 +71,7 @@ class ViewChangeRouter:
         backends = [m for m in members if m != self._self]
         with self._lock:
             self._backends = backends
-            self._weight_seed = {
-                b: xxh64(b.hostname + b"#%d" % b.port, 0) & 0x7FFFFFFF
-                for b in backends
-            }
+            self._weight_seed = {b: weight_seed(b) for b in backends}
 
     def _on_view_change(self, config_id: int, changes) -> None:
         with self._lock:
@@ -98,10 +96,7 @@ class ViewChangeRouter:
         with self._lock:
             if not self._backends:
                 return None
-            return max(
-                self._backends,
-                key=lambda b: xxh64(key, self._weight_seed[b]),
-            )
+            return rendezvous_route(key, self._backends, self._weight_seed)
 
 
 def run_scenario(

@@ -14,6 +14,12 @@ from .cluster import Cluster, ClusterBuilder, JoinException, K, H, L
 from .events import ClusterEvents, NodeStatusChange
 from .membership import Configuration, MembershipView
 from .cut_detector import MultiNodeCutDetector
+from .placement.engine import (
+    PlacementConfig,
+    PlacementDiff,
+    PlacementMap,
+    PlacementSubscriber,
+)
 from .settings import Settings
 from .types import (
     EdgeStatus,
@@ -37,6 +43,10 @@ __all__ = [
     "NodeId",
     "NodeStatus",
     "NodeStatusChange",
+    "PlacementConfig",
+    "PlacementDiff",
+    "PlacementMap",
+    "PlacementSubscriber",
     "Settings",
     "K",
     "H",

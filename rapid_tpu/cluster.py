@@ -26,6 +26,7 @@ from .metadata import FrozenMetadata
 from .monitoring.base import IEdgeFailureDetectorFactory
 from .monitoring.pingpong import PingPongFailureDetectorFactory
 from .observability import FlightRecorder, Metrics, Tracer, global_metrics
+from .placement.engine import DEFAULT_WEIGHT_KEY, PlacementConfig
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
 from .runtime.scheduler import Scheduler
@@ -113,6 +114,19 @@ class Cluster:
     ) -> None:
         self._membership_service.register_subscription(event, callback)
 
+    def get_placement_map(self):
+        """The current deterministic shard map (placement/engine.py), or
+        None when the node was built without ``use_placement``. Identical
+        bytes-for-bytes on every member of a configuration."""
+        self._check_running()
+        return self._membership_service.placement_map()
+
+    def get_placement_diff(self):
+        """The rebalance plan from the most recent view change (None before
+        the first churn or without placement)."""
+        self._check_running()
+        return self._membership_service.placement_diff()
+
     def leave_gracefully_async(self) -> Promise:
         """Inform observers of the intent to leave, then shut down
         (Cluster.java:145-149)."""
@@ -160,6 +174,7 @@ class ClusterBuilder:
         self._broadcaster_factory = None
         self._metrics: Optional[Metrics] = None
         self._tracer: Optional[Tracer] = None
+        self._placement: Optional[PlacementConfig] = None
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -210,6 +225,24 @@ class ClusterBuilder:
         """Inject the span tracer for this node. Default: a per-node tracer
         attached to ``global_tracer()``."""
         self._tracer = tracer
+        return self
+
+    def use_placement(
+        self,
+        partitions: int = 256,
+        replicas: int = 3,
+        seed: int = 0,
+        weight_key: str = DEFAULT_WEIGHT_KEY,
+        default_weight: int = 1,
+    ) -> "ClusterBuilder":
+        """Enable the placement plane: a deterministic P-partition, R-replica
+        shard map recomputed locally at every view change (placement/). All
+        members must be built with identical parameters -- they are part of
+        the map function, like K/H/L are part of the protocol."""
+        self._placement = PlacementConfig(
+            partitions=partitions, replicas=replicas, seed=seed,
+            weight_key=weight_key, default_weight=default_weight,
+        )
         return self
 
     def set_broadcaster_factory(self, factory) -> "ClusterBuilder":
@@ -300,6 +333,7 @@ class ClusterBuilder:
                 node=str(self._listen_address),
                 clock=resources.scheduler.now_ms,
             ),
+            placement=self._placement,
         )
         server.set_membership_service(service)
         server.start()
@@ -437,6 +471,7 @@ class ClusterBuilder:
                 metrics=self._metrics,
                 tracer=self._tracer,
                 recorder=recorder,
+                placement=self._placement,
             )
             server.set_membership_service(service)
             result.set_result(

@@ -272,6 +272,9 @@ def to_wire_response(msg) :
         s.metricNames.extend(msg.metric_names)
         s.metricValues.extend(msg.metric_values)
         s.journal.extend(msg.journal)
+        s.placementVersion = msg.placement_version
+        s.placementPartitions = msg.placement_partitions
+        s.placementOwned = msg.placement_owned
     else:  # Response / None -> empty ack
         resp.response.SetInParent()
     return resp
@@ -311,6 +314,9 @@ def from_wire_response(resp):
             metric_names=tuple(m.metricNames),
             metric_values=tuple(int(v) for v in m.metricValues),
             journal=tuple(m.journal),
+            placement_version=int(m.placementVersion),
+            placement_partitions=int(m.placementPartitions),
+            placement_owned=int(m.placementOwned),
         )
     return T.Response()
 

@@ -121,6 +121,11 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("metricNames", "string", 10, True),
         ("metricValues", "int64", 11, True),
         ("journal", "string", 12, True),
+        # placement plane exposure; proto3 unknown-field tolerance keeps
+        # peers without placement interoperable
+        ("placementVersion", "int64", 13, False),
+        ("placementPartitions", "int32", 14, False),
+        ("placementOwned", "int32", 15, False),
     ],
 }
 

@@ -99,6 +99,11 @@ METRIC_CATALOG = frozenset({
     "latency.detection_to_decision_ms",
     "latency.decision_to_view_ms",
     "time_to_stable_view_ms",
+    # placement plane (placement/, service.py, sim/driver.py)
+    "placement.rebuilds",
+    "placement.partitions_moved",
+    "placement.imbalance",
+    "placement.partitions_owned",
 })
 
 # Dynamic name families: an f-string call site is legal iff its literal head
@@ -112,6 +117,7 @@ SPAN_CATALOG = frozenset({
     "alert_batch",       # service.py: handling one BatchedAlertMessage
     "view_change",       # service.py + sim/driver.py: installing a view
     "device_rounds",     # sim/driver.py: a batch of device-dispatched rounds
+    "placement_rebalance",  # placement map rebuilt after a view change
 })
 
 # Instant-event and flight-recorder kinds: every Tracer.event and
@@ -133,6 +139,7 @@ EVENT_CATALOG = frozenset({
     "join_exhausted",    # a join burned all RETRIES attempts
     "kicked",            # this node was removed from the ring
     "status_served",     # answered a ClusterStatusRequest
+    "placement_rebalance",  # placement map rebuilt (moved count + versions)
 })
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).
@@ -146,6 +153,13 @@ DEFAULT_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
 STABLE_VIEW_BUCKETS_MS: Tuple[float, ...] = (
     10, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 15000, 30000, 60000,
     120000,
+)
+
+# Partitions moved per rebalance (placement.partitions_moved): powers of two
+# up to the largest supported map so correlated-failure motion is directly
+# readable off the histogram on both planes.
+PARTITIONS_MOVED_BUCKETS: Tuple[float, ...] = (
+    0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
 )
 
 

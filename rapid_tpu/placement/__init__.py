@@ -1,0 +1,37 @@
+"""Placement plane: deterministic weighted shard maps over the membership.
+
+``engine`` is the object model (pure-Python, imported by the protocol
+plane); ``device`` is the vectorized/jitted mirror (imports numpy/jax --
+pulled in lazily by the sim plane only, so protocol-side users of this
+package stay light). See engine.py's module docstring for the scheme.
+"""
+
+from .engine import (
+    DEFAULT_WEIGHT_KEY,
+    MAX_WEIGHT,
+    PlacementConfig,
+    PlacementDiff,
+    PlacementEngine,
+    PlacementMap,
+    PlacementSubscriber,
+    build_map,
+    diff_maps,
+    rendezvous_route,
+    weight_of,
+    weight_seed,
+)
+
+__all__ = [
+    "DEFAULT_WEIGHT_KEY",
+    "MAX_WEIGHT",
+    "PlacementConfig",
+    "PlacementDiff",
+    "PlacementEngine",
+    "PlacementMap",
+    "PlacementSubscriber",
+    "build_map",
+    "diff_maps",
+    "rendezvous_route",
+    "weight_of",
+    "weight_seed",
+]

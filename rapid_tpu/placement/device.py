@@ -1,0 +1,393 @@
+"""Vectorized placement: the device-plane mirror of placement/engine.py.
+
+Same arithmetic as the object model, expressed three ways over a ``[P, C]``
+score matrix (P partitions x C candidate slots):
+
+- ``_score_matrix`` / ``topr_full``: chunked numpy over uint32 lanes -- the
+  host-side bulk path used for the one-time full build when placement is
+  enabled on a Simulator (100k x 8k is a few tens of seconds of one-time
+  work on a laptop core, amortized across the run).
+- ``DevicePlacement.apply_view_change``: the incremental path driven from the
+  sim plane's view changes. Removals only recompute the rows whose replica
+  set intersects the removed slots; additions only merge the new columns into
+  the stored top-R -- together exactly the minimal-motion set, so a churn
+  step over 100k nodes touches thousandths of the matrix instead of all of
+  it and stays well inside the bench wall-time budget.
+- ``build_jit``: the whole map as ONE jitted dispatch, row-sharded over a
+  device mesh with the same NamedSharding discipline as shard/engine.py
+  (partitions are embarrassingly parallel, so the mesh splits the P axis).
+
+Parity: assignments and the xxh64 map fingerprint are bit-identical with
+engine.build_map for the same (view, weights, seed) whenever the active set
+has at least R members -- pinned in tests/test_placement.py (including on an
+8-device mesh) and in the golden vectors.
+
+Ranking is by ``(score desc, slot index asc)``, encoded branch-free as a
+uint64 composite ``(score << 32) | (0xFFFFFFFF - slot)`` so numpy
+argpartition needs no tie-break pass; the jitted path gets the same order
+from argmax's first-maximum rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..hashing import endpoint_hash_batch, xxh64_batch_auto
+from .engine import GOLDEN64, MIX1, MIX2, PlacementConfig
+
+_U32 = np.uint32
+_U64 = np.uint64
+_REV = _U64(0xFFFFFFFF)
+
+__all__ = [
+    "DevicePlacement",
+    "DeviceDiff",
+    "build_jit",
+    "instance_keys32",
+    "node_keys64",
+    "partition_keys32",
+    "topr_full",
+]
+
+
+def _fold32(h: np.ndarray) -> np.ndarray:
+    """uint64[N] -> uint32[N]; mirrors engine.fold32."""
+    return ((h ^ (h >> _U64(32))) & _REV).astype(_U32)
+
+
+def partition_keys32(partitions: int, seed: int) -> np.ndarray:
+    """engine.partition_key32 for all P at once: batched xxh64 over the
+    8-LE-byte rows of the partition indices."""
+    idx = np.arange(partitions, dtype=np.int64)
+    data = (
+        (idx[:, None] >> (8 * np.arange(8, dtype=np.int64))[None, :]) & 0xFF
+    ).astype(np.uint8)
+    lengths = np.full(partitions, 8, dtype=np.int64)
+    return _fold32(xxh64_batch_auto(data, lengths, seed))
+
+
+def node_keys64(
+    hostnames: np.ndarray, host_lengths: np.ndarray, ports: np.ndarray, seed: int
+) -> np.ndarray:
+    """engine.node_key64 for all C slots at once; uint64[C]."""
+    return endpoint_hash_batch(hostnames, host_lengths, ports, seed)
+
+
+def instance_keys32(keys64: np.ndarray, max_weight: int) -> np.ndarray:
+    """[V, C] uint32 virtual-instance keys; row v is every node's key
+    advanced by v golden steps (engine.instance_key32)."""
+    v = np.arange(max_weight, dtype=_U64)[:, None] * _U64(GOLDEN64)
+    with np.errstate(over="ignore"):
+        return _fold32(keys64[None, :].astype(_U64) + v)
+
+
+def _mix32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """engine.mix32 over uint32 lanes (broadcasting)."""
+    with np.errstate(over="ignore"):
+        h = (a ^ b) * _U32(MIX1)
+        h = h ^ (h >> _U32(15))
+        h = h * _U32(MIX2)
+        h = h ^ (h >> _U32(13))
+    return h
+
+
+def _score_matrix(
+    part32: np.ndarray, inst32: np.ndarray, weights: np.ndarray
+) -> np.ndarray:
+    """[B, M] uint32: each row-partition's score against each candidate
+    column, max over that column's weight-many virtual instances. A node
+    with weight >= 1 always applies instance 0, so the masked-to-zero
+    unused instances can never win (scores are unsigned)."""
+    acc = np.zeros((part32.shape[0], inst32.shape[1]), dtype=_U32)
+    for v in range(inst32.shape[0]):
+        s = _mix32(part32[:, None], inst32[v][None, :])
+        live = weights > v
+        if not live.all():
+            s = np.where(live[None, :], s, _U32(0))
+        np.maximum(acc, s, out=acc)
+    return acc
+
+
+def _composite(
+    scores: np.ndarray, cols: np.ndarray, valid: np.ndarray
+) -> np.ndarray:
+    """(score << 32) | (0xFFFFFFFF - col) as uint64, 0 where invalid. Higher
+    composite == better candidate; equal scores resolve to the lower slot,
+    matching the engine's tie rule. Composite 0 is unreachable for any valid
+    candidate (the index half is nonzero for col < 2**32 - 1)."""
+    rev = _REV - cols.astype(_U64)
+    if rev.ndim == 1:
+        rev = rev[None, :]
+    comp = (scores.astype(_U64) << _U64(32)) | rev
+    return np.where(valid, comp, _U64(0))
+
+
+def _select_topr(comp: np.ndarray, r: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-r composites per row, descending. Returns (assign [B,r] int32
+    with -1 for empty slots, scores [B,r] uint32)."""
+    n_rows, m = comp.shape
+    k = min(r, m)
+    if m > k:
+        part = np.argpartition(comp, m - k, axis=1)[:, m - k:]
+        vals = np.take_along_axis(comp, part, axis=1)
+    else:
+        vals = comp
+    order = np.argsort(vals, axis=1)[:, ::-1]
+    vals = np.take_along_axis(vals, order, axis=1)
+    if k < r:
+        vals = np.concatenate(
+            [vals, np.zeros((n_rows, r - k), dtype=_U64)], axis=1
+        )
+    assign = (_REV - (vals & _REV)).astype(np.int64).astype(np.int32)
+    assign = np.where(vals == _U64(0), np.int32(-1), assign)
+    return assign, (vals >> _U64(32)).astype(_U32)
+
+
+# rows-per-chunk sized so the [B, C] uint64 composite stays ~64 MB
+_CHUNK_ELEMS = 8_000_000
+
+
+def topr_full(
+    part32: np.ndarray,
+    inst32: np.ndarray,
+    weights: np.ndarray,
+    active: np.ndarray,
+    replicas: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full [P, R] build, chunked over partitions to bound peak memory."""
+    n_parts = part32.shape[0]
+    n_slots = inst32.shape[1]
+    cols = np.arange(n_slots, dtype=np.int64)
+    block = max(1, _CHUNK_ELEMS // max(n_slots, 1))
+    assign = np.empty((n_parts, replicas), dtype=np.int32)
+    scores = np.empty((n_parts, replicas), dtype=_U32)
+    for start in range(0, n_parts, block):
+        sub = part32[start : start + block]
+        sc = _score_matrix(sub, inst32, weights)
+        comp = _composite(sc, cols, active[None, :])
+        assign[start : start + len(sub)], scores[start : start + len(sub)] = (
+            _select_topr(comp, replicas)
+        )
+    return assign, scores
+
+
+def build_jit(
+    part32: np.ndarray,
+    inst32: np.ndarray,
+    weights: np.ndarray,
+    active: np.ndarray,
+    replicas: int,
+    mesh=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """The whole map as one jitted dispatch, optionally row-sharded.
+
+    With a mesh, the P axis is split across devices exactly like the
+    protocol state in shard/engine.py (NamedSharding over the mesh's axis
+    names); every per-partition row is independent so no collectives are
+    needed. P must divide by the device count. The theoretical parity gap
+    vs the numpy path: an *active* candidate whose best score is exactly 0
+    (p ~= 2**-32 per pair) is indistinguishable from a masked one here.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_instances = int(inst32.shape[0])
+
+    @jax.jit
+    def _build(p32, inst, w, act):
+        acc = jnp.zeros((p32.shape[0], inst.shape[1]), dtype=jnp.uint32)
+        for v in range(n_instances):
+            h = (p32[:, None] ^ inst[v][None, :]) * jnp.uint32(MIX1)
+            h = h ^ (h >> jnp.uint32(15))
+            h = h * jnp.uint32(MIX2)
+            h = h ^ (h >> jnp.uint32(13))
+            h = jnp.where(w[None, :] > v, h, jnp.uint32(0))
+            acc = jnp.maximum(acc, h)
+        key = jnp.where(act[None, :], acc, jnp.uint32(0))
+        col = jnp.arange(key.shape[1], dtype=jnp.int32)[None, :]
+        picks, vals = [], []
+        for _ in range(replicas):
+            a = jnp.argmax(key, axis=1).astype(jnp.int32)
+            v = jnp.max(key, axis=1)
+            picks.append(jnp.where(v > 0, a, jnp.int32(-1)))
+            vals.append(v)
+            key = jnp.where(col == a[:, None], jnp.uint32(0), key)
+        return jnp.stack(picks, axis=1), jnp.stack(vals, axis=1)
+
+    p32 = jnp.asarray(part32, dtype=jnp.uint32)
+    inst = jnp.asarray(inst32, dtype=jnp.uint32)
+    w = jnp.asarray(weights, dtype=jnp.int32)
+    act = jnp.asarray(active, dtype=bool)
+    if mesh is not None:
+        rows = NamedSharding(mesh, P(mesh.axis_names))
+        every = NamedSharding(mesh, P())
+        p32 = jax.device_put(p32, rows)
+        inst = jax.device_put(inst, every)
+        w = jax.device_put(w, every)
+        act = jax.device_put(act, every)
+    assign, scores = _build(p32, inst, w, act)
+    return np.asarray(assign, dtype=np.int32), np.asarray(scores, dtype=_U32)
+
+
+@dataclass(frozen=True)
+class DeviceDiff:
+    """Array-plane PlacementDiff: moved partition indices and per-slot load
+    delta, plus the old/new fingerprints for cross-plane agreement checks."""
+
+    old_version: int
+    new_version: int
+    partitions_moved: np.ndarray  # int64[moved]
+    load_delta: np.ndarray  # int64[C] (new slots held minus old, per slot)
+
+    @property
+    def moved(self) -> int:
+        return int(self.partitions_moved.shape[0])
+
+
+class DevicePlacement:
+    """Stateful device-plane placement over a fixed slot universe.
+
+    Construction fixes the candidate universe (every slot the simulator can
+    ever host, alive or not) and precomputes all keys; ``build`` does the
+    one-time full map for the starting active set; ``apply_view_change``
+    tracks churn incrementally. Slot indices are the simulator's column
+    indices, so candidate order -- and therefore tie-breaking -- is the
+    same sorted-identity order on both planes when the caller's slots are
+    sorted (VirtualCluster.synthesize and the parity tests sort)."""
+
+    def __init__(
+        self,
+        config: PlacementConfig,
+        hostnames: np.ndarray,
+        host_lengths: np.ndarray,
+        ports: np.ndarray,
+        weights: Optional[np.ndarray] = None,
+    ) -> None:
+        self.config = config
+        n_slots = int(ports.shape[0])
+        self.replicas = min(config.replicas, n_slots)
+        self.keys64 = node_keys64(hostnames, host_lengths, ports, config.seed)
+        self.weights = (
+            np.ones(n_slots, dtype=np.int32)
+            if weights is None
+            else weights.astype(np.int32)
+        )
+        self.inst32 = instance_keys32(self.keys64, int(self.weights.max()))
+        self.part32 = partition_keys32(config.partitions, config.seed)
+        self.active = np.zeros(n_slots, dtype=bool)
+        self.assign: Optional[np.ndarray] = None  # [P, R] int32 slot ids
+        self.scores: Optional[np.ndarray] = None  # [P, R] uint32
+        self.version = 0
+
+    # -- full build ------------------------------------------------------ #
+
+    def build(self, active: np.ndarray) -> None:
+        self.assign, self.scores = topr_full(
+            self.part32, self.inst32, self.weights, active, self.replicas
+        )
+        self.active = active.copy()
+        self.version = self._fingerprint()
+
+    # -- incremental churn ---------------------------------------------- #
+
+    def apply_view_change(self, new_active: np.ndarray) -> DeviceDiff:
+        """Update the stored map for a new active set and return the diff.
+
+        Rows are recomputed only when a removed slot sits in their replica
+        set; added slots are merged against every surviving row's stored
+        top-R. Both cases are exactly the rows rendezvous hashing says can
+        change, so the moved set IS the minimal-motion set."""
+        if self.assign is None:
+            raise RuntimeError("build() must run before apply_view_change()")
+        old_assign = self.assign
+        removed = self.active & ~new_active
+        added = new_active & ~self.active
+        removed_slots = np.flatnonzero(removed)
+        added_slots = np.flatnonzero(added)
+
+        assign = old_assign.copy()
+        scores = self.scores.copy()
+        affected = (
+            np.isin(old_assign, removed_slots).any(axis=1)
+            if removed_slots.size
+            else np.zeros(old_assign.shape[0], dtype=bool)
+        )
+        if affected.any():
+            sub_assign, sub_scores = topr_full(
+                self.part32[affected], self.inst32, self.weights,
+                new_active, self.replicas,
+            )
+            assign[affected] = sub_assign
+            scores[affected] = sub_scores
+        if added_slots.size:
+            untouched = ~affected
+            sub_part = self.part32[untouched]
+            new_sc = _score_matrix(
+                sub_part, self.inst32[:, added_slots], self.weights[added_slots]
+            )
+            comp_new = _composite(new_sc, added_slots, True)
+            comp_old = _composite(
+                scores[untouched], assign[untouched], assign[untouched] >= 0
+            )
+            merged_a, merged_s = _select_topr(
+                np.concatenate([comp_old, comp_new], axis=1), self.replicas
+            )
+            assign[untouched] = merged_a
+            scores[untouched] = merged_s
+
+        moved = np.flatnonzero((assign != old_assign).any(axis=1))
+        old_counts = self._counts(old_assign)
+        self.assign, self.scores = assign, scores
+        self.active = new_active.copy()
+        old_version = self.version
+        self.version = self._fingerprint()
+        return DeviceDiff(
+            old_version=old_version,
+            new_version=self.version,
+            partitions_moved=moved,
+            load_delta=self._counts(assign) - old_counts,
+        )
+
+    # -- introspection --------------------------------------------------- #
+
+    def _counts(self, assign: np.ndarray) -> np.ndarray:
+        flat = assign[assign >= 0]
+        return np.bincount(flat, minlength=self.keys64.shape[0]).astype(np.int64)
+
+    def counts(self) -> np.ndarray:
+        if self.assign is None:
+            return np.zeros(self.keys64.shape[0], dtype=np.int64)
+        return self._counts(self.assign)
+
+    def imbalance(self) -> float:
+        """Same statistic as PlacementMap.imbalance over the active slots."""
+        if self.assign is None or not self.active.any():
+            return 0.0
+        counts = self.counts()[self.active]
+        weights = self.weights[self.active].astype(np.float64)
+        total_slots = float(self.assign.size)
+        fair = total_slots / float(weights.sum())
+        if fair == 0.0:
+            return 0.0
+        return float((counts / weights).max() / fair)
+
+    def _fingerprint(self) -> int:
+        """engine._fingerprint mirror: xxh64 over the assigned node keys,
+        8 LE bytes each, in partition-major order. Defined when every slot
+        is filled (active count >= R), which the engine parity requires
+        anyway."""
+        keys = np.where(
+            self.assign >= 0,
+            self.keys64[np.clip(self.assign, 0, None)],
+            _U64(0),
+        )
+        blob = keys.astype("<u8").reshape(1, -1).view(np.uint8)
+        h = xxh64_batch_auto(
+            blob, np.array([blob.shape[1]], dtype=np.int64), self.config.seed
+        )
+        u = int(h[0])
+        return u - (1 << 64) if u >= (1 << 63) else u

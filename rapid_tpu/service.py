@@ -27,6 +27,7 @@ from .messaging.unicast import UnicastToAllBroadcaster
 from .metadata import FrozenMetadata, MetadataManager
 from .monitoring.base import IEdgeFailureDetectorFactory
 from .observability import (
+    PARTITIONS_MOVED_BUCKETS,
     FlightRecorder,
     Metrics,
     StableViewTimer,
@@ -36,6 +37,13 @@ from .observability import (
     global_tracer,
     stamp_trace_context,
     trace_context_of,
+)
+from .placement.engine import (
+    PlacementConfig,
+    PlacementDiff,
+    PlacementEngine,
+    PlacementMap,
+    weight_of,
 )
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
@@ -93,6 +101,7 @@ class MembershipService:
         metrics: Optional[Metrics] = None,
         tracer: Optional[Tracer] = None,
         recorder: Optional[FlightRecorder] = None,
+        placement: Optional[PlacementConfig] = None,
     ) -> None:
         self._my_addr = my_addr
         self._cut_detection = cut_detector
@@ -173,6 +182,11 @@ class MembershipService:
         self._fast_paxos = self._new_fast_paxos()
         self._create_failure_detectors()
 
+        # Placement plane: a deterministic shard map recomputed at every
+        # view install from (config id, sorted view, metadata weights, seed)
+        # -- pure function of state every member agrees on, so no messages.
+        self._placement = PlacementEngine(placement) if placement else None
+
         # Initial VIEW_CHANGE callbacks: start/join completed
         # (MembershipService.java:162-165)
         configuration_id = self._view.get_current_configuration_id()
@@ -181,6 +195,7 @@ class MembershipService:
             for node in self._view.get_ring(0)
         ]
         self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, initial)
+        self._update_placement(configuration_id)
 
     # ------------------------------------------------------------------ #
     # Message dispatch (MembershipService.java:171-193)
@@ -236,6 +251,7 @@ class MembershipService:
         from a quiesced cluster."""
         occupancy = self._cut_detection.occupancy()
         digest = sorted(self.metrics.snapshot().items())
+        pmap = self.placement_map()
         return ClusterStatusResponse(
             sender=self._my_addr,
             configuration_id=self._view.get_current_configuration_id(),
@@ -249,7 +265,71 @@ class MembershipService:
             metric_names=tuple(name for name, _ in digest),
             metric_values=tuple(value for _, value in digest),
             journal=self.recorder.to_wire(32),
+            placement_version=pmap.version if pmap is not None else 0,
+            placement_partitions=(
+                pmap.config.partitions if pmap is not None else 0
+            ),
+            placement_owned=(
+                len(pmap.owned(self._my_addr)) if pmap is not None else 0
+            ),
         )
+
+    # ------------------------------------------------------------------ #
+    # Placement plane (placement/engine.py)
+    # ------------------------------------------------------------------ #
+
+    def placement_map(self) -> Optional[PlacementMap]:
+        """The current deterministic shard map (None unless placement was
+        configured); identical on every member of a configuration."""
+        return self._placement.map if self._placement is not None else None
+
+    def placement_diff(self) -> Optional[PlacementDiff]:
+        """The rebalance plan produced by the latest view change."""
+        return self._placement.last_diff if self._placement is not None else None
+
+    def _update_placement(self, configuration_id: int) -> None:
+        """Recompute the shard map for the just-installed configuration.
+
+        Runs on the protocol executor inside the view-change path (and once
+        at construction), so the map versions advance in lockstep with
+        configuration ids on every member. The rebalance span parents under
+        the ambient view_change span and therefore joins the churn trace."""
+        if self._placement is None:
+            return
+        members = self._view.get_ring(0)
+        cfg = self._placement.config
+        weights = {
+            node: weight_of(
+                self._metadata_manager.get(node), cfg.weight_key,
+                cfg.default_weight,
+            )
+            for node in members
+        }
+        with self.tracer.span(
+            "placement_rebalance", virtual_ms=self._scheduler.now_ms(),
+            size=len(members),
+        ) as span:
+            pmap, diff = self._placement.update(
+                configuration_id, members, weights
+            )
+            span.attrs["version"] = pmap.version
+            if diff is not None:
+                span.attrs["moved"] = diff.moved
+        self.metrics.incr("placement.rebuilds")
+        self.metrics.set_gauge("placement.imbalance", pmap.imbalance())
+        self.metrics.set_gauge(
+            "placement.partitions_owned", len(pmap.owned(self._my_addr))
+        )
+        if diff is not None:
+            self.metrics.observe(
+                "placement.partitions_moved", diff.moved,
+                buckets=PARTITIONS_MOVED_BUCKETS,
+            )
+            self.recorder.record(
+                "placement_rebalance", configuration_id=configuration_id,
+                moved=diff.moved, version=pmap.version,
+                handoffs=len(diff.handoffs),
+            )
 
     def _handle_gossip(self, env: GossipEnvelope) -> Promise:
         """Epidemic relay plane: hand the envelope to a gossip-aware
@@ -603,6 +683,7 @@ class MembershipService:
             size=self._view.membership_size,
         )
         self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, status_changes)
+        self._update_placement(configuration_id)
         self._stable_view.view_installed()
 
         self._cut_detection.clear()

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import (
+    PARTITIONS_MOVED_BUCKETS,
     FlightRecorder,
     Metrics,
     StableViewTimer,
@@ -217,6 +218,10 @@ class Simulator:
         self._injected_down = np.zeros(
             (self.config.capacity, self.config.k), dtype=bool
         )
+        # placement plane (opt-in via enable_placement; not part of protocol
+        # state, so from_configuration restores re-enable it explicitly)
+        self._placement = None
+        self._placement_diffs: List = []
         # membership-invariant element hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
@@ -422,6 +427,60 @@ class Simulator:
             self.cluster.hostnames[slot, : self.cluster.host_lengths[slot]]
         )
         return host, int(self.cluster.ports[slot])
+
+    # ------------------------------------------------------------------ #
+    # Placement plane (placement/device.py)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def placement(self):
+        """The DevicePlacement (None unless enable_placement ran)."""
+        return self._placement
+
+    @property
+    def placement_diffs(self) -> List:
+        """DeviceDiff per view change since placement was enabled."""
+        return list(self._placement_diffs)
+
+    def enable_placement(
+        self,
+        partitions: int = 8192,
+        replicas: int = 3,
+        seed: int = 0,
+        weights: Optional[np.ndarray] = None,
+    ) -> None:
+        """Attach the placement plane: a deterministic shard map over the
+        live membership, updated incrementally inside every view change.
+
+        The full [P, R] build over the whole slot universe happens HERE,
+        once -- deliberately outside any timed path (it is the same
+        one-time-cost class as the ring-hash pre-warms above). View changes
+        afterwards touch only the minimal-motion subset. Placement never
+        advances virtual_ms: the map is state *derived from* the membership,
+        not part of the protocol the simulator is timing."""
+        from ..placement.device import DevicePlacement
+        from ..placement.engine import PlacementConfig
+
+        cfg = PlacementConfig(
+            partitions=partitions, replicas=replicas, seed=seed
+        )
+        placement = DevicePlacement(
+            cfg,
+            self.cluster.hostnames,
+            self.cluster.host_lengths,
+            self.cluster.ports,
+            weights,
+        )
+        placement.build(self.active)
+        self._placement = placement
+        self._placement_diffs = []
+        self.metrics.incr("placement.rebuilds")
+        self.metrics.set_gauge("placement.imbalance", placement.imbalance())
+        self.recorder.record(
+            "placement_rebalance",
+            configuration_id=self.configuration_id(),
+            moved=0, version=placement.version,
+        )
 
     def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
         """Asymmetric failure: probes TO these nodes are lost, their own
@@ -1107,6 +1166,37 @@ class Simulator:
         self.metrics.set_gauge(
             "sim.pending_joiners", len(self._pending_joiners)
         )
+        if self._placement is not None:
+            # Incremental map update: removal-affected rows recompute, added
+            # columns merge -- sub-second even at 100k x 8192 because only
+            # the minimal-motion set is touched. Host-side work on mirrors
+            # already fetched; bills NO protocol time (virtual_ms is the
+            # membership protocol's clock, and the map is derived state).
+            p_span = self.tracer.begin(
+                "placement_rebalance", virtual_ms=self.virtual_ms,
+                size=record.membership_size,
+            )
+            p_span.parent_id = vc_span.span_id
+            p_span.trace_id = vc_span.trace_id
+            diff = self._placement.apply_view_change(self.active)
+            self._placement_diffs.append(diff)
+            p_span.attrs.update(
+                moved=diff.moved, version=self._placement.version,
+            )
+            self.tracer.end(p_span, virtual_ms=self.virtual_ms)
+            self.metrics.incr("placement.rebuilds")
+            self.metrics.observe(
+                "placement.partitions_moved", diff.moved,
+                buckets=PARTITIONS_MOVED_BUCKETS,
+            )
+            self.metrics.set_gauge(
+                "placement.imbalance", self._placement.imbalance()
+            )
+            self.recorder.record(
+                "placement_rebalance",
+                configuration_id=record.configuration_id,
+                moved=diff.moved, version=self._placement.version,
+            )
         vc_span.attrs.update(
             cut=len(record.cut), added=len(record.added),
             removed=len(record.removed),

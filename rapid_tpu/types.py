@@ -322,6 +322,12 @@ class ClusterStatusResponse:
     metric_names: Tuple[str, ...] = ()
     metric_values: Tuple[int, ...] = ()
     journal: Tuple[str, ...] = ()
+    # placement plane (0/absent when placement is not enabled): the map
+    # fingerprint every member must agree on, the map geometry, and how
+    # many partitions this member holds a replica of
+    placement_version: int = 0
+    placement_partitions: int = 0
+    placement_owned: int = 0
 
 
 # Any protocol request/response, for type annotations.

@@ -64,7 +64,8 @@ class ClusterHarness:
     def _builder(self, addr: Endpoint,
                  fd: Optional[IEdgeFailureDetectorFactory] = None,
                  metadata: Optional[Dict[str, bytes]] = None,
-                 subscriptions=None) -> ClusterBuilder:
+                 subscriptions=None,
+                 placement: Optional[Dict[str, int]] = None) -> ClusterBuilder:
         server = InProcessServer(addr, self.network)
         self.servers[addr] = server
         client = InProcessClient(addr, self.network, self.settings)
@@ -89,6 +90,8 @@ class ClusterHarness:
             )
         if metadata:
             builder.set_metadata(metadata)
+        if placement:
+            builder.use_placement(**placement)
         for event, cb in subscriptions or []:
             builder.add_subscription(event, cb)
         return builder

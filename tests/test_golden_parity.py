@@ -36,6 +36,8 @@ from rapid_tpu.hashing import endpoint_hash, xxh64
 from rapid_tpu.membership import MembershipView
 from rapid_tpu.messaging import grpc_transport as gt
 from rapid_tpu.messaging.wire_schema import MSG
+from rapid_tpu.placement import PlacementConfig, build_map, diff_maps
+from rapid_tpu.placement.device import DevicePlacement
 from rapid_tpu.sim.topology import (
     VirtualCluster,
     configuration_id_vectorized,
@@ -135,6 +137,82 @@ def test_sim_plane_matches_golden():
             cluster.ports[order0],
         )
         assert config_id == golden["configuration_id"], name
+
+
+def _placement_config():
+    cfg = GOLDEN["placement"]["config"]
+    return PlacementConfig(
+        partitions=cfg["partitions"], replicas=cfg["replicas"], seed=cfg["seed"]
+    )
+
+
+def _placement_weights():
+    eps = {fx.ep_str(fx.member(i)[0]): fx.member(i)[0] for i in range(25)}
+    return {eps[name]: w for name, w in GOLDEN["placement"]["weights"].items()}
+
+
+def test_placement_engine_matches_golden():
+    """The object-plane placement map (weighted rendezvous over the sorted
+    view) reproduces the frozen assignments, versions, and the minimal-motion
+    moved sets across the three fixed configurations."""
+    config = _placement_config()
+    weights = _placement_weights()
+    prev = None
+    for name, view in _object_views():
+        golden = GOLDEN["placement"]["maps"][name]
+        pmap = build_map(
+            view.get_ring(0), weights, config,
+            view.get_current_configuration_id(),
+        )
+        assert pmap.configuration_id == golden["configuration_id"], name
+        assert pmap.version == golden["version"], name
+        got = [[fx.ep_str(ep) for ep in row] for row in pmap.assignments]
+        assert got == golden["assignments"], name
+        if prev is not None:
+            moved = list(diff_maps(prev, pmap).partitions_moved)
+            assert moved == golden["moved_from_prev"], name
+        prev = pmap
+
+
+def test_placement_device_matches_golden():
+    """The vectorized device plane, fed the same identities as a fixed slot
+    universe with per-stage active masks, lands on the identical frozen
+    assignments and map versions."""
+    config = _placement_config()
+    weights = _placement_weights()
+    universe = sorted(fx.member(i)[0] for i in range(25))
+    max_len = max(len(ep.hostname) for ep in universe)
+    hostnames = np.zeros((len(universe), max_len), dtype=np.uint8)
+    host_lengths = np.zeros(len(universe), dtype=np.int64)
+    ports = np.zeros(len(universe), dtype=np.int64)
+    w = np.ones(len(universe), dtype=np.int32)
+    for slot, ep in enumerate(universe):
+        hostnames[slot, : len(ep.hostname)] = np.frombuffer(
+            ep.hostname, np.uint8
+        )
+        host_lengths[slot] = len(ep.hostname)
+        ports[slot] = ep.port
+        w[slot] = weights.get(ep, 1)
+    stages = {
+        "initial20": set(range(20)),
+        "after_delete3": set(range(20)) - set(fx.DELETED),
+        "after_add5": set(range(25)) - set(fx.DELETED),
+    }
+    ep_of = {i: fx.member(i)[0] for i in range(25)}
+    slot_of = {ep: slot for slot, ep in enumerate(universe)}
+    for name, members in stages.items():
+        golden = GOLDEN["placement"]["maps"][name]
+        active = np.zeros(len(universe), dtype=bool)
+        for i in members:
+            active[slot_of[ep_of[i]]] = True
+        placement = DevicePlacement(config, hostnames, host_lengths, ports, w)
+        placement.build(active)
+        got = [
+            [fx.ep_str(universe[int(s)]) for s in row if s >= 0]
+            for row in placement.assign
+        ]
+        assert got == golden["assignments"], name
+        assert placement.version == golden["version"], name
 
 
 def test_request_bytes_golden():

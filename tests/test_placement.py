@@ -1,0 +1,473 @@
+"""Placement plane: the deterministic weighted-rendezvous shard map.
+
+Both implementations of the same pure function -- the object model
+(placement/engine.py, sorted-view candidate order, scalar xxh64) and the
+vectorized device plane (placement/device.py, slot-column candidate order,
+batched xxh64 + jittable top-R) -- must agree bit-for-bit on assignments
+and map fingerprints across arbitrary churn. On top of parity this battery
+pins the properties the subsystem exists for: determinism from
+(configuration id, view, weights, seed) alone, weighted proportionality via
+virtual instances, and minimal motion (only partitions that lost a replica
+move; uniform-weight noise bound is exactly zero).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from rapid_tpu import Endpoint
+from rapid_tpu.events import NodeStatusChange
+from rapid_tpu.placement import (
+    MAX_WEIGHT,
+    PlacementConfig,
+    PlacementSubscriber,
+    build_map,
+    diff_maps,
+    weight_of,
+)
+from rapid_tpu.placement.device import DevicePlacement, build_jit, topr_full
+from rapid_tpu.placement.engine import PlacementEngine
+from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.types import EdgeStatus
+
+from harness import ClusterHarness
+
+
+def members(n, base_port=9000):
+    return [Endpoint.from_parts(f"10.0.{i // 200}.{i % 200}", base_port + i)
+            for i in range(n)]
+
+
+def device_universe(eps, weights=None):
+    """Column arrays for a *sorted* endpoint universe (the order parity
+    with the engine's sorted-view candidate indexing requires)."""
+    eps = sorted(eps)
+    max_len = max(len(ep.hostname) for ep in eps)
+    hostnames = np.zeros((len(eps), max_len), dtype=np.uint8)
+    host_lengths = np.zeros(len(eps), dtype=np.int64)
+    ports = np.zeros(len(eps), dtype=np.int64)
+    w = np.ones(len(eps), dtype=np.int32)
+    for slot, ep in enumerate(eps):
+        hostnames[slot, : len(ep.hostname)] = np.frombuffer(ep.hostname, np.uint8)
+        host_lengths[slot] = len(ep.hostname)
+        ports[slot] = ep.port
+        if weights:
+            w[slot] = weights.get(ep, 1)
+    return eps, hostnames, host_lengths, ports, w
+
+
+def rows_as_endpoints(assign, eps):
+    return [tuple(eps[int(s)] for s in row if s >= 0) for row in assign]
+
+
+# ---------------------------------------------------------------------- #
+# Determinism and the pure-function contract
+# ---------------------------------------------------------------------- #
+
+def test_build_map_deterministic():
+    eps = members(12)
+    weights = {eps[0]: 4, eps[3]: 2}
+    config = PlacementConfig(partitions=64, replicas=3, seed=11)
+    a = build_map(eps, weights, config, configuration_id=77)
+    b = build_map(list(reversed(eps)), dict(weights), config, 77)
+    assert a == b  # input order is irrelevant: the sorted view decides
+    c = build_map(eps, weights, PlacementConfig(64, 3, seed=12), 77)
+    assert c.assignments != a.assignments or c.version != a.version
+
+
+def test_every_member_computes_the_same_map():
+    """Two engines fed the same views are indistinguishable -- the property
+    that lets every node derive the map locally with zero coordination."""
+    eps = members(9)
+    config = PlacementConfig(partitions=32, replicas=3, seed=5)
+    e1, e2 = PlacementEngine(config), PlacementEngine(config)
+    for cid, view in [(1, eps), (2, eps[:6]), (3, eps[:6] + eps[7:])]:
+        m1, d1 = e1.update(cid, view, {})
+        m2, d2 = e2.update(cid, list(reversed(view)), {})
+        assert m1 == m2
+        assert d1 == d2
+
+
+def test_weight_of_parsing():
+    assert weight_of((("capacity", b"4"),), "capacity", 1) == 4
+    assert weight_of((), "capacity", 2) == 2
+    assert weight_of((("capacity", b"junk"),), "capacity", 1) == 1
+    assert weight_of((("capacity", b"0"),), "capacity", 1) == 1  # clamp low
+    assert weight_of((("capacity", b"9999"),), "capacity", 1) == MAX_WEIGHT
+
+
+def test_replicas_clamped_to_membership():
+    eps = members(2)
+    config = PlacementConfig(partitions=16, replicas=3, seed=0)
+    pmap = build_map(eps, {}, config, 1)
+    assert all(len(row) == 2 for row in pmap.assignments)
+
+
+# ---------------------------------------------------------------------- #
+# Engine <-> device parity across churn
+# ---------------------------------------------------------------------- #
+
+def test_engine_device_parity_across_churn():
+    """Full churn cycle -- build, remove a burst, add some back -- lands
+    on bit-identical assignments and fingerprints on both planes, with the
+    device plane running its incremental path."""
+    all_eps = members(40)
+    weights = {all_eps[1]: 3, all_eps[17]: 5, all_eps[30]: 2}
+    config = PlacementConfig(partitions=256, replicas=3, seed=9)
+    eps, hostnames, host_lengths, ports, w = device_universe(all_eps, weights)
+    placement = DevicePlacement(config, hostnames, host_lengths, ports, w)
+
+    active = np.zeros(len(eps), dtype=bool)
+    active[:32] = True
+    placement.build(active)
+
+    def check(live_mask):
+        live = [eps[i] for i in np.flatnonzero(live_mask)]
+        pmap = build_map(live, weights, config, configuration_id=0)
+        got = rows_as_endpoints(placement.assign, eps)
+        assert got == list(pmap.assignments)
+        assert placement.version == pmap.version
+        return pmap
+
+    prev = check(active)
+
+    # removal burst: incremental update == engine full rebuild
+    removed = np.array([2, 9, 10, 17])
+    active2 = active.copy()
+    active2[removed] = False
+    diff = placement.apply_view_change(active2)
+    cur = check(active2)
+    engine_diff = diff_maps(prev, cur)
+    assert sorted(diff.partitions_moved.tolist()) == list(
+        engine_diff.partitions_moved
+    )
+    prev = cur
+
+    # addition burst (rejoin two, admit four fresh slots)
+    active3 = active2.copy()
+    active3[[2, 9, 33, 34, 35, 36]] = True
+    diff = placement.apply_view_change(active3)
+    engine_diff = diff_maps(prev, check(active3))
+    assert sorted(diff.partitions_moved.tolist()) == list(
+        engine_diff.partitions_moved
+    )
+
+    # incremental state == from-scratch rebuild of the same active set
+    fresh = DevicePlacement(config, hostnames, host_lengths, ports, w)
+    fresh.build(active3)
+    assert np.array_equal(fresh.assign, placement.assign)
+    assert fresh.version == placement.version
+
+
+def test_jit_build_matches_numpy():
+    all_eps = members(24)
+    config = PlacementConfig(partitions=128, replicas=3, seed=4)
+    _, hostnames, host_lengths, ports, w = device_universe(
+        all_eps, {all_eps[5]: 4}
+    )
+    placement = DevicePlacement(config, hostnames, host_lengths, ports, w)
+    active = np.ones(len(all_eps), dtype=bool)
+    active[[3, 11]] = False
+    ref_assign, ref_scores = topr_full(
+        placement.part32, placement.inst32, placement.weights, active,
+        placement.replicas,
+    )
+    jit_assign, jit_scores = build_jit(
+        placement.part32, placement.inst32, placement.weights, active,
+        placement.replicas,
+    )
+    assert np.array_equal(jit_assign, ref_assign)
+    assert np.array_equal(jit_scores, ref_scores)
+
+
+def test_jit_build_sharded_over_mesh():
+    """The jitted build row-sharded over the 8-device CPU mesh (the same
+    NamedSharding scheme as shard/engine.py) agrees with the numpy path."""
+    from rapid_tpu.shard.engine import make_mesh
+
+    assert len(jax.devices()) == 8, "conftest should have forced 8 CPU devices"
+    mesh = make_mesh(8)
+    all_eps = members(32)
+    config = PlacementConfig(partitions=512, replicas=3, seed=6)
+    _, hostnames, host_lengths, ports, w = device_universe(
+        all_eps, {all_eps[0]: 2}
+    )
+    placement = DevicePlacement(config, hostnames, host_lengths, ports, w)
+    active = np.ones(len(all_eps), dtype=bool)
+    active[7] = False
+    ref_assign, ref_scores = topr_full(
+        placement.part32, placement.inst32, placement.weights, active,
+        placement.replicas,
+    )
+    mesh_assign, mesh_scores = build_jit(
+        placement.part32, placement.inst32, placement.weights, active,
+        placement.replicas, mesh=mesh,
+    )
+    assert np.array_equal(mesh_assign, ref_assign)
+    assert np.array_equal(mesh_scores, ref_scores)
+
+
+# ---------------------------------------------------------------------- #
+# Minimal motion and weighted balance
+# ---------------------------------------------------------------------- #
+
+def test_minimal_motion_exact_set():
+    """Removing nodes moves exactly the partitions that held one of them as
+    a replica -- no collateral movement, the rendezvous property the paper's
+    Fig.-13 single-rebalance claim rests on. Uniform weights, so the noise
+    bound is exactly zero."""
+    eps = members(20)
+    config = PlacementConfig(partitions=512, replicas=3, seed=3)
+    old = build_map(eps, {}, config, 1)
+    victims = {eps[4], eps[13]}
+    new = build_map([e for e in eps if e not in victims], {}, config, 2)
+    diff = diff_maps(old, new)
+    expected = {
+        p for p, row in enumerate(old.assignments)
+        if any(v in row for v in victims)
+    }
+    assert set(diff.partitions_moved) == expected  # noise == 0
+    # survivors keep every replica they had
+    for p, (old_row, new_row) in enumerate(zip(old.assignments, new.assignments)):
+        kept = [n for n in old_row if n not in victims]
+        assert all(n in new_row for n in kept), p
+
+
+def test_addition_minimal_motion():
+    """A joiner only steals partitions where it out-scores an incumbent."""
+    eps = members(20)
+    config = PlacementConfig(partitions=512, replicas=3, seed=3)
+    old = build_map(eps[:19], {}, config, 1)
+    new = build_map(eps, {}, config, 2)
+    diff = diff_maps(old, new)
+    for p in diff.partitions_moved:
+        assert eps[19] in new.assignments[p]
+        # exactly one slot changed and the rest survived
+        assert len(set(old.assignments[p]) - set(new.assignments[p])) == 1
+
+
+def test_weighted_proportionality():
+    """A capacity-4 node owns ~4x the partitions of a capacity-1 node."""
+    eps = members(16)
+    heavy = eps[7]
+    config = PlacementConfig(partitions=4096, replicas=1, seed=13)
+    pmap = build_map(eps, {heavy: 4}, config, 1)
+    counts = pmap.counts()
+    fair = config.partitions / (len(eps) - 1 + 4)
+    assert counts[heavy] > 2.5 * fair  # ~4x fair share, generous slack
+    others = [counts.get(e, 0) for e in eps if e != heavy]
+    assert max(others) < 2.0 * fair
+    assert pmap.imbalance() < 1.6
+
+
+# ---------------------------------------------------------------------- #
+# Subscriber: the map from VIEW_CHANGE events alone
+# ---------------------------------------------------------------------- #
+
+def test_subscriber_tracks_view_changes():
+    eps = members(8)
+    config = PlacementConfig(partitions=64, replicas=3, seed=2)
+    sub = PlacementSubscriber(config)
+    up = [
+        NodeStatusChange(ep, EdgeStatus.UP,
+                         (("capacity", b"3"),) if i == 2 else ())
+        for i, ep in enumerate(eps)
+    ]
+    sub(101, up)
+    weights = {eps[2]: 3}
+    assert sub.map == build_map(eps, weights, config, 101)
+    assert sub.last_diff is None
+
+    down = [NodeStatusChange(eps[5], EdgeStatus.DOWN, ())]
+    sub(102, down)
+    expect = build_map([e for e in eps if e != eps[5]], weights, config, 102)
+    assert sub.map == expect
+    assert sub.last_diff is not None
+    assert sub.last_diff.configuration_id == 102
+    assert sub.view_changes == 2
+
+
+# ---------------------------------------------------------------------- #
+# Protocol-plane integration (in-process cluster on virtual time)
+# ---------------------------------------------------------------------- #
+
+@pytest.fixture
+def harness():
+    h = ClusterHarness(seed=7)
+    yield h
+    h.shutdown()
+
+
+def test_cluster_placement_agreement_and_rebalance(harness):
+    """Every member derives the identical map from its own view; one crash
+    burst produces one rebalance whose moved set is minimal."""
+    placement = {"partitions": 64, "replicas": 3, "seed": 1}
+    harness.start_seed(0, placement=placement)
+    for i in range(1, 6):
+        harness.join(i, placement=placement)
+    harness.wait_and_verify_agreement(6)
+
+    maps = [inst.get_placement_map() for inst in harness.instances.values()]
+    assert all(m is not None for m in maps)
+    assert len({m.version for m in maps}) == 1
+    assert all(m.configuration_id == maps[0].configuration_id for m in maps)
+    before = maps[0]
+    assert len(before.members) == 6
+
+    victim = harness.addr(5)
+    harness.fail_nodes([victim])
+    harness.wait_and_verify_agreement(5)
+
+    maps = {ep: inst.get_placement_map()
+            for ep, inst in harness.instances.items()}
+    assert len({m.version for m in maps.values()}) == 1
+    after = next(iter(maps.values()))
+    assert victim not in after.members
+    diffs = [inst.get_placement_diff() for inst in harness.instances.values()]
+    assert all(d is not None for d in diffs)
+    expected = {
+        p for p, row in enumerate(before.assignments) if victim in row
+    }
+    for d in diffs:
+        assert set(d.partitions_moved) == expected
+        assert d.new_version == after.version
+    # the status RPC surfaces the same version it computed locally
+    for ep, inst in harness.instances.items():
+        status = inst.get_cluster_status()
+        assert status.placement_version == inst.get_placement_map().version
+        assert status.placement_partitions == 64
+        assert status.placement_owned == len(
+            inst.get_placement_map().owned(ep)
+        )
+
+
+def test_statusz_renders_placement_fields(harness):
+    """tools/statusz.py surfaces the placement triple in both text and JSON
+    form, and omits the text line for placement-free nodes."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "statusz", os.path.join(os.path.dirname(__file__), "..", "tools",
+                                "statusz.py")
+    )
+    statusz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(statusz)
+
+    harness.start_seed(0, placement={"partitions": 32, "replicas": 3})
+    inst = harness.instances[harness.addr(0)]
+    status = inst.get_cluster_status()
+    text = statusz.render(status)
+    assert f"placement: version={status.placement_version}" in text
+    assert "partitions=32" in text
+    blob = statusz.to_json(status)
+    assert blob["placement_version"] == status.placement_version
+    assert blob["placement_partitions"] == 32
+    assert blob["placement_owned"] == 32  # sole member owns everything
+
+    plain = ClusterHarness(seed=8)
+    try:
+        plain.start_seed(0)
+        bare = plain.instances[plain.addr(0)].get_cluster_status()
+        assert "placement:" not in statusz.render(bare)
+        assert statusz.to_json(bare)["placement_partitions"] == 0
+    finally:
+        plain.shutdown()
+
+
+def test_status_placement_fields_survive_both_wires():
+    """The placement triple in ClusterStatusResponse round-trips through
+    the msgpack codec AND the gRPC wire (fields 13-15); an old frame
+    without them parses back to the defaults."""
+    from rapid_tpu.messaging import grpc_transport as gt
+    from rapid_tpu.messaging.codec import decode, encode
+    from rapid_tpu.messaging.wire_schema import MSG
+    from rapid_tpu.types import ClusterStatusResponse
+
+    r = ClusterStatusResponse(
+        sender=Endpoint.from_parts("h", 1), configuration_id=9,
+        membership_size=3, placement_version=-123456789,
+        placement_partitions=64, placement_owned=21,
+    )
+    assert decode(encode(7, r)) == (7, r)
+    wire = gt.to_wire_response(r).SerializeToString(deterministic=True)
+    assert gt.from_wire_response(MSG["RapidResponse"].FromString(wire)) == r
+    old = ClusterStatusResponse(
+        sender=Endpoint.from_parts("h", 1), configuration_id=1,
+        membership_size=2,
+    )
+    wire = gt.to_wire_response(old).SerializeToString(deterministic=True)
+    back = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert back == old and back.placement_partitions == 0
+
+
+def test_cluster_without_placement_reports_zero(harness):
+    harness.start_seed(0)
+    inst = harness.instances[harness.addr(0)]
+    assert inst.get_placement_map() is None
+    status = inst.get_cluster_status()
+    assert status.placement_version == 0
+    assert status.placement_partitions == 0
+
+
+# ---------------------------------------------------------------------- #
+# Simulator integration (device plane inside the view-change path)
+# ---------------------------------------------------------------------- #
+
+def test_sim_placement_rebalance_on_crash():
+    sim = Simulator(48, seed=3)
+    sim.enable_placement(partitions=128, replicas=3, seed=2)
+    before_assign = sim.placement.assign.copy()
+    before_version = sim.placement.version
+    victims = np.array([5, 6, 7])
+    sim.crash(victims)
+    rec = sim.run_until_decision(max_rounds=64)
+    assert rec is not None
+    diffs = sim.placement_diffs
+    assert len(diffs) == 1
+    diff = diffs[0]
+    expected = np.flatnonzero(np.isin(before_assign, victims).any(axis=1))
+    assert np.array_equal(np.sort(diff.partitions_moved), expected)
+    assert diff.old_version == before_version
+    assert diff.new_version == sim.placement.version != before_version
+    assert not np.isin(sim.placement.assign, victims).any()
+    # metrics + journal carry the rebalance
+    hist = sim.metrics.histogram("placement.partitions_moved")
+    assert hist is not None and hist["count"] == 1
+    kinds = [e["kind"] for e in sim.recorder.tail()]
+    assert kinds.count("placement_rebalance") == 2  # enable + rebalance
+
+
+def test_sim_placement_never_advances_virtual_time():
+    """Placement is derived state: two identical runs, one with the plane
+    enabled, must agree on protocol timing exactly (the bench pin's
+    guarantee)."""
+    a = Simulator(32, seed=11)
+    b = Simulator(32, seed=11)
+    b.enable_placement(partitions=64)
+    for sim in (a, b):
+        sim.crash(np.array([3, 9]))
+        rec = sim.run_until_decision(max_rounds=64)
+        assert rec is not None
+    assert a.virtual_ms == b.virtual_ms
+    assert a.configuration_id() == b.configuration_id()
+
+
+@pytest.mark.slow
+def test_sim_placement_at_scale():
+    """The acceptance scenario: a 100k-node simulated cluster computes and
+    diffs an 8192x3 map inside the view-change path; the incremental update
+    touches only the minimal-motion rows."""
+    sim = Simulator(100_000, seed=1)
+    sim.enable_placement(partitions=8192, replicas=3)
+    before_assign = sim.placement.assign.copy()
+    victims = np.arange(40, 52)
+    sim.crash(victims)
+    rec = sim.run_until_decision(max_rounds=64)
+    assert rec is not None
+    diffs = sim.placement_diffs
+    assert len(diffs) == 1
+    expected = np.flatnonzero(np.isin(before_assign, victims).any(axis=1))
+    assert np.array_equal(np.sort(diffs[0].partitions_moved), expected)
+    assert diffs[0].moved <= 8192
+    assert not np.isin(sim.placement.assign, victims).any()

@@ -99,6 +99,49 @@ def test_metadata_in_down_notification(harness):
     assert dict(changes[0].metadata) == {"role": b"backend"}
 
 
+def test_capacity_metadata_weights_placement(harness):
+    """A joiner advertising ``capacity`` in its metadata owns proportionally
+    more partitions: the metadata plane is the placement plane's weight
+    input (placement/engine.py weight_of)."""
+    placement = {"partitions": 1024, "replicas": 1, "seed": 3}
+    harness.start_seed(0, placement=placement)
+    harness.join(1, placement=placement, metadata={"capacity": b"4"})
+    for i in range(2, 6):
+        harness.join(i, placement=placement)
+    harness.wait_and_verify_agreement(6)
+    heavy = harness.addr(1)
+    fair = 1024 / (5 + 4)  # five weight-1 nodes + one weight-4 node
+    for inst in harness.instances.values():
+        pmap = inst.get_placement_map()
+        counts = pmap.counts()
+        assert counts[heavy] > 2.5 * fair  # ~4x fair share, generous slack
+        assert max(
+            counts.get(harness.addr(i), 0) for i in range(6) if i != 1
+        ) < 2.0 * fair
+
+
+def test_capacity_weight_survives_join_snapshot(harness):
+    """A late joiner learns existing members' weights from the join
+    snapshot's metadata: its locally-derived map is identical (same
+    version) to the ones computed by nodes that watched the heavy node
+    join live."""
+    placement = {"partitions": 256, "replicas": 2, "seed": 5}
+    harness.start_seed(0, placement=placement, metadata={"capacity": b"4"})
+    harness.join(1, placement=placement)
+    harness.wait_and_verify_agreement(2)
+    # node 2 never saw node 0's join; its weight table comes from the
+    # snapshot alone
+    harness.join(2, placement=placement)
+    harness.wait_and_verify_agreement(3)
+    maps = [inst.get_placement_map() for inst in harness.instances.values()]
+    assert len({m.version for m in maps}) == 1
+    heavy = harness.addr(0)
+    counts = maps[0].counts()
+    # weight 4 vs 1,1: the heavy node must dominate ownership everywhere
+    assert counts[heavy] > counts.get(harness.addr(1), 0)
+    assert counts[heavy] > counts.get(harness.addr(2), 0)
+
+
 def test_kicked_event_on_removed_node(harness):
     """A node that is cut from the view fires KICKED locally
     (MembershipService.java:424-429)."""

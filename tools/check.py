@@ -228,7 +228,7 @@ class Checker(ast.NodeVisitor):
         if (
             self.metric_names_checked
             and isinstance(func, ast.Attribute)
-            and func.attr in ("incr", "observe")
+            and func.attr in ("incr", "observe", "set_gauge")
             and node.args
         ):
             self._check_metric_name(node, node.args[0])
@@ -261,8 +261,8 @@ class Checker(ast.NodeVisitor):
             )
 
     def _check_metric_name(self, call: ast.Call, arg: ast.expr) -> None:
-        """Every .incr()/.observe() call site in library code must use a
-        name from observability.METRIC_CATALOG (or a METRIC_PREFIXES
+        """Every .incr()/.observe()/.set_gauge() call site in library code
+        must use a name from observability.METRIC_CATALOG (or a METRIC_PREFIXES
         dynamic family, e.g. f"messages.{...}"). Dynamic names built from
         variables are skipped -- the lint targets the literal call sites
         where a typo would silently fork a metric series."""

@@ -53,6 +53,12 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
         f"  consensus: decided={status.consensus_decided}"
         f" votes={status.consensus_votes}",
     ]
+    if status.placement_partitions:
+        lines.append(
+            f"  placement: version={status.placement_version}"
+            f" partitions={status.placement_partitions}"
+            f" owned={status.placement_owned}"
+        )
     for name, value in zip(status.metric_names, status.metric_values):
         lines.append(f"  metric {name} = {value}")
     tail = status.journal[-journal_lines:] if journal_lines else ()
@@ -82,6 +88,9 @@ def to_json(status: ClusterStatusResponse) -> dict:
         "updates_in_progress": status.updates_in_progress,
         "consensus_decided": status.consensus_decided,
         "consensus_votes": status.consensus_votes,
+        "placement_version": status.placement_version,
+        "placement_partitions": status.placement_partitions,
+        "placement_owned": status.placement_owned,
         "metrics": dict(zip(status.metric_names, status.metric_values)),
         "journal": [json.loads(line) for line in status.journal],
     }
@@ -102,6 +111,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     client = TcpClientServer(Endpoint(b"127.0.0.1", 0), Settings())
     rc = 0
     configs = set()
+    placements = set()
     try:
         for raw in args.targets:
             target = Endpoint.from_string(raw)
@@ -112,6 +122,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rc = 1
                 continue
             configs.add(status.configuration_id)
+            if status.placement_partitions:
+                placements.add(status.placement_version)
             if args.as_json:
                 print(json.dumps(to_json(status), sort_keys=True))
             else:
@@ -121,6 +133,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if len(configs) > 1:
         print(
             f"WARNING: members disagree on configuration id: {sorted(configs)}",
+            file=sys.stderr,
+        )
+        rc = max(rc, 2)
+    # the placement map is a pure function of the configuration, so version
+    # disagreement among placement-enabled members is the same class of
+    # finding as config-id disagreement (split-brain / drifted map function)
+    if len(placements) > 1:
+        print(
+            "WARNING: members disagree on placement map version: "
+            f"{sorted(placements)}",
             file=sys.stderr,
         )
         rc = max(rc, 2)
