@@ -96,6 +96,39 @@ def _placement_hist() -> "dict | None":
         return None
 
 
+def _handoff_hist() -> "dict | None":
+    """Bytes-moved-per-completed-session histogram from the sim plane's
+    handoff transfers (populated by the sweep sizes that enable handoff).
+    None when handoff never ran."""
+    try:
+        from rapid_tpu.observability import global_metrics
+
+        snap = global_metrics().histogram(
+            "handoff.session_bytes", plane="sim"
+        )
+        return snap if snap["count"] else None
+    except Exception:  # noqa: BLE001 -- telemetry must never sink the artifact
+        return None
+
+
+def _handoff_completed() -> int:
+    """Completed handoff session count summed over the global registry tree
+    (``get`` only reads one registry's own counters; live children -- each
+    Simulator's plane=sim registry -- are reachable through ``collect``).
+    0 when handoff never ran or telemetry is unavailable."""
+    try:
+        from rapid_tpu.observability import global_metrics
+
+        return sum(
+            value
+            for kind, name, labels, value in global_metrics().collect()
+            if kind == "counter" and name == "handoff.sessions_completed"
+            and labels.get("plane") == "sim"
+        )
+    except Exception:  # noqa: BLE001 -- telemetry must never sink the artifact
+        return 0
+
+
 def _flag_value(flag: str) -> "str | None":
     """Tolerant --flag VALUE / --flag=VALUE scan. argparse would choke on
     pytest's argv when the contract tests call main() in-process."""
@@ -144,6 +177,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "sweep": merged,
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "placement_partitions_moved": _placement_hist(),
+                "handoff_session_bytes": _handoff_hist(),
             }
         ),
         flush=True,
@@ -255,7 +289,7 @@ def probe_backend() -> "str | None":
 
 
 def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION,
-               placement_partitions: int = 0):
+               placement_partitions: int = 0, handoff_partitions: int = 0):
     """The single definition of the warmed measurement (shared with
     experiments/scaling_sweep.py so the published sweep can never drift from
     the headline): compile on an identical-shape run, then time a fresh
@@ -264,6 +298,12 @@ def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION,
     plane on the timed simulator (full map built before the clock starts;
     the timed window then includes the incremental in-view-change rebalance,
     which is the cost a placement-running deployment actually pays).
+    ``handoff_partitions`` > 0 further enables the handoff plane (implying
+    placement at that partition count if not already set): the diff's
+    transfers execute store-to-store inside the view change, and the run
+    asserts every session completed. Transfer time is billed on the
+    simulator's virtual clock strictly after view_installed, so the
+    stable-view distributions the bench pins are untouched.
     Returns (wall_ms, record, build_s, warmup_wall_s)."""
     from rapid_tpu.sim.driver import Simulator
 
@@ -282,8 +322,12 @@ def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION,
 
     sim2 = Simulator(n_nodes, seed=seed + 4444)
     sim2.ready()  # drain construction from the device queue
-    if placement_partitions:
-        sim2.enable_placement(partitions=placement_partitions)
+    if placement_partitions or handoff_partitions:
+        sim2.enable_placement(
+            partitions=placement_partitions or handoff_partitions
+        )
+    if handoff_partitions:
+        sim2.enable_handoff()
     victims2 = rng.choice(n_nodes, size=n_fail, replace=False)
     sim2.crash(victims2)
     t0 = time.perf_counter()
@@ -293,11 +337,19 @@ def warmed_run(n_nodes: int, seed: int, fail_fraction: float = FAIL_FRACTION,
     assert record is not None, "no decision reached"
     assert set(record.cut) == set(victims2), "cut-set parity violated"
     assert record.membership_size == n_nodes - len(victims2)
-    if placement_partitions:
+    if placement_partitions or handoff_partitions:
         diffs = sim2.placement_diffs
         assert diffs, "placement enabled but no rebalance happened"
         # minimal motion: every moved partition lost a replica to the cut
-        assert all(d.moved <= placement_partitions for d in diffs)
+        partitions = placement_partitions or handoff_partitions
+        assert all(d.moved <= partitions for d in diffs)
+    if handoff_partitions:
+        assert sim2.handoff_transfers, "handoff enabled but nothing moved"
+        started = sim2.metrics.get("handoff.sessions_started")
+        completed = sim2.metrics.get("handoff.sessions_completed")
+        assert started > 0 and completed == started, (
+            f"handoff sessions incomplete: {completed}/{started}"
+        )
     return wall_ms, record, build_s, warm_wall
 
 
@@ -307,26 +359,34 @@ def run_sweep(backend: str, seed: int) -> list:
     _PROGRESS["sweep"] as they complete so the watchdog can emit a partial
     curve."""
     sizes = [1_000, 10_000, 1_000_000] if backend == "tpu" else [1_000, 10_000]
-    # placement rides along on the small sizes only: it exercises the
-    # in-view-change rebalance (and feeds the partitions-moved histogram in
-    # the JSON line) without perturbing the headline-compatible big points
+    # placement + handoff ride along on the small sizes only: they exercise
+    # the in-view-change rebalance and the diff-driven state transfers (and
+    # feed the partitions-moved / session-bytes histograms in the JSON line)
+    # without perturbing the headline-compatible big points
     placement_sizes = {1_000, 10_000}
     out = _PROGRESS["sweep"] = []
     for n in sizes:
         partitions = 1024 if n in placement_sizes else 0
         try:
+            completed_before = _handoff_completed()
             wall_ms, record, _, _ = warmed_run(
-                n, seed=seed, placement_partitions=partitions
+                n, seed=seed, placement_partitions=partitions,
+                handoff_partitions=partitions,
             )
-            out.append(
-                {
-                    "n": n,
-                    "warmed_wall_ms": round(wall_ms, 1),
-                    "virtual_ms": record.virtual_time_ms,
-                    "cut_ok": True,  # asserted inside warmed_run
-                    "placement_partitions": partitions,
-                }
-            )
+            entry = {
+                "n": n,
+                "warmed_wall_ms": round(wall_ms, 1),
+                "virtual_ms": record.virtual_time_ms,
+                "cut_ok": True,  # asserted inside warmed_run
+                "placement_partitions": partitions,
+            }
+            if partitions:
+                moved = _handoff_completed() - completed_before
+                entry["handoff_partitions"] = moved
+                entry["handoff_partitions_per_s"] = (
+                    round(moved / (wall_ms / 1000.0), 1) if wall_ms > 0 else None
+                )
+            out.append(entry)
         except AssertionError:
             # a parity/correctness failure is a BUG, not a lost data point:
             # it must crash the bench (generic nonzero rc per the contract),

@@ -14,6 +14,12 @@ from .cluster import Cluster, ClusterBuilder, JoinException, K, H, L
 from .events import ClusterEvents, NodeStatusChange
 from .membership import Configuration, MembershipView
 from .cut_detector import MultiNodeCutDetector
+from .handoff import (
+    InMemoryPartitionStore,
+    PartitionStore,
+    TransferPlan,
+    plan_transfers,
+)
 from .placement.engine import (
     PlacementConfig,
     PlacementDiff,
@@ -36,6 +42,7 @@ __all__ = [
     "Configuration",
     "EdgeStatus",
     "Endpoint",
+    "InMemoryPartitionStore",
     "JoinException",
     "JoinStatusCode",
     "MembershipView",
@@ -43,11 +50,14 @@ __all__ = [
     "NodeId",
     "NodeStatus",
     "NodeStatusChange",
+    "PartitionStore",
     "PlacementConfig",
     "PlacementDiff",
     "PlacementMap",
     "PlacementSubscriber",
     "Settings",
+    "TransferPlan",
+    "plan_transfers",
     "K",
     "H",
     "L",

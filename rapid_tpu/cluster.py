@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .cut_detector import MultiNodeCutDetector
 from .events import ClusterEvents
+from .handoff.store import PartitionStore
 from .membership import MembershipView
 from .messaging.base import IMessagingClient, IMessagingServer
 from .metadata import FrozenMetadata
@@ -127,6 +128,20 @@ class Cluster:
         self._check_running()
         return self._membership_service.placement_diff()
 
+    def get_handoff_status(self) -> Tuple[int, int, int]:
+        """(in-flight, completed, failed) handoff session counts, all zero
+        when the node was built without ``use_handoff``."""
+        self._check_running()
+        engine = self._membership_service.handoff_engine()
+        return engine.status() if engine is not None else (0, 0, 0)
+
+    def get_partition_store(self):
+        """The PartitionStore this node moves bytes through (None without
+        ``use_handoff``)."""
+        self._check_running()
+        engine = self._membership_service.handoff_engine()
+        return engine.store if engine is not None else None
+
     def leave_gracefully_async(self) -> Promise:
         """Inform observers of the intent to leave, then shut down
         (Cluster.java:145-149)."""
@@ -175,6 +190,7 @@ class ClusterBuilder:
         self._metrics: Optional[Metrics] = None
         self._tracer: Optional[Tracer] = None
         self._placement: Optional[PlacementConfig] = None
+        self._handoff_store: Optional[PartitionStore] = None
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -243,6 +259,14 @@ class ClusterBuilder:
             partitions=partitions, replicas=replicas, seed=seed,
             weight_key=weight_key, default_weight=default_weight,
         )
+        return self
+
+    def use_handoff(self, store: PartitionStore) -> "ClusterBuilder":
+        """Enable the handoff plane: every placement diff's moved partitions
+        are pulled into ``store`` by this node when it becomes a new replica,
+        and released from it once a verified new owner acks (handoff/).
+        Requires ``use_placement`` with identical parameters cluster-wide."""
+        self._handoff_store = store
         return self
 
     def set_broadcaster_factory(self, factory) -> "ClusterBuilder":
@@ -334,6 +358,7 @@ class ClusterBuilder:
                 clock=resources.scheduler.now_ms,
             ),
             placement=self._placement,
+            handoff_store=self._handoff_store,
         )
         server.set_membership_service(service)
         server.start()
@@ -472,6 +497,7 @@ class ClusterBuilder:
                 tracer=self._tracer,
                 recorder=recorder,
                 placement=self._placement,
+                handoff_store=self._handoff_store,
             )
             server.set_membership_service(service)
             result.set_result(

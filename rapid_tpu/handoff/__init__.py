@@ -1,0 +1,34 @@
+"""Handoff plane: partition state transfer driven by placement diffs.
+
+The placement plane (placement/) makes every member derive a bit-identical
+partition map from the strongly consistent view; this package moves the
+bytes that map implies. A view change produces a :class:`PlacementDiff`;
+the :class:`HandoffEngine` turns each moved partition into a versioned,
+pull-based transfer *session* -- the new owner fetches chunks from a
+surviving old replica, bounded in flight, resumable by (session id, chunk
+offset), idempotent on duplicate delivery, and verified by an xxh64 content
+fingerprint before it is acked. A corrupt or torn transfer is retried, a
+dead source fails over to the next surviving replica.
+
+Layout mirrors placement/: ``store.py`` is the application seam
+(:class:`PartitionStore`), ``plan.py`` the pure object-plane planner whose
+output is pinned in the golden vectors, ``device.py`` the vectorized mirror
+of the planner, and ``engine.py`` the live session machinery wired into
+service.py via ``ClusterBuilder.use_handoff``.
+"""
+
+from .engine import DEFAULT_CHUNK_SIZE, HandoffEngine
+from .plan import TransferPlan, chunk_spans, content_fingerprint, plan_transfers, session_key
+from .store import InMemoryPartitionStore, PartitionStore
+
+__all__ = [
+    "DEFAULT_CHUNK_SIZE",
+    "HandoffEngine",
+    "InMemoryPartitionStore",
+    "PartitionStore",
+    "TransferPlan",
+    "chunk_spans",
+    "content_fingerprint",
+    "plan_transfers",
+    "session_key",
+]

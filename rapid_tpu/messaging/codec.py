@@ -59,6 +59,9 @@ _TYPES: Tuple[Type, ...] = (
     T.FastRoundVoteBatch,  # 16
     T.ClusterStatusRequest,  # 17
     T.ClusterStatusResponse,  # 18
+    T.HandoffRequest,  # 19
+    T.HandoffChunk,  # 20
+    T.HandoffAck,  # 21
 )
 _TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
 

@@ -147,6 +147,21 @@ def to_wire_request(msg: T.RapidMessage):
         req.leaveMessage.sender.CopyFrom(_ep(msg.sender))
     elif isinstance(msg, T.ClusterStatusRequest):
         req.clusterStatusRequest.sender.CopyFrom(_ep(msg.sender))
+    elif isinstance(msg, T.HandoffRequest):
+        h = req.handoffRequest
+        h.sender.CopyFrom(_ep(msg.sender))
+        h.sessionId = msg.session_id
+        h.partition = msg.partition
+        h.offset = msg.offset
+        h.length = msg.length
+        h.mapVersion = msg.map_version
+    elif isinstance(msg, T.HandoffAck):
+        h = req.handoffAck
+        h.sender.CopyFrom(_ep(msg.sender))
+        h.sessionId = msg.session_id
+        h.partition = msg.partition
+        h.fingerprint = msg.fingerprint
+        h.mapVersion = msg.map_version
     else:
         raise TypeError(f"not a request type: {type(msg).__name__}")
     ctx = trace_context_of(msg)
@@ -239,6 +254,25 @@ def _from_wire_request_content(req) -> T.RapidMessage:
         return T.ClusterStatusRequest(
             sender=_ep_back(req.clusterStatusRequest.sender)
         )
+    if which == "handoffRequest":
+        m = req.handoffRequest
+        return T.HandoffRequest(
+            sender=_ep_back(m.sender),
+            session_id=int(m.sessionId),
+            partition=int(m.partition),
+            offset=int(m.offset),
+            length=int(m.length),
+            map_version=int(m.mapVersion),
+        )
+    if which == "handoffAck":
+        m = req.handoffAck
+        return T.HandoffAck(
+            sender=_ep_back(m.sender),
+            session_id=int(m.sessionId),
+            partition=int(m.partition),
+            fingerprint=int(m.fingerprint),
+            map_version=int(m.mapVersion),
+        )
     raise ValueError(f"empty RapidRequest envelope: {which}")
 
 
@@ -275,6 +309,21 @@ def to_wire_response(msg) :
         s.placementVersion = msg.placement_version
         s.placementPartitions = msg.placement_partitions
         s.placementOwned = msg.placement_owned
+        s.handoffInFlight = msg.handoff_in_flight
+        s.handoffCompleted = msg.handoff_completed
+        s.handoffFailed = msg.handoff_failed
+        s.handoffPartitions.extend(msg.handoff_partitions)
+        s.handoffFingerprints.extend(msg.handoff_fingerprints)
+    elif isinstance(msg, T.HandoffChunk):
+        h = resp.handoffChunk
+        h.sender.CopyFrom(_ep(msg.sender))
+        h.sessionId = msg.session_id
+        h.partition = msg.partition
+        h.offset = msg.offset
+        h.data = msg.data
+        h.totalSize = msg.total_size
+        h.fingerprint = msg.fingerprint
+        h.status = msg.status
     else:  # Response / None -> empty ack
         resp.response.SetInParent()
     return resp
@@ -317,6 +366,23 @@ def from_wire_response(resp):
             placement_version=int(m.placementVersion),
             placement_partitions=int(m.placementPartitions),
             placement_owned=int(m.placementOwned),
+            handoff_in_flight=int(m.handoffInFlight),
+            handoff_completed=int(m.handoffCompleted),
+            handoff_failed=int(m.handoffFailed),
+            handoff_partitions=tuple(int(p) for p in m.handoffPartitions),
+            handoff_fingerprints=tuple(int(f) for f in m.handoffFingerprints),
+        )
+    if which == "handoffChunk":
+        m = resp.handoffChunk
+        return T.HandoffChunk(
+            sender=_ep_back(m.sender),
+            session_id=int(m.sessionId),
+            partition=int(m.partition),
+            offset=int(m.offset),
+            data=bytes(m.data),
+            total_size=int(m.totalSize),
+            fingerprint=int(m.fingerprint),
+            status=int(m.status),
         )
     return T.Response()
 

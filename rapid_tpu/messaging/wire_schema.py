@@ -126,6 +126,38 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("placementVersion", "int64", 13, False),
         ("placementPartitions", "int32", 14, False),
         ("placementOwned", "int32", 15, False),
+        # handoff plane exposure: session counts plus the local partition
+        # store's (id, fingerprint) digest as parallel arrays
+        ("handoffInFlight", "int32", 16, False),
+        ("handoffCompleted", "int32", 17, False),
+        ("handoffFailed", "int32", 18, False),
+        ("handoffPartitions", "int64", 19, True),
+        ("handoffFingerprints", "int64", 20, True),
+    ],
+    "HandoffRequest": [
+        ("sender", "M:Endpoint", 1, False),
+        ("sessionId", "int64", 2, False),
+        ("partition", "int64", 3, False),
+        ("offset", "int64", 4, False),
+        ("length", "int64", 5, False),
+        ("mapVersion", "int64", 6, False),
+    ],
+    "HandoffChunk": [
+        ("sender", "M:Endpoint", 1, False),
+        ("sessionId", "int64", 2, False),
+        ("partition", "int64", 3, False),
+        ("offset", "int64", 4, False),
+        ("data", "bytes", 5, False),
+        ("totalSize", "int64", 6, False),
+        ("fingerprint", "int64", 7, False),
+        ("status", "int32", 8, False),
+    ],
+    "HandoffAck": [
+        ("sender", "M:Endpoint", 1, False),
+        ("sessionId", "int64", 2, False),
+        ("partition", "int64", 3, False),
+        ("fingerprint", "int64", 4, False),
+        ("mapVersion", "int64", 5, False),
     ],
 }
 
@@ -147,6 +179,10 @@ _REQUEST_ONEOF = [
     ("phase2bMessage", "Phase2bMessage", 9),
     ("leaveMessage", "LeaveMessage", 10),
     ("clusterStatusRequest", "ClusterStatusRequest", 11),
+    # 12/13 are handoff-plane extensions; 15 is reserved for traceCtx
+    # (TRACE_CTX_FIELD_NUMBER), which rides outside the oneof
+    ("handoffRequest", "HandoffRequest", 12),
+    ("handoffAck", "HandoffAck", 13),
 ]
 _RESPONSE_ONEOF = [
     ("joinResponse", "JoinResponse", 1),
@@ -154,6 +190,7 @@ _RESPONSE_ONEOF = [
     ("consensusResponse", "ConsensusResponse", 3),
     ("probeResponse", "ProbeResponse", 4),
     ("clusterStatusResponse", "ClusterStatusResponse", 5),
+    ("handoffChunk", "HandoffChunk", 6),
 ]
 
 _ENUMS = {

@@ -104,6 +104,20 @@ METRIC_CATALOG = frozenset({
     "placement.partitions_moved",
     "placement.imbalance",
     "placement.partitions_owned",
+    # handoff plane (handoff/, service.py, sim/driver.py)
+    "handoff.sessions_started",
+    "handoff.sessions_completed",
+    "handoff.sessions_failed",
+    "handoff.chunks_sent",
+    "handoff.chunks_received",
+    "handoff.chunks_duplicate",
+    "handoff.bytes_moved",
+    "handoff.retries",
+    "handoff.failovers",
+    "handoff.fingerprint_mismatches",
+    "handoff.session_bytes",
+    "handoff.session_chunks",
+    "handoff.releases",
 })
 
 # Dynamic name families: an f-string call site is legal iff its literal head
@@ -118,6 +132,7 @@ SPAN_CATALOG = frozenset({
     "view_change",       # service.py + sim/driver.py: installing a view
     "device_rounds",     # sim/driver.py: a batch of device-dispatched rounds
     "placement_rebalance",  # placement map rebuilt after a view change
+    "handoff_session",   # one partition's state transfer (handoff/engine.py)
 })
 
 # Instant-event and flight-recorder kinds: every Tracer.event and
@@ -140,6 +155,10 @@ EVENT_CATALOG = frozenset({
     "kicked",            # this node was removed from the ring
     "status_served",     # answered a ClusterStatusRequest
     "placement_rebalance",  # placement map rebuilt (moved count + versions)
+    "handoff_started",   # transfer sessions launched for a placement diff
+    "handoff_complete",  # a session finished with a verified fingerprint
+    "handoff_failed",    # a session exhausted sources/retries
+    "handoff_release",   # source released a partition after a verified ack
 })
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).
@@ -160,6 +179,19 @@ STABLE_VIEW_BUCKETS_MS: Tuple[float, ...] = (
 # readable off the histogram on both planes.
 PARTITIONS_MOVED_BUCKETS: Tuple[float, ...] = (
     0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+)
+
+# Bytes moved per handoff session (handoff.session_bytes): powers of four
+# from 1 KiB to 1 GiB, wide enough for both the in-memory reference store
+# and a real partition payload.
+HANDOFF_BYTES_BUCKETS: Tuple[float, ...] = (
+    0, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216,
+    67108864, 268435456, 1073741824,
+)
+
+# Chunks per handoff session (handoff.session_chunks): powers of two.
+HANDOFF_CHUNKS_BUCKETS: Tuple[float, ...] = (
+    0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
 )
 
 

@@ -352,6 +352,40 @@ class DevicePlacement:
             load_delta=self._counts(assign) - old_counts,
         )
 
+    def apply_weight_change(self, new_weights: np.ndarray) -> DeviceDiff:
+        """Re-derive the map after capacity weights change for existing
+        members.
+
+        Weights feed the per-node instance keys (one virtual instance per
+        weight unit), so a weight change alters candidate scores globally --
+        there is no removed/added slot set to scope an incremental update
+        around. Deliberately a full rebuild over the current active set: the
+        engine's ``update`` with changed weights does exactly the same full
+        ``build_map``, and a cheaper path here would be a second scoring
+        code path that could drift from it (the engine/device desync this
+        method exists to prevent; parity pinned in tests)."""
+        if self.assign is None:
+            raise RuntimeError("build() must run before apply_weight_change()")
+        new_weights = new_weights.astype(np.int32)
+        if new_weights.shape != self.weights.shape:
+            raise ValueError("weights must cover the full slot universe")
+        old_assign = self.assign
+        old_counts = self._counts(old_assign)
+        old_version = self.version
+        self.weights = new_weights
+        self.inst32 = instance_keys32(self.keys64, int(new_weights.max()))
+        self.assign, self.scores = topr_full(
+            self.part32, self.inst32, self.weights, self.active, self.replicas
+        )
+        self.version = self._fingerprint()
+        moved = np.flatnonzero((self.assign != old_assign).any(axis=1))
+        return DeviceDiff(
+            old_version=old_version,
+            new_version=self.version,
+            partitions_moved=moved,
+            load_delta=self._counts(self.assign) - old_counts,
+        )
+
     # -- introspection --------------------------------------------------- #
 
     def _counts(self, assign: np.ndarray) -> np.ndarray:

@@ -20,6 +20,8 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from .cut_detector import MultiNodeCutDetector
 from .events import ClusterEvents, NodeStatusChange
 from .fast_paxos import FastPaxos
+from .handoff.engine import HandoffEngine
+from .handoff.store import PartitionStore
 from .hashing import endpoint_hash, to_signed
 from .membership import MembershipView
 from .messaging.base import IBroadcaster, IMessagingClient
@@ -61,6 +63,8 @@ from .types import (
     FastRoundPhase2bMessage,
     FastRoundVoteBatch,
     GossipEnvelope,
+    HandoffAck,
+    HandoffRequest,
     JoinMessage,
     JoinResponse,
     JoinStatusCode,
@@ -102,6 +106,7 @@ class MembershipService:
         tracer: Optional[Tracer] = None,
         recorder: Optional[FlightRecorder] = None,
         placement: Optional[PlacementConfig] = None,
+        handoff_store: Optional[PartitionStore] = None,
     ) -> None:
         self._my_addr = my_addr
         self._cut_detection = cut_detector
@@ -187,6 +192,19 @@ class MembershipService:
         # -- pure function of state every member agrees on, so no messages.
         self._placement = PlacementEngine(placement) if placement else None
 
+        # Handoff plane: moves the partition bytes the placement diffs
+        # imply. Requires placement (sessions launch off its diffs); the
+        # engine shares this node's telemetry so sessions join churn traces.
+        self._handoff: Optional[HandoffEngine] = None
+        if handoff_store is not None:
+            if self._placement is None:
+                raise ValueError("handoff requires placement to be configured")
+            self._handoff = HandoffEngine(
+                handoff_store, my_addr, client, self._scheduler,
+                metrics=self.metrics, tracer=self.tracer,
+                recorder=self.recorder,
+            )
+
         # Initial VIEW_CHANGE callbacks: start/join completed
         # (MembershipService.java:162-165)
         configuration_id = self._view.get_current_configuration_id()
@@ -230,7 +248,46 @@ class MembershipService:
             return self._handle_cluster_status(msg)
         if isinstance(msg, GossipEnvelope):
             return self._handle_gossip(msg)
+        if isinstance(msg, HandoffRequest):
+            return self._handle_handoff_request(msg)
+        if isinstance(msg, HandoffAck):
+            return self._handle_handoff_ack(msg)
         raise TypeError(f"unidentified request type {type(msg).__name__}")
+
+    def _handle_handoff_request(self, msg: HandoffRequest) -> Promise:
+        """Serve one chunk of a partition to a pulling new owner. The slice
+        itself is stateless (handoff/engine.py), but it runs on the protocol
+        executor so reads are serialized against releases from acks."""
+        if self._handoff is None:
+            # no handoff plane here: an empty Response makes the recipient
+            # fail over to its next source rather than hang
+            return Promise.completed(Response())
+        future: Promise = Promise()
+
+        def task() -> None:
+            future.set_result(self._handoff.handle_request(msg))
+
+        self._resources.protocol_executor.execute(task)
+        return future
+
+    def _handle_handoff_ack(self, msg: HandoffAck) -> Promise:
+        """A new owner verified its copy; release ours unless the current
+        map still assigns this member a replica of the partition."""
+        future: Promise = Promise()
+
+        def task() -> None:
+            if self._handoff is not None:
+                pmap = self.placement_map()
+                still_replica = (
+                    pmap is not None
+                    and 0 <= msg.partition < len(pmap.assignments)
+                    and self._my_addr in pmap.assignments[msg.partition]
+                )
+                self._handoff.handle_ack(msg, still_replica)
+            future.set_result(Response())
+
+        self._resources.protocol_executor.execute(task)
+        return future
 
     def _handle_cluster_status(self, msg: ClusterStatusRequest) -> Promise:
         """Introspection RPC: snapshot protocol state on the protocol
@@ -252,6 +309,24 @@ class MembershipService:
         occupancy = self._cut_detection.occupancy()
         digest = sorted(self.metrics.snapshot().items())
         pmap = self.placement_map()
+        handoff_in_flight = handoff_completed = handoff_failed = 0
+        handoff_partitions: Tuple[int, ...] = ()
+        handoff_fingerprints: Tuple[int, ...] = ()
+        if self._handoff is not None:
+            handoff_in_flight, handoff_completed, handoff_failed = (
+                self._handoff.status()
+            )
+            store_digest = getattr(self._handoff.store, "digest", None)
+            if store_digest is not None:
+                handoff_partitions, handoff_fingerprints = store_digest()
+            else:
+                handoff_partitions = self._handoff.store.partitions()
+                handoff_fingerprints = tuple(
+                    fp if fp is not None else 0
+                    for fp in map(
+                        self._handoff.store.fingerprint, handoff_partitions
+                    )
+                )
         return ClusterStatusResponse(
             sender=self._my_addr,
             configuration_id=self._view.get_current_configuration_id(),
@@ -272,6 +347,11 @@ class MembershipService:
             placement_owned=(
                 len(pmap.owned(self._my_addr)) if pmap is not None else 0
             ),
+            handoff_in_flight=handoff_in_flight,
+            handoff_completed=handoff_completed,
+            handoff_failed=handoff_failed,
+            handoff_partitions=handoff_partitions,
+            handoff_fingerprints=handoff_fingerprints,
         )
 
     # ------------------------------------------------------------------ #
@@ -286,6 +366,10 @@ class MembershipService:
     def placement_diff(self) -> Optional[PlacementDiff]:
         """The rebalance plan produced by the latest view change."""
         return self._placement.last_diff if self._placement is not None else None
+
+    def handoff_engine(self) -> Optional[HandoffEngine]:
+        """The live handoff engine (None unless use_handoff configured)."""
+        return self._handoff
 
     def _update_placement(self, configuration_id: int) -> None:
         """Recompute the shard map for the just-installed configuration.
@@ -305,6 +389,7 @@ class MembershipService:
             )
             for node in members
         }
+        old_map = self._placement.map
         with self.tracer.span(
             "placement_rebalance", virtual_ms=self._scheduler.now_ms(),
             size=len(members),
@@ -315,6 +400,25 @@ class MembershipService:
             span.attrs["version"] = pmap.version
             if diff is not None:
                 span.attrs["moved"] = diff.moved
+            if self._handoff is not None:
+                # launched inside the rebalance span, so every
+                # handoff_session span joins this churn's trace. The first
+                # map has no predecessor diff (a joiner builds its service
+                # at the post-join view), so it bootstraps instead: pull
+                # whatever the map assigns us that the store lacks.
+                if old_map is None:
+                    launched = self._handoff.bootstrap_sessions(pmap)
+                elif diff is not None and diff.handoffs:
+                    launched = self._handoff.start_sessions(old_map, pmap)
+                else:
+                    launched = 0
+                if launched:
+                    span.attrs["handoff_sessions"] = launched
+                    self.recorder.record(
+                        "handoff_started",
+                        configuration_id=configuration_id,
+                        sessions=launched, version=pmap.version,
+                    )
         self.metrics.incr("placement.rebuilds")
         self.metrics.set_gauge("placement.imbalance", pmap.imbalance())
         self.metrics.set_gauge(

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import (
+    HANDOFF_BYTES_BUCKETS,
+    HANDOFF_CHUNKS_BUCKETS,
     PARTITIONS_MOVED_BUCKETS,
     FlightRecorder,
     Metrics,
@@ -222,6 +224,14 @@ class Simulator:
         # state, so from_configuration restores re-enable it explicitly)
         self._placement = None
         self._placement_diffs: List = []
+        # handoff plane (opt-in via enable_handoff; requires placement)
+        self._handoff_stores = None
+        self._handoff_sizes: Optional[np.ndarray] = None
+        self._handoff_chunk_size = 1 << 16
+        self._handoff_chunk_ms = 1
+        self._handoff_max_chunk_retries = 8
+        self._handoff_nemesis = None
+        self._handoff_transfers: List = []
         # membership-invariant element hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
@@ -481,6 +491,236 @@ class Simulator:
             configuration_id=self.configuration_id(),
             moved=0, version=placement.version,
         )
+
+    # ------------------------------------------------------------------ #
+    # Handoff plane (handoff/device.py)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def handoff_stores(self):
+        """Per-slot InMemoryPartitionStore dict (None unless enabled)."""
+        return self._handoff_stores
+
+    @property
+    def handoff_transfers(self) -> List:
+        """DeviceTransferPlan lists, one per view change since enabling."""
+        return list(self._handoff_transfers)
+
+    def enable_handoff(
+        self,
+        sizes: Optional[np.ndarray] = None,
+        chunk_size: int = 1 << 16,
+        chunk_ms: int = 1,
+        fault_plan=None,
+        max_chunk_retries: int = 8,
+    ) -> None:
+        """Attach the handoff plane: per-slot partition stores seeded for
+        the current owners, with every subsequent placement diff's moved
+        partitions transferred chunk-by-chunk between stores.
+
+        Transfers are billed on virtual time (``chunk_ms`` per chunk plus
+        any fault-plan delay) strictly AFTER the view installs, so the
+        detection->decision->install stable-view distributions the bench
+        pins are untouched. ``fault_plan`` (faults.FaultPlan) makes chunk
+        pulls suffer deterministic drops/duplicates/delays -- dropped
+        chunks retry up to ``max_chunk_retries`` before the session fails
+        over to the next surviving source, mirroring the live engine."""
+        from ..handoff.store import InMemoryPartitionStore
+
+        if self._placement is None:
+            raise RuntimeError("enable_placement must run before enable_handoff")
+        partitions = self._placement.config.partitions
+        if sizes is None:
+            sizes = (977 * np.arange(partitions, dtype=np.int64)) % 5000
+        sizes = np.asarray(sizes, dtype=np.int64)
+        if sizes.shape[0] != partitions:
+            raise ValueError("sizes must have one entry per partition")
+        self._handoff_sizes = sizes
+        self._handoff_chunk_size = int(chunk_size)
+        self._handoff_chunk_ms = int(chunk_ms)
+        self._handoff_max_chunk_retries = int(max_chunk_retries)
+        self._handoff_transfers = []
+        if fault_plan is not None:
+            from ..faults import Nemesis
+
+            class _VirtualClock:
+                def __init__(self, sim: "Simulator") -> None:
+                    self._sim = sim
+
+                def now_ms(self) -> int:
+                    return self._sim.virtual_ms
+
+            self._handoff_nemesis = Nemesis(
+                fault_plan, _VirtualClock(self), metrics=self.metrics
+            ).arm()
+        else:
+            self._handoff_nemesis = None
+        stores = {
+            slot: InMemoryPartitionStore()
+            for slot in range(self.config.capacity)
+        }
+        assign = self._placement.assign
+        for p in range(partitions):
+            payload = self._handoff_payload(p, int(sizes[p]))
+            for slot in assign[p]:
+                if slot >= 0:
+                    stores[int(slot)].put(p, payload)
+        self._handoff_stores = stores
+
+    @staticmethod
+    def _handoff_payload(partition: int, size: int) -> bytes:
+        """Deterministic per-partition content (cheap, numpy-generated)."""
+        if size <= 0:
+            return b""
+        pattern = (
+            np.arange(size, dtype=np.int64) * 31 + partition * 977 + 7
+        ) & 0xFF
+        return pattern.astype(np.uint8).tobytes()
+
+    def _run_handoff(self, old_assign: np.ndarray, parent_span) -> None:
+        """Execute every transfer the just-applied placement diff implies,
+        deterministically (store-to-store, fault plan consulted per chunk).
+        Runs after view_installed; bills virtual time for the chunk pulls."""
+        from ..handoff.device import device_transfer_plans
+        from ..handoff.plan import chunk_spans, content_fingerprint
+        from ..types import Endpoint, HandoffRequest
+
+        placement = self._placement
+        plans = device_transfer_plans(
+            old_assign, placement.assign, self.active, placement.keys64,
+            placement.version, placement.config.seed, self._handoff_sizes,
+            self._handoff_chunk_size,
+        )
+        self._handoff_transfers.append(plans)
+        stores = self._handoff_stores
+        nemesis = self._handoff_nemesis
+        billed_ms = 0
+        moved_ok: Set[Tuple[int, int]] = set()
+        endpoints: dict = {}
+
+        def ep(slot: int) -> Endpoint:
+            cached = endpoints.get(slot)
+            if cached is None:
+                host, port = self.endpoint_of(slot)
+                cached = endpoints[slot] = Endpoint(hostname=host, port=port)
+            return cached
+
+        for plan in plans:
+            span = self.tracer.begin(
+                "handoff_session", virtual_ms=self.virtual_ms,
+                partition=plan.partition, session=plan.session_id,
+                sources=len(plan.sources),
+            )
+            span.parent_id = parent_span.span_id
+            span.trace_id = parent_span.trace_id
+            self.metrics.incr("handoff.sessions_started")
+            completed = False
+            not_found = 0
+            reachable = 0
+            for idx, src in enumerate(plan.sources):
+                if not self.alive[src]:
+                    self.metrics.incr("handoff.failovers")
+                    continue
+                reachable += 1
+                data = stores[src].get(plan.partition)
+                if data is None:
+                    not_found += 1
+                    continue
+                schedule = chunk_spans(len(data), self._handoff_chunk_size)
+                pulled = True
+                n_chunks = 0
+                for offset, length in schedule if schedule else ((0, 0),):
+                    request = HandoffRequest(
+                        sender=ep(plan.recipient),
+                        session_id=plan.session_id,
+                        partition=plan.partition, offset=offset,
+                        length=length,
+                    )
+                    retries = 0
+                    while True:
+                        billed_ms += self._handoff_chunk_ms
+                        if nemesis is not None:
+                            decision = nemesis.decide(
+                                ep(plan.recipient), ep(src), request, "egress"
+                            )
+                            billed_ms += decision.delay_ms
+                            if decision.drop:
+                                retries += 1
+                                self.metrics.incr("handoff.retries")
+                                if retries > self._handoff_max_chunk_retries:
+                                    pulled = False
+                                    break
+                                continue
+                            for _ in range(decision.duplicates):
+                                self.metrics.incr("handoff.chunks_duplicate")
+                        self.metrics.incr("handoff.chunks_sent")
+                        self.metrics.incr("handoff.chunks_received")
+                        self.metrics.incr("handoff.bytes_moved", length)
+                        n_chunks += 1
+                        break
+                    if not pulled:
+                        break
+                if not pulled:
+                    self.metrics.incr("handoff.failovers")
+                    continue
+                fingerprint = content_fingerprint(plan.partition, data)
+                src_fp = stores[src].fingerprint(plan.partition)
+                if src_fp is not None and fingerprint != src_fp:
+                    self.metrics.incr("handoff.fingerprint_mismatches")
+                    continue
+                stores[plan.recipient].put(plan.partition, data)
+                completed = True
+                self.metrics.incr("handoff.sessions_completed")
+                self.metrics.observe(
+                    "handoff.session_bytes", len(data),
+                    buckets=HANDOFF_BYTES_BUCKETS,
+                )
+                self.metrics.observe(
+                    "handoff.session_chunks", max(1, n_chunks),
+                    buckets=HANDOFF_CHUNKS_BUCKETS,
+                )
+                span.attrs["bytes"] = len(data)
+                self.recorder.record(
+                    "handoff_complete", partition=plan.partition,
+                    session=plan.session_id, bytes=len(data), source=int(src),
+                )
+                break
+            if not completed:
+                if reachable > 0 and not_found == reachable:
+                    # every reachable source is authoritative and empty:
+                    # nothing to move (the live engine's vacuous completion)
+                    completed = True
+                    self.metrics.incr("handoff.sessions_completed")
+                    span.attrs["empty"] = True
+                else:
+                    self.metrics.incr("handoff.sessions_failed")
+                    span.attrs["failed"] = True
+                    self.recorder.record(
+                        "handoff_failed", partition=plan.partition,
+                        session=plan.session_id, sources=len(plan.sources),
+                    )
+            if completed:
+                moved_ok.add((plan.partition, plan.recipient))
+            self.tracer.end(span, virtual_ms=self.virtual_ms)
+        # releases: a donor drops its copy once every recipient of that
+        # partition verified (a failed transfer keeps the old replica alive)
+        by_partition: dict = {}
+        for plan in plans:
+            by_partition.setdefault(plan.partition, []).append(plan)
+        for partition, group in by_partition.items():
+            if not all((partition, g.recipient) in moved_ok for g in group):
+                continue
+            new_row = set(int(s) for s in placement.assign[partition] if s >= 0)
+            old_row = [int(s) for s in old_assign[partition] if s >= 0]
+            for slot in old_row:
+                if slot in new_row or not self.alive[slot]:
+                    continue
+                if stores[slot].get(partition) is not None:
+                    stores[slot].delete(partition)
+                    self.metrics.incr("handoff.releases")
+        # billed strictly after view_installed: the stable-view timer has
+        # already stamped this churn, so the bench pin cannot move
+        self.virtual_ms += billed_ms
 
     def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
         """Asymmetric failure: probes TO these nodes are lost, their own
@@ -1178,6 +1418,10 @@ class Simulator:
             )
             p_span.parent_id = vc_span.span_id
             p_span.trace_id = vc_span.trace_id
+            old_assign = (
+                self._placement.assign.copy()
+                if self._handoff_stores is not None else None
+            )
             diff = self._placement.apply_view_change(self.active)
             self._placement_diffs.append(diff)
             p_span.attrs.update(
@@ -1197,6 +1441,13 @@ class Simulator:
                 configuration_id=record.configuration_id,
                 moved=diff.moved, version=self._placement.version,
             )
+            if old_assign is not None:
+                self.recorder.record(
+                    "handoff_started",
+                    configuration_id=record.configuration_id,
+                    version=self._placement.version,
+                )
+                self._run_handoff(old_assign, p_span)
         vc_span.attrs.update(
             cut=len(record.cut), added=len(record.added),
             removed=len(record.removed),

@@ -328,6 +328,71 @@ class ClusterStatusResponse:
     placement_version: int = 0
     placement_partitions: int = 0
     placement_owned: int = 0
+    # handoff plane (0/absent when handoff is not enabled): session counts
+    # plus a parallel (partition id, content fingerprint) digest of the local
+    # partition store, so an operator tool can cross-check replicas holding
+    # the same partition for byte-level divergence
+    handoff_in_flight: int = 0
+    handoff_completed: int = 0
+    handoff_failed: int = 0
+    handoff_partitions: Tuple[int, ...] = ()
+    handoff_fingerprints: Tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class HandoffRequest:
+    """Pull one chunk of a partition during a handoff session.
+
+    Sent by the NEW owner (recipient) to a surviving OLD replica (source).
+    Pull-based so the recipient controls pacing/backpressure and resume:
+    after a transport failure it simply re-requests from the last offset it
+    has not yet received -- the source keeps no per-session state. Not in
+    rapid.proto's reference surface; carried as a rapid-tpu extension on
+    every transport (msgpack tag 19, request oneof 12)."""
+
+    sender: Endpoint
+    session_id: int
+    partition: int
+    offset: int
+    length: int
+    map_version: int = 0
+
+
+@dataclass(frozen=True)
+class HandoffChunk:
+    """One chunk of partition content, answering a HandoffRequest.
+
+    ``total_size`` and ``fingerprint`` describe the FULL partition content
+    at the source (signed xxh64), repeated on every chunk so the recipient
+    can verify assembly regardless of which chunk arrives last and detect a
+    source whose content changed mid-session. ``status`` 0 = OK, 1 = the
+    source no longer holds the partition (recipient fails over). Msgpack
+    tag 20, response oneof 6."""
+
+    STATUS_OK = 0
+    STATUS_NOT_FOUND = 1
+
+    sender: Endpoint
+    session_id: int
+    partition: int
+    offset: int
+    data: bytes = b""
+    total_size: int = 0
+    fingerprint: int = 0
+    status: int = 0
+
+
+@dataclass(frozen=True)
+class HandoffAck:
+    """Verified-completion notice, recipient -> source (answered with the
+    empty Response). Lets the source release the partition if the new map
+    no longer assigns it a replica. Msgpack tag 21, request oneof 13."""
+
+    sender: Endpoint
+    session_id: int
+    partition: int
+    fingerprint: int = 0
+    map_version: int = 0
 
 
 # Any protocol request/response, for type annotations.

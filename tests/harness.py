@@ -65,7 +65,8 @@ class ClusterHarness:
                  fd: Optional[IEdgeFailureDetectorFactory] = None,
                  metadata: Optional[Dict[str, bytes]] = None,
                  subscriptions=None,
-                 placement: Optional[Dict[str, int]] = None) -> ClusterBuilder:
+                 placement: Optional[Dict[str, int]] = None,
+                 handoff=None) -> ClusterBuilder:
         server = InProcessServer(addr, self.network)
         self.servers[addr] = server
         client = InProcessClient(addr, self.network, self.settings)
@@ -92,6 +93,10 @@ class ClusterHarness:
             builder.set_metadata(metadata)
         if placement:
             builder.use_placement(**placement)
+        if handoff is not None:
+            # a PartitionStore instance, or a factory called per node
+            store = handoff() if callable(handoff) else handoff
+            builder.use_handoff(store)
         for event, cb in subscriptions or []:
             builder.add_subscription(event, cb)
         return builder

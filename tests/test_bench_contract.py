@@ -25,7 +25,7 @@ class _FakeRecord:
 
 def _fake_warmed_run(wall_ms):
     def run(n_nodes, seed, fail_fraction=bench.FAIL_FRACTION,
-            placement_partitions=0):
+            placement_partitions=0, handoff_partitions=0):
         return wall_ms, _FakeRecord(), 1.0, 2.0
 
     return run
@@ -143,7 +143,7 @@ def test_sweep_parity_failure_crashes_the_bench(monkeypatch):
     it must propagate (generic nonzero rc), never become an rc-0 error
     entry."""
     def bad_parity(n_nodes, seed, fail_fraction=bench.FAIL_FRACTION,
-                   placement_partitions=0):
+                   placement_partitions=0, handoff_partitions=0):
         raise AssertionError("cut-set parity violated")
 
     monkeypatch.setattr(bench, "warmed_run", bad_parity)
@@ -154,7 +154,7 @@ def test_sweep_parity_failure_crashes_the_bench(monkeypatch):
 
 def test_sweep_isolates_per_size_failures(monkeypatch):
     def flaky(n_nodes, seed, fail_fraction=bench.FAIL_FRACTION,
-              placement_partitions=0):
+              placement_partitions=0, handoff_partitions=0):
         if n_nodes == 10_000:
             raise RuntimeError("boom")
         return 50.0, _FakeRecord(), 1.0, 2.0

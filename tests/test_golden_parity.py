@@ -32,6 +32,8 @@ from pathlib import Path
 
 import numpy as np
 
+from rapid_tpu.handoff.device import device_transfer_plans
+from rapid_tpu.handoff.plan import plan_transfers
 from rapid_tpu.hashing import endpoint_hash, xxh64
 from rapid_tpu.membership import MembershipView
 from rapid_tpu.messaging import grpc_transport as gt
@@ -213,6 +215,91 @@ def test_placement_device_matches_golden():
         ]
         assert got == golden["assignments"], name
         assert placement.version == golden["version"], name
+
+
+def test_handoff_plans_match_golden():
+    """Both transfer-planning implementations -- the object plane
+    (handoff/plan.py over PlacementMaps) and the vectorized device plane
+    (handoff/device.py over [P, R] slot arrays) -- reproduce the frozen
+    per-transition session lists: pairing, failover chains, sizes, chunk
+    counts, and the xxh64-derived session ids."""
+    config = _placement_config()
+    weights = _placement_weights()
+    sizes = {
+        int(p): s for p, s in GOLDEN["handoff"]["sizes"].items()
+    }
+    chunk_size = GOLDEN["handoff"]["chunk_size"]
+
+    # engine plans per stage transition
+    maps = []
+    for name, view in _object_views():
+        maps.append((name, build_map(
+            view.get_ring(0), weights, config,
+            view.get_current_configuration_id(),
+        )))
+    engine_plans = {}
+    for (_, prev), (name, cur) in zip(maps, maps[1:]):
+        engine_plans[name] = plan_transfers(prev, cur, sizes, chunk_size)
+
+    for name, plans in engine_plans.items():
+        golden = GOLDEN["handoff"]["transitions"][name]
+        assert len(plans) == len(golden), name
+        for plan, expect in zip(plans, golden):
+            assert plan.partition == expect["partition"], name
+            assert fx.ep_str(plan.recipient) == expect["recipient"], name
+            assert [fx.ep_str(ep) for ep in plan.sources] == expect["sources"]
+            assert plan.size == expect["size"], name
+            assert len(plan.chunks) == expect["chunks"], name
+            assert plan.session_id == expect["session_id"], name
+
+    # device plans over the same transitions, via the fixed slot universe
+    universe = sorted(fx.member(i)[0] for i in range(25))
+    max_len = max(len(ep.hostname) for ep in universe)
+    hostnames = np.zeros((len(universe), max_len), dtype=np.uint8)
+    host_lengths = np.zeros(len(universe), dtype=np.int64)
+    ports = np.zeros(len(universe), dtype=np.int64)
+    w = np.ones(len(universe), dtype=np.int32)
+    for slot, ep in enumerate(universe):
+        hostnames[slot, : len(ep.hostname)] = np.frombuffer(
+            ep.hostname, np.uint8
+        )
+        host_lengths[slot] = len(ep.hostname)
+        ports[slot] = ep.port
+        w[slot] = weights.get(ep, 1)
+    stages = [
+        ("initial20", set(range(20))),
+        ("after_delete3", set(range(20)) - set(fx.DELETED)),
+        ("after_add5", set(range(25)) - set(fx.DELETED)),
+    ]
+    ep_of = {i: fx.member(i)[0] for i in range(25)}
+    slot_of = {ep: slot for slot, ep in enumerate(universe)}
+    sizes_arr = np.array(
+        [sizes[p] for p in range(config.partitions)], dtype=np.int64
+    )
+    placement = DevicePlacement(config, hostnames, host_lengths, ports, w)
+    prev_assign = None
+    for name, members in stages:
+        active = np.zeros(len(universe), dtype=bool)
+        for i in members:
+            active[slot_of[ep_of[i]]] = True
+        placement.build(active)
+        if prev_assign is not None:
+            device_plans = device_transfer_plans(
+                prev_assign, placement.assign, active, placement.keys64,
+                placement.version, config.seed, sizes_arr, chunk_size,
+            )
+            golden = GOLDEN["handoff"]["transitions"][name]
+            assert len(device_plans) == len(golden), name
+            for plan, expect in zip(device_plans, golden):
+                assert plan.partition == expect["partition"], name
+                assert fx.ep_str(universe[plan.recipient]) == expect["recipient"]
+                assert [
+                    fx.ep_str(universe[s]) for s in plan.sources
+                ] == expect["sources"], name
+                assert plan.size == expect["size"], name
+                assert len(plan.chunks) == expect["chunks"], name
+                assert plan.session_id == expect["session_id"], name
+        prev_assign = placement.assign.copy()
 
 
 def test_request_bytes_golden():

@@ -159,6 +159,48 @@ def test_engine_device_parity_across_churn():
     assert fresh.version == placement.version
 
 
+def test_apply_weight_change_matches_engine_rebuild():
+    """Re-weighting a live slot is an explicit full rebuild: the device
+    plane's post-change assignments, version, and moved set agree with the
+    engine rebuilding from scratch under the new weight dict."""
+    all_eps = members(12)
+    config = PlacementConfig(partitions=128, replicas=3, seed=6)
+    eps, hostnames, host_lengths, ports, w = device_universe(all_eps)
+    placement = DevicePlacement(config, hostnames, host_lengths, ports, w)
+    active = np.ones(len(eps), dtype=bool)
+    active[4] = False
+    placement.build(active)
+    live = [eps[i] for i in np.flatnonzero(active)]
+    before = build_map(live, {}, config, configuration_id=0)
+    assert rows_as_endpoints(placement.assign, eps) == list(before.assignments)
+
+    new_w = w.copy()
+    new_w[0] = 4
+    new_w[7] = 2
+    diff = placement.apply_weight_change(new_w)
+    after = build_map(
+        live, {eps[0]: 4, eps[7]: 2}, config, configuration_id=0
+    )
+    assert rows_as_endpoints(placement.assign, eps) == list(after.assignments)
+    assert placement.version == after.version
+    assert diff.old_version == before.version
+    assert diff.new_version == after.version
+    engine_diff = diff_maps(before, after)
+    assert sorted(diff.partitions_moved.tolist()) == list(
+        engine_diff.partitions_moved
+    )
+    # load_delta sums to zero slots-moved bookkeeping and only over actives
+    assert int(diff.load_delta.sum()) == 0
+    assert not diff.load_delta[4]
+
+    # guard rails: shape mismatch and use-before-build both refuse
+    with pytest.raises(ValueError):
+        placement.apply_weight_change(np.ones(3, dtype=np.int32))
+    virgin = DevicePlacement(config, hostnames, host_lengths, ports, w)
+    with pytest.raises(RuntimeError):
+        virgin.apply_weight_change(new_w)
+
+
 def test_jit_build_matches_numpy():
     all_eps = members(24)
     config = PlacementConfig(partitions=128, replicas=3, seed=4)

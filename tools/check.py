@@ -287,6 +287,147 @@ class Checker(ast.NodeVisitor):
                 )
 
 
+def _module_literals(path: Path, wanted: set) -> dict:
+    """Top-level ``NAME = <literal>`` assignments (plain or annotated) from a
+    file, without importing it: {name: (value, lineno)}."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out: dict = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            target, value = node.target.id, node.value
+        else:
+            continue
+        if target in wanted and value is not None:
+            try:
+                out[target] = (ast.literal_eval(value), node.lineno)
+            except ValueError:
+                pass
+    return out
+
+
+def check_wire_tags() -> list[Finding]:
+    """Wire-numbering lint over the messaging schema tables.
+
+    The msgpack codec's tags are _TYPES list indices and the gRPC envelope's
+    oneof numbers are hand-maintained literals; a duplicate or colliding
+    number would decode one message type as another with no error at the
+    call site. Asserts: codec._TYPES entries are unique; every wire_schema
+    message uses each field number and name once; each oneof's numbers are
+    unique AND contiguous from 1 (so a new message -- e.g. the handoff
+    messages after ClusterStatus -- must take the next number, never a gap
+    or a reuse); no oneof number collides with TRACE_CTX_FIELD_NUMBER,
+    which rides outside the oneof on the same envelopes."""
+    findings: list[Finding] = []
+    msg_dir = REPO / "rapid_tpu" / "messaging"
+    codec_path = msg_dir / "codec.py"
+    schema_path = msg_dir / "wire_schema.py"
+
+    tree = ast.parse(codec_path.read_text(), filename=str(codec_path))
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if (
+            any(
+                isinstance(t, ast.Name) and t.id == "_TYPES"
+                for t in targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            seen: dict = {}
+            for i, elt in enumerate(node.value.elts):
+                name = (
+                    elt.attr if isinstance(elt, ast.Attribute)
+                    else getattr(elt, "id", None)
+                )
+                if name is None:
+                    continue
+                if name in seen:
+                    findings.append(Finding(
+                        codec_path, elt.lineno, "wire-tags",
+                        f"codec._TYPES lists {name!r} at tags {seen[name]} "
+                        f"and {i}; duplicates make encoding ambiguous",
+                    ))
+                seen[name] = i
+            break
+    else:
+        findings.append(Finding(
+            codec_path, 0, "wire-tags", "codec._TYPES not found"
+        ))
+
+    wanted = {"_MESSAGES", "_REQUEST_ONEOF", "_RESPONSE_ONEOF",
+              "TRACE_CTX_FIELD_NUMBER"}
+    lits = _module_literals(schema_path, wanted)
+    for name in sorted(wanted - lits.keys()):
+        findings.append(Finding(
+            schema_path, 0, "wire-tags",
+            f"wire_schema.{name} not found or not a pure literal",
+        ))
+
+    messages = lits.get("_MESSAGES", ({}, 0))[0]
+    if messages:
+        line = lits["_MESSAGES"][1]
+        for msg_name, fields in messages.items():
+            numbers = [number for _, _, number, _ in fields]
+            names = [field_name for field_name, _, _, _ in fields]
+            for number in sorted({n for n in numbers if numbers.count(n) > 1}):
+                findings.append(Finding(
+                    schema_path, line, "wire-tags",
+                    f"{msg_name} uses field number {number} more than once",
+                ))
+            for field_name in sorted({n for n in names if names.count(n) > 1}):
+                findings.append(Finding(
+                    schema_path, line, "wire-tags",
+                    f"{msg_name} declares field {field_name!r} more than once",
+                ))
+            for number in numbers:
+                if number < 1:
+                    findings.append(Finding(
+                        schema_path, line, "wire-tags",
+                        f"{msg_name} uses invalid field number {number}",
+                    ))
+
+    trace_number = lits.get("TRACE_CTX_FIELD_NUMBER", (None, 0))[0]
+    for oneof_name in ("_REQUEST_ONEOF", "_RESPONSE_ONEOF"):
+        if oneof_name not in lits:
+            continue
+        entries, line = lits[oneof_name]
+        numbers = [number for _, _, number in entries]
+        if len(set(numbers)) != len(numbers):
+            findings.append(Finding(
+                schema_path, line, "wire-tags",
+                f"{oneof_name} reuses a field number: {sorted(numbers)}",
+            ))
+        if sorted(numbers) != list(range(1, len(numbers) + 1)):
+            findings.append(Finding(
+                schema_path, line, "wire-tags",
+                f"{oneof_name} numbers {sorted(numbers)} are not contiguous "
+                "from 1; new messages must take the next free number",
+            ))
+        if trace_number is not None and trace_number in numbers:
+            findings.append(Finding(
+                schema_path, line, "wire-tags",
+                f"{oneof_name} number {trace_number} collides with "
+                "TRACE_CTX_FIELD_NUMBER (rides outside the oneof)",
+            ))
+        if messages:
+            for _, type_name, _ in entries:
+                if type_name not in messages:
+                    findings.append(Finding(
+                        schema_path, line, "wire-tags",
+                        f"{oneof_name} references unknown message "
+                        f"{type_name!r}",
+                    ))
+    return findings
+
+
 def check_file(path: Path) -> list[Finding]:
     source = path.read_text()
     try:
@@ -314,6 +455,7 @@ def main(argv: list[str]) -> int:
     findings: list[Finding] = []
     for f in files:
         findings.extend(check_file(f))
+    findings.extend(check_wire_tags())
     for finding in findings:
         print(finding)
     print(f"checked {len(files)} files: "

@@ -59,6 +59,18 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
             f" partitions={status.placement_partitions}"
             f" owned={status.placement_owned}"
         )
+    if (
+        status.handoff_in_flight
+        or status.handoff_completed
+        or status.handoff_failed
+        or status.handoff_partitions
+    ):
+        lines.append(
+            f"  handoff: in-flight={status.handoff_in_flight}"
+            f" completed={status.handoff_completed}"
+            f" failed={status.handoff_failed}"
+            f" stored={len(status.handoff_partitions)}"
+        )
     for name, value in zip(status.metric_names, status.metric_values):
         lines.append(f"  metric {name} = {value}")
     tail = status.journal[-journal_lines:] if journal_lines else ()
@@ -91,6 +103,15 @@ def to_json(status: ClusterStatusResponse) -> dict:
         "placement_version": status.placement_version,
         "placement_partitions": status.placement_partitions,
         "placement_owned": status.placement_owned,
+        "handoff_in_flight": status.handoff_in_flight,
+        "handoff_completed": status.handoff_completed,
+        "handoff_failed": status.handoff_failed,
+        "handoff_partitions": {
+            str(p): fp
+            for p, fp in zip(
+                status.handoff_partitions, status.handoff_fingerprints
+            )
+        },
         "metrics": dict(zip(status.metric_names, status.metric_values)),
         "journal": [json.loads(line) for line in status.journal],
     }
@@ -112,6 +133,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     rc = 0
     configs = set()
     placements = set()
+    # partition id -> set of content fingerprints reported by its holders
+    fingerprints: dict = {}
     try:
         for raw in args.targets:
             target = Endpoint.from_string(raw)
@@ -124,6 +147,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             configs.add(status.configuration_id)
             if status.placement_partitions:
                 placements.add(status.placement_version)
+            for part, fp in zip(
+                status.handoff_partitions, status.handoff_fingerprints
+            ):
+                fingerprints.setdefault(part, set()).add(fp)
             if args.as_json:
                 print(json.dumps(to_json(status), sort_keys=True))
             else:
@@ -143,6 +170,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             "WARNING: members disagree on placement map version: "
             f"{sorted(placements)}",
+            file=sys.stderr,
+        )
+        rc = max(rc, 2)
+    # replicas of a partition must hold byte-identical content once handoff
+    # has drained; divergent fingerprints mean a corrupt or torn transfer
+    # survived verification somewhere, which is the same severity of finding
+    # as a split-brain configuration
+    torn = sorted(p for p, fps in fingerprints.items() if len(fps) > 1)
+    if torn:
+        print(
+            "WARNING: replicas disagree on partition content fingerprints: "
+            f"partitions {torn}",
             file=sys.stderr,
         )
         rc = max(rc, 2)
