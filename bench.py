@@ -10,10 +10,13 @@ reporting: the decided cut must be exactly the crashed set, and the resulting
 configuration ID is computed with the bit-exact JVM hash chain.
 
 Prints exactly one JSON line:
-  {"metric", "value", "unit", "vs_baseline", "backend", "sweep"}
+  {"metric", "value", "unit", "vs_baseline", "backend", "sweep",
+   "wan_stable_view"}
 where "sweep" is the warmed scaling curve (1k/10k/100k/1M on TPU; the 1M
 point is skipped on CPU), each entry measured by the same warmed_run as the
-headline so the curve can never drift from it.
+headline so the curve can never drift from it, and "wan_stable_view" is the
+WAN dimension: stable-view latency vs inter-region RTT (WAN_RTTS_MS), the
+topology compiled onto the device plane's delivery groups.
 
 Exit-code contract (the driver records rc alongside the JSON):
   0   measurement produced; TPU wall within the regression budget
@@ -64,7 +67,14 @@ WATCHDOG_S = 20 * 60
 # Progress shared with the watchdog: once the headline measurement exists it
 # is the round's artifact, and a later hang (e.g. the 1M sweep point jitting
 # against a dying tunnel) must emit it rather than destroy it.
-_PROGRESS: dict = {"headline": None, "backend": None, "sweep": []}
+_PROGRESS: dict = {"headline": None, "backend": None, "sweep": [], "wan": None}
+
+# WAN dimension: stable-view latency vs inter-region round-trip time. Two
+# regions, 2k nodes, a 1% crash in the mix; the topology compiles to
+# delivery groups + broadcast-delay rounds on the device plane (see
+# rapid_tpu/faults.py:apply_topology). 0 = the flat-fabric control point.
+WAN_N_NODES = 2_000
+WAN_RTTS_MS = (0, 500, 1000)
 
 
 def _stable_view_hist() -> "dict | None":
@@ -175,6 +185,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "vs_baseline": round(headline["value"] / BASELINE_MS, 4),
                 "backend": backend,
                 "sweep": merged,
+                "wan_stable_view": _PROGRESS["wan"],
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "placement_partitions_moved": _placement_hist(),
                 "handoff_session_bytes": _handoff_hist(),
@@ -395,6 +406,59 @@ def run_sweep(backend: str, seed: int) -> list:
         except Exception as exc:  # noqa: BLE001 -- keep the rest of the curve
             out.append({"n": n, "error": f"{type(exc).__name__}: {exc}"})
             print(f"bench.py: sweep n={n} failed: {exc}", file=sys.stderr, flush=True)
+    # the WAN dimension rides inside the sweep stage (the contract tests
+    # stub run_sweep, so their stubbed runs skip the real simulators here);
+    # an AssertionError is a parity bug and crashes per the rc contract
+    try:
+        run_wan_dimension(seed)
+    except AssertionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 -- keep the artifact
+        _PROGRESS["wan"] = [{"error": f"{type(exc).__name__}: {exc}"}]
+        print(f"bench.py: WAN dimension failed: {exc}", file=sys.stderr,
+              flush=True)
+    return out
+
+
+def run_wan_dimension(seed: int) -> list:
+    """The WAN curve: warmed-style stable-view measurement at each
+    inter-region RTT in WAN_RTTS_MS, identical crash workload, identical
+    SimConfig shape (one jit cache entry serves all points). Cut parity is
+    asserted at every point, same policy as the sweep. Entries land in
+    _PROGRESS["wan"] as they complete so the watchdog can emit a partial
+    curve."""
+    from rapid_tpu.faults import apply_topology
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.sim.engine import SimConfig
+    from rapid_tpu.sim.topology import LatencyTopology
+
+    n = WAN_N_NODES
+    out = _PROGRESS["wan"] = []
+    rng = np.random.default_rng(seed)
+    for rtt in WAN_RTTS_MS:
+        config = SimConfig(capacity=n, groups=2, max_delivery_delay=2,
+                           rounds_per_interval=4)
+        sim = Simulator(n, config=config, seed=seed)
+        if rtt:
+            topo = LatencyTopology(racks=2, zones=2, regions=2,
+                                   rack_rtt_ms=0, zone_rtt_ms=0,
+                                   region_rtt_ms=0, inter_region_rtt_ms=rtt)
+            apply_topology(sim, topo)
+        victims = rng.choice(n, size=n // 100, replace=False)
+        sim.crash(victims)
+        t0 = time.perf_counter()
+        record = sim.run_until_decision(max_rounds=64, batch=16)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        assert record is not None, f"no decision at inter-region RTT {rtt}"
+        assert set(record.cut) == set(victims), (
+            f"cut-set parity violated at inter-region RTT {rtt}"
+        )
+        out.append({
+            "inter_region_rtt_ms": rtt,
+            "n": n,
+            "virtual_ms": record.virtual_time_ms,
+            "wall_ms": round(wall_ms, 1),
+        })
     return out
 
 

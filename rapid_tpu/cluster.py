@@ -315,6 +315,10 @@ class ClusterBuilder:
     def _fd(self, client: IMessagingClient) -> IEdgeFailureDetectorFactory:
         if self._fd_factory is not None:
             return self._fd_factory
+        # RTT estimates read the node's scheduler clock when one is set, so
+        # virtual-time runs measure deterministic fd.rtt_ms and a nemesis
+        # clock-skew scheduler drifts the estimates with the node
+        clock = self._scheduler.now_ms if self._scheduler is not None else None
         if self._settings.fd_policy == "windowed":
             from .monitoring.pingpong import WindowedPingPongFailureDetectorFactory
 
@@ -323,11 +327,13 @@ class ClusterBuilder:
                 window=self._settings.fd_window,
                 threshold=self._settings.fd_window_threshold,
                 metrics=self._metrics,
+                clock=clock,
             )
         return PingPongFailureDetectorFactory(
             self._listen_address, client,
             failure_threshold=self._settings.fd_failure_threshold,
             metrics=self._metrics,
+            clock=clock,
         )
 
     def start(self) -> Cluster:

@@ -29,6 +29,16 @@ sockets. This module unifies them:
   arrays segment by segment, so one seeded plan replays on both planes and
   parity tests can assert identical cuts and configuration ids.
 
+Beyond the crash-adjacent battery, the plane models *gray* failures -- the
+class where a component works by every binary check but is useless in
+practice: :class:`SlowNodeRule` (alive, answering, too late),
+:class:`LossyLinkRule` (connected, leaking), :class:`ClockSkewRule` (running,
+on the wrong time), :class:`WireVersionRule` (speaking, in a different wire
+dialect) -- and WAN latency structure via
+:class:`~.sim.topology.LatencyTopology` attached with
+``FaultPlan.with_topology``. ``RULE_CATALOG`` pins each rule's device-plane
+story; tools/check.py keeps it exhaustive.
+
 Egress rules (``at="egress"``, the default) are applied by the client
 decorator at the sender; ingress rules by the server decorator at the
 receiver. A rule is applied exactly once either way, so wrapping both halves
@@ -162,6 +172,70 @@ class ReorderRule(Rule):
     max_extra_ms: int = 100
 
 
+@dataclass(frozen=True)
+class LossyLinkRule(DropRule):
+    """Gray failure: the link stays *connected* but drops a sustained
+    ``probability`` of traffic -- below the one-way-cut threshold a
+    PartitionRule models. A distinct class (not just a DropRule with small
+    p) so plans, telemetry and the device catalog name the failure mode the
+    paper's flip-flop battery gestures at but never isolates."""
+
+
+@dataclass(frozen=True)
+class SlowNodeRule(Rule):
+    """Gray failure: the matched destination answers *every* message, just
+    ``response_delay_ms`` late. When that exceeds the sender's per-message
+    timeout the sender observes a timeout -- exactly what a gray node looks
+    like from an FD's perspective -- while the node itself keeps receiving
+    and processing traffic (it is alive, voting, and will answer probes it
+    receives; only its answers come back too late to matter)."""
+
+    response_delay_ms: int = 0
+
+
+@dataclass(frozen=True)
+class ClockSkewRule(Rule):
+    """Gray failure: the matched *source* node's clock runs at ``rate``×
+    real time, offset by ``offset_ms``. Consulted through
+    :meth:`Nemesis.scheduler_for`, not the message path: the skewed node's
+    timers (FD probe intervals, retry backoff, message deadlines) all fire
+    early or late by the drift while every other node keeps true time."""
+
+    offset_ms: int = 0
+    rate: float = 1.0
+
+
+@dataclass(frozen=True)
+class WireVersionRule(Rule):
+    """Rolling upgrade: the matched *source* node encodes every egress
+    message at wire ``version`` -- round-tripped through the real codec with
+    that version's reserved ``__``-prefixed extension keys injected (newer
+    peer) or optional defaulted fields thinned (older peer) -- proving the
+    mixed-version cluster converges on bytes a same-version cluster never
+    exercises. See messaging/codec.py:wire_roundtrip."""
+
+    version: int = 2
+
+
+# Device-plane behavior of every Rule subclass; tools/check.py lints that
+# this catalog and the set of Rule subclasses in this module stay in sync.
+#   compiled  -- mapped onto the Simulator's fault arrays by apply_plan_at
+#   absorbed  -- invisible to the round model within a documented bound,
+#                outside which _device_rules raises UnsupportedDeviceFault
+RULE_CATALOG = {
+    "DropRule": "compiled",        # -> Simulator.ingress_loss
+    "PartitionRule": "compiled",   # -> Simulator.one_way_ingress_partition
+    "FlipFlopRule": "compiled",    # -> partition toggled at phase edges
+    "LossyLinkRule": "compiled",   # -> Simulator.ingress_loss
+    "SlowNodeRule": "compiled",    # >= one round -> partition-equivalent
+    "DelayRule": "absorbed",       # sub-round latency only
+    "DuplicateRule": "absorbed",   # probe exchanges are idempotent
+    "ReorderRule": "absorbed",     # intra-round reordering only
+    "ClockSkewRule": "absorbed",   # bounded drift never flips a round
+    "WireVersionRule": "absorbed", # wire bytes are not modeled on device
+}
+
+
 class FaultPlan:
     """A seeded, declarative fault schedule (pure data, reusable across runs).
 
@@ -177,9 +251,68 @@ class FaultPlan:
     def __init__(self, seed: int = 0) -> None:
         self.seed = int(seed)
         self.rules: List[Rule] = []
+        # optional WAN latency structure (sim/topology.py LatencyTopology):
+        # every egress decision adds the topology's one-way latency for the
+        # (src, dst) pair; topology_slots maps protocol-plane endpoints to
+        # topology indices (device-plane slots ARE indices)
+        self.topology = None
+        self.topology_slots: Dict[Endpoint, int] = {}
+
+    def with_topology(self, topology,
+                      slots: Optional[Dict[Endpoint, int]] = None) -> "FaultPlan":
+        """Attach a :class:`~.sim.topology.LatencyTopology`. ``slots`` maps
+        each protocol-plane endpoint to its topology index (omit on the
+        device plane, where slot == index)."""
+        self.topology = topology
+        self.topology_slots = dict(slots) if slots else {}
+        return self
+
+    @staticmethod
+    def _check_windows(windows: Tuple[Window, ...]) -> None:
+        """Reject windows that could never fire (a silent no-op fault plan
+        is a test that asserts nothing)."""
+        for start, end in windows:
+            if start < 0:
+                raise ValueError(f"window start {start} < 0")
+            if end is not None and end <= start:
+                raise ValueError(
+                    f"window ({start}, {end}) can never fire: end <= start"
+                )
+
+    @staticmethod
+    def _overlap(a: Tuple[Window, ...], b: Tuple[Window, ...]) -> bool:
+        return any(
+            (e2 is None or s1 < e2) and (e1 is None or s2 < e1)
+            for s1, e1 in a
+            for s2, e2 in b
+        )
+
+    def _check_partition_conflicts(self, rule: Rule) -> None:
+        """A PartitionRule and a FlipFlopRule (or two schedule-bearing
+        partition rules) on the SAME link with overlapping windows
+        contradict each other -- the plain cut masks the flip-flop's healed
+        phases, so the plan silently tests less than it claims."""
+        if not isinstance(rule, (PartitionRule, FlipFlopRule)):
+            return
+        for prior in self.rules:
+            if not isinstance(prior, (PartitionRule, FlipFlopRule)):
+                continue
+            if (prior.match.src, prior.match.dst, prior.at) != (
+                rule.match.src, rule.match.dst, rule.at
+            ):
+                continue
+            if self._overlap(prior.windows, rule.windows):
+                raise ValueError(
+                    f"contradictory partition rules on the same link "
+                    f"{rule.match.src} -> {rule.match.dst}: "
+                    f"{type(prior).__name__}{prior.windows} overlaps "
+                    f"{type(rule).__name__}{rule.windows}"
+                )
 
     def _add(self, rule: Rule) -> "FaultPlan":
         assert rule.at in (EGRESS, INGRESS), rule.at
+        self._check_windows(rule.windows)
+        self._check_partition_conflicts(rule)
         self.rules.append(rule)
         return self
 
@@ -250,6 +383,46 @@ class FaultPlan:
             probability=probability, max_extra_ms=max_extra_ms,
         ))
 
+    def lossy_link(self, probability: float, src: Optional[Endpoint] = None,
+                   dst: Optional[Endpoint] = None, msg_types=None,
+                   windows: Tuple[Window, ...] = _ALWAYS,
+                   at: str = EGRESS) -> "FaultPlan":
+        if not 0.0 < probability < 1.0:
+            raise ValueError(
+                f"a lossy link drops some but not all traffic; p="
+                f"{probability} is a {'partition' if probability == 1.0 else 'no-op'}"
+            )
+        return self._add(LossyLinkRule(
+            match=self._match(src, dst, msg_types), at=at, windows=windows,
+            probability=probability,
+        ))
+
+    def slow_node(self, node: Endpoint, response_delay_ms: int,
+                  windows: Tuple[Window, ...] = _ALWAYS) -> "FaultPlan":
+        assert response_delay_ms >= 1, response_delay_ms
+        return self._add(SlowNodeRule(
+            match=self._match(None, node, None), at=EGRESS, windows=windows,
+            response_delay_ms=response_delay_ms,
+        ))
+
+    def clock_skew(self, node: Endpoint, offset_ms: int = 0,
+                   rate: float = 1.0) -> "FaultPlan":
+        if rate <= 0.0:
+            raise ValueError(f"clock rate must be positive, got {rate}")
+        # no windows: a clock that jumps mid-run would retroactively reorder
+        # already-scheduled timers, which no real skewed clock does
+        return self._add(ClockSkewRule(
+            match=self._match(node, None, None), at=EGRESS, windows=_ALWAYS,
+            offset_ms=offset_ms, rate=rate,
+        ))
+
+    def wire_version(self, node: Endpoint, version: int,
+                     windows: Tuple[Window, ...] = _ALWAYS) -> "FaultPlan":
+        return self._add(WireVersionRule(
+            match=self._match(node, None, None), at=EGRESS, windows=windows,
+            version=version,
+        ))
+
 
 @dataclass
 class Decision:
@@ -259,6 +432,50 @@ class Decision:
     delay_ms: int = 0
     duplicates: int = 0
     reordered: bool = False
+    # gray-failure extensions: slow_ms is the destination's response latency
+    # (sender sees a timeout when it exceeds the message deadline, but the
+    # message is still delivered); wire_version re-encodes the message
+    # through the versioned codec round-trip
+    slow_ms: int = 0
+    wire_version: Optional[int] = None
+
+
+class SkewedScheduler(Scheduler):
+    """A node's drifted view of the shared clock (ClockSkewRule).
+
+    ``now_ms`` reads ``rate * true + offset_ms``; a delay the node asks for
+    in its own time costs ``delay / rate`` of true time (a fast clock fires
+    its timers early). Purely arithmetic over the wrapped scheduler, so
+    virtual-time determinism is untouched -- the skewed node's events still
+    land at exact integer virtual times."""
+
+    def __init__(self, inner: Scheduler, offset_ms: int = 0,
+                 rate: float = 1.0) -> None:
+        assert rate > 0.0, rate
+        self.inner = inner
+        self.offset_ms = int(offset_ms)
+        self.rate = float(rate)
+
+    def now_ms(self) -> int:
+        return int(self.inner.now_ms() * self.rate) + self.offset_ms
+
+    def _true_delay(self, delay_ms: int) -> int:
+        return max(0, int(round(delay_ms / self.rate)))
+
+    def schedule(self, delay_ms, fn):
+        return self.inner.schedule(self._true_delay(delay_ms), fn)
+
+    def schedule_at_fixed_rate(self, initial_delay_ms, period_ms, fn):
+        return self.inner.schedule_at_fixed_rate(
+            self._true_delay(initial_delay_ms),
+            max(1, self._true_delay(period_ms)), fn,
+        )
+
+    def execute(self, fn) -> None:
+        self.inner.execute(fn)
+
+    def shutdown(self) -> None:
+        pass  # the true scheduler is shared; its owner shuts it down
 
 
 class Nemesis:
@@ -274,6 +491,10 @@ class Nemesis:
         # (rule index, src str, dst str) -> decisions drawn so far
         self._seq: Dict[Tuple[int, str, str], int] = {}
         self._lock = threading.Lock()
+        # one skewed clock per ClockSkewRule'd node, cached so every consumer
+        # of a node's clock (client deadlines, FD intervals, retry backoff)
+        # shares the same drifted view
+        self._skewed: Dict[Endpoint, Scheduler] = {}
 
     # -- clock ---------------------------------------------------------------
 
@@ -314,6 +535,27 @@ class Nemesis:
         tag = str(address).encode() if address is not None else b"?"
         return random.Random(self.plan.seed ^ zlib.crc32(tag))
 
+    def scheduler_for(self, address: Optional[Endpoint]) -> Scheduler:
+        """The clock ``address`` lives by: the shared scheduler, or its
+        drifted wrapper when a ClockSkewRule names the node. Harnesses build
+        each node's timers against this seam, so one skewed node perturbs
+        its own FD deadlines and retry backoff while the rest of the cluster
+        keeps true time."""
+        if address is None:
+            return self.scheduler
+        cached = self._skewed.get(address)
+        if cached is not None:
+            return cached
+        for rule in self.plan.rules:
+            if isinstance(rule, ClockSkewRule) and rule.match.src == address:
+                skewed = SkewedScheduler(
+                    self.scheduler, offset_ms=rule.offset_ms, rate=rule.rate
+                )
+                self._skewed[address] = skewed
+                return skewed
+        self._skewed[address] = self.scheduler
+        return self.scheduler
+
     def decide(self, src: Optional[Endpoint], dst: Optional[Endpoint],
                msg: RapidMessage, at: str) -> Decision:
         t = self.plan_now_ms()
@@ -345,6 +587,20 @@ class Nemesis:
                     )
                     out.delay_ms += min(held, rule.max_extra_ms)
                     out.reordered = True
+            elif isinstance(rule, SlowNodeRule):
+                out.slow_ms = max(out.slow_ms, rule.response_delay_ms)
+            elif isinstance(rule, WireVersionRule):
+                out.wire_version = rule.version
+            # ClockSkewRule is consulted via scheduler_for, not per message
+        topo = self.plan.topology
+        if topo is not None and at == EGRESS:
+            # WAN latency structure: the topology's one-way delay applies to
+            # every message whose endpoints are placed (egress only, so
+            # wrapping both halves of a node never doubles the RTT)
+            si = self.plan.topology_slots.get(src)
+            di = self.plan.topology_slots.get(dst)
+            if si is not None and di is not None:
+                out.delay_ms += topo.one_way_ms(si, di)
         return out
 
 
@@ -381,12 +637,15 @@ class NemesisClient(IMessagingClient):
             settings if settings is not None
             else inherited if inherited is not None else Settings()
         )
+        # the clock this node lives by: drifted when a ClockSkewRule names
+        # it, so its timeouts/backoff/deadlines all skew together
+        self._sched = nemesis.scheduler_for(self.address)
 
     def send_message(self, remote: Endpoint, msg: RapidMessage) -> Promise:
         return call_with_retries(
             lambda: self._attempt(remote, msg),
             self._settings.message_retries,
-            scheduler=self._nem.scheduler,
+            scheduler=self._sched,
             policy=self._settings.retry_policy(),
             deadline_ms=self._settings.deadline_for(msg),
             rng=self._nem.retry_rng(self.address),
@@ -403,13 +662,18 @@ class NemesisClient(IMessagingClient):
         # labeled by fault application point and message type; unlabeled
         # reads (metrics.get("nemesis_dropped")) sum across the label sets
         kind = type(msg).__name__
+        if d.wire_version is not None:
+            from .messaging.codec import wire_roundtrip
+
+            metrics.incr("nemesis_wire_versioned", at="egress", msg=kind)
+            msg = wire_roundtrip(msg, d.wire_version)
         if d.drop:
             metrics.incr("nemesis_dropped", at="egress", msg=kind)
             # dropped on the wire: the sender only ever sees its per-message
             # deadline expire, exactly like the in-process fabric's filters
             out: Promise = Promise()
             timeout = self._settings.timeout_for(msg)
-            self._nem.scheduler.schedule(
+            self._sched.schedule(
                 timeout,
                 lambda: out.try_set_exception(TimeoutError(
                     f"nemesis dropped {type(msg).__name__} to {remote}"
@@ -419,6 +683,30 @@ class NemesisClient(IMessagingClient):
         for _ in range(d.duplicates):
             metrics.incr("nemesis_duplicated", at="egress", msg=kind)
             self.inner.send_message_best_effort(remote, msg)
+        if d.slow_ms > 0:
+            # gray node: the message IS delivered (and answered) slow_ms
+            # late; the sender's own deadline decides whether that answer
+            # still counts. Past the timeout this is indistinguishable from
+            # a drop at the sender -- which is the whole failure mode.
+            metrics.incr("nemesis_slowed", at="egress", msg=kind)
+            out = Promise()
+            total = d.slow_ms + d.delay_ms
+            self._nem.scheduler.schedule(
+                total,
+                lambda: self.inner.send_message_best_effort(
+                    remote, msg
+                ).add_callback(lambda p: _pipe(p, out)),
+            )
+            timeout = self._settings.timeout_for(msg)
+            if total >= timeout:
+                self._sched.schedule(
+                    timeout,
+                    lambda: out.try_set_exception(TimeoutError(
+                        f"{remote} answered {total} ms late "
+                        f"(> {timeout} ms timeout)"
+                    )),
+                )
+            return out
         if d.delay_ms > 0:
             metrics.incr(
                 "nemesis_reordered" if d.reordered else "nemesis_delayed",
@@ -524,8 +812,19 @@ def _device_rules(plan: FaultPlan, round_ms: int) -> List[Tuple[int, Rule]]:
     """
     out: List[Tuple[int, Rule]] = []
     for idx, rule in enumerate(plan.rules):
-        if isinstance(rule, (DuplicateRule, ReorderRule)):
-            continue  # idempotent / intra-round: invisible to the round model
+        if isinstance(rule, (DuplicateRule, ReorderRule, WireVersionRule)):
+            # idempotent / intra-round / byte-level: invisible to the round
+            # model (the device plane never serializes wire frames)
+            continue
+        if isinstance(rule, ClockSkewRule):
+            if not 0.5 <= rule.rate <= 2.0:
+                raise UnsupportedDeviceFault(
+                    f"clock-skew rule {idx}: rate {rule.rate} outside "
+                    "[0.5, 2.0] -- drift that extreme can flip round "
+                    "outcomes, which the global-clock round model cannot "
+                    "express"
+                )
+            continue  # bounded drift shifts timings, never round outcomes
         if isinstance(rule, DelayRule):
             if rule.base_ms + rule.jitter_ms >= round_ms:
                 raise UnsupportedDeviceFault(
@@ -534,6 +833,8 @@ def _device_rules(plan: FaultPlan, round_ms: int) -> List[Tuple[int, Rule]]:
                     "latency"
                 )
             continue  # sub-round latency is absorbed by the round model
+        if isinstance(rule, SlowNodeRule) and rule.response_delay_ms < round_ms:
+            continue  # answers within the round: the probe still succeeds
         if rule.match.src is not None:
             raise UnsupportedDeviceFault(
                 f"rule {idx}: per-source link faults have no device "
@@ -594,6 +895,8 @@ def apply_plan_at(sim, plan: FaultPlan, t_ms: int,
     slots = slots if slots is not None else endpoint_slots(sim)
     round_ms = sim.config.fd_interval_ms // sim.config.rounds_per_interval
     sim.clear_link_faults()
+    if plan.topology is not None:
+        _apply_topology_delays(sim, plan.topology)
     cut: List[int] = []
     for idx, rule in _device_rules(plan, round_ms):
         if not rule.active_at(t_ms):
@@ -602,12 +905,53 @@ def apply_plan_at(sim, plan: FaultPlan, t_ms: int,
             targets = [slots[rule.match.dst]]
         else:
             targets = [s for s in range(sim.config.capacity) if sim.active[s]]
-        if isinstance(rule, (PartitionRule, FlipFlopRule)):
+        if isinstance(rule, (PartitionRule, FlipFlopRule, SlowNodeRule)):
+            # a node answering slower than the probe deadline is, to every
+            # observer, a node whose probes all fail: partition-equivalent
             cut.extend(targets)
-        elif isinstance(rule, DropRule):
+        elif isinstance(rule, DropRule):  # incl. LossyLinkRule
             sim.ingress_loss(np.asarray(targets), rule.probability)
     if cut:
         sim.one_way_ingress_partition(np.asarray(sorted(set(cut))))
+
+
+def apply_topology(sim, topology) -> None:
+    """Compile a :class:`~.sim.topology.LatencyTopology` onto a Simulator:
+    zones become delivery groups, and inter-zone one-way latency >= one
+    round becomes ``delay_broadcasts`` rounds (sub-round latency is absorbed
+    by the round model, the same rule DelayRule compilation follows).
+    Requires ``sim.config.groups >= zones`` and ``max_delivery_delay`` large
+    enough for the widest tier."""
+    import numpy as np
+
+    round_ms = sim.config.fd_interval_ms // sim.config.rounds_per_interval
+    groups = topology.group_assignment(sim.config.capacity)
+    n_zones = int(groups.max()) + 1
+    if sim.config.groups < n_zones:
+        raise UnsupportedDeviceFault(
+            f"topology has {n_zones} zones but sim.config.groups="
+            f"{sim.config.groups}"
+        )
+    sim.set_delivery_groups(groups)
+    _apply_topology_delays(sim, topology)
+
+
+def _apply_topology_delays(sim, topology) -> None:
+    """Re-arm the inter-zone broadcast delays (clear_link_faults wipes the
+    delay arrays, so apply_plan_at re-applies these each schedule segment)."""
+    import numpy as np
+
+    round_ms = sim.config.fd_interval_ms // sim.config.rounds_per_interval
+    groups = topology.group_assignment(sim.config.capacity)
+    n_zones = int(groups.max()) + 1
+    slots = np.arange(sim.config.capacity)
+    for receiver in range(n_zones):
+        for sender in range(n_zones):
+            if receiver == sender:
+                continue
+            rounds = topology.delay_rounds(sender, receiver, round_ms)
+            if rounds > 0:
+                sim.delay_broadcasts(receiver, slots[groups == sender], rounds)
 
 
 def replay_on_simulator(sim, plan: FaultPlan, duration_ms: int,
@@ -619,6 +963,8 @@ def replay_on_simulator(sim, plan: FaultPlan, duration_ms: int,
     slots = endpoint_slots(sim)
     round_ms = sim.config.fd_interval_ms // sim.config.rounds_per_interval
     rules = _device_rules(plan, round_ms)
+    if plan.topology is not None:
+        apply_topology(sim, plan.topology)
     epoch = sim.virtual_ms
     prior_changes = len(sim.view_changes)
     times = _boundaries(rules, duration_ms, round_ms)

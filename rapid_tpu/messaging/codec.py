@@ -207,6 +207,60 @@ def encode(request_no: int, msg: Any) -> bytes:
     return ENVELOPE.pack(request_no, tag) + body
 
 
+# The wire dialect this codec natively speaks. Rolling upgrades are modeled
+# relative to it (faults.py WireVersionRule): a NEWER dialect adds reserved
+# "__"-prefixed envelope keys (which every decoder since PR 3 strips) and
+# thins optional fields whose value equals the dataclass default (which
+# every decoder reconstructs via cls(**kwargs) defaulting); an OLDER dialect
+# (< 1) predates the "__tc" trace-context extension and omits it.
+WIRE_VERSION = 1
+
+
+def encode_versioned(request_no: int, msg: Any, version: int) -> bytes:
+    """Encode ``msg`` as a peer speaking wire dialect ``version`` would.
+
+    ``version == WIRE_VERSION`` matches :func:`encode` byte-for-byte (minus
+    the large-body memo). The bytes differ across versions; the decoded
+    message must not -- that invariant is what rolling-upgrade replay pins.
+    """
+    import dataclasses as _dc
+
+    tag = _TAG_OF[type(msg)]
+    fields = _fields_of(msg)
+    if version > WIRE_VERSION:
+        payload = {}
+        defaults = {
+            f.name: f.default for f in _dc.fields(msg)
+            if f.default is not _dc.MISSING
+        }
+        for name, value in fields.items():
+            # stripped optional tags: a newer encoder omits what the decoder
+            # reconstructs (dataclass defaults), shrinking its frames
+            if name in defaults and value == defaults[name]:
+                continue
+            payload[name] = _enc(value)
+        # extra reserved fields a current decoder has never seen; the
+        # "__"-stripping rule must make them invisible
+        payload[f"__v{version}"] = version
+        payload[f"__v{version}_ext"] = {"reserved": [version, "future"]}
+    else:
+        payload = {k: _enc(v) for k, v in fields.items()}
+    ctx = trace_context_of(msg)
+    if ctx is not None and version >= 1:
+        payload["__tc"] = ctx.to_wire()
+    body = msgpack.packb(payload, use_bin_type=True)
+    return ENVELOPE.pack(request_no, tag) + body
+
+
+def wire_roundtrip(msg: Any, version: int) -> Any:
+    """``msg`` as a ``version``-speaking peer would put it on the wire and a
+    current peer would read it back. Equality with the original (modulo a
+    dropped trace context below version 1) is the forward/backward-compat
+    contract the rolling-upgrade nemesis replays on live traffic."""
+    _, out = decode(encode_versioned(0, msg, version))
+    return out
+
+
 def decode(frame: bytes) -> Tuple[int, Any]:
     request_no, tag = ENVELOPE.unpack_from(frame)
     cls = _TYPES[tag]

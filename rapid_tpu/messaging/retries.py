@@ -94,7 +94,8 @@ def call_with_retries(
       fails the promise with :class:`RetryDeadlineExceeded` chaining the last
       attempt's error. Requires ``scheduler``.
     - ``metrics``: optional :class:`~..observability.Metrics`; counts
-      ``retry_attempts`` / ``retry_exhausted`` / ``retry_deadline_exceeded``.
+      ``retry_attempts`` / ``retry_exhausted`` / ``retry_deadline_exceeded``
+      and observes each realized backoff into ``retry_backoff_ms``.
     """
     out: Promise = Promise()
     policy = policy if policy is not None else RetryPolicy()
@@ -146,6 +147,11 @@ def call_with_retries(
                 out.try_set_exception(dead)
             return
         if delay > 0:
+            if metrics is not None:
+                # the realized jitter schedule, observable next to fd.rtt_ms:
+                # under a DelayRule'd or slow link the histogram shows how
+                # backoff and the per-message deadline split the budget
+                metrics.observe("retry_backoff_ms", delay)
             scheduler.schedule(delay, lambda: run(remaining - 1))
         else:
             run(remaining - 1)

@@ -11,6 +11,7 @@ times before counting as failure (:44,100-106).
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Callable, Deque, Optional
 
@@ -23,6 +24,13 @@ from .base import IEdgeFailureDetectorFactory
 FAILURE_THRESHOLD = 10
 BOOTSTRAP_COUNT_THRESHOLD = 30
 
+# EWMA smoothing for the per-edge RTT estimate (TCP SRTT's classic alpha)
+_RTT_ALPHA = 0.125
+
+
+def _wall_ms() -> int:
+    return int(time.monotonic() * 1000)
+
 
 class PingPongFailureDetector:
     def __init__(
@@ -33,6 +41,7 @@ class PingPongFailureDetector:
         notifier: Callable[[], None],
         failure_threshold: int = FAILURE_THRESHOLD,
         metrics: Optional[Metrics] = None,
+        clock: Optional[Callable[[], int]] = None,
     ) -> None:
         self._address = address
         self._subject = subject
@@ -40,13 +49,25 @@ class PingPongFailureDetector:
         self._notifier = notifier
         self._failure_threshold = failure_threshold
         self._metrics = metrics if metrics is not None else global_metrics()
+        # ``clock``: ms source for RTT measurement -- the node's scheduler
+        # clock when available (virtual-time determinism; also the seam a
+        # ClockSkewRule drifts), else the wall clock
+        self._clock = clock if clock is not None else _wall_ms
         self._failure_count = 0
         self._bootstrap_response_count = 0
         self._notified = False
         self._probe = ProbeMessage(sender=address)
+        self._rtt_ms: Optional[float] = None  # per-edge EWMA estimate
 
     def has_failed(self) -> bool:
         return self._failure_count >= self._failure_threshold
+
+    def rtt_ms(self) -> Optional[float]:
+        """Smoothed probe round-trip estimate for this edge (None until the
+        first answered probe). The observable that separates a gray node
+        from a dead one: a SlowNodeRule victim inside the timeout shows an
+        inflated estimate here long before any eviction."""
+        return self._rtt_ms
 
     def __call__(self) -> None:
         if self.has_failed() and not self._notified:
@@ -54,9 +75,22 @@ class PingPongFailureDetector:
             self._notifier()
         else:
             self._metrics.incr("fd.probes")
+            sent_ms = self._clock()
             self._client.send_message_best_effort(
                 self._subject, self._probe
-            ).add_callback(self._on_probe_done)
+            ).add_callback(lambda p: self._on_probe_result(p, sent_ms))
+
+    def _on_probe_result(self, promise: Promise, sent_ms: int) -> None:
+        if promise.exception() is None and isinstance(
+            promise.peek(), ProbeResponse
+        ):
+            rtt = max(0, self._clock() - sent_ms)
+            self._metrics.observe("fd.rtt_ms", rtt)
+            self._rtt_ms = (
+                float(rtt) if self._rtt_ms is None
+                else (1 - _RTT_ALPHA) * self._rtt_ms + _RTT_ALPHA * rtt
+            )
+        self._on_probe_done(promise)
 
     def _record_failure(self) -> None:
         self._failure_count += 1
@@ -79,11 +113,13 @@ class PingPongFailureDetector:
 class PingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
     def __init__(self, address: Endpoint, client: IMessagingClient,
                  failure_threshold: int = FAILURE_THRESHOLD,
-                 metrics: Optional[Metrics] = None) -> None:
+                 metrics: Optional[Metrics] = None,
+                 clock: Optional[Callable[[], int]] = None) -> None:
         self._address = address
         self._client = client
         self._failure_threshold = failure_threshold
         self._metrics = metrics
+        self._clock = clock
 
     def create_instance(
         self, subject: Endpoint, notifier: Callable[[], None]
@@ -91,6 +127,7 @@ class PingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
         return PingPongFailureDetector(
             self._address, subject, self._client, notifier,
             self._failure_threshold, metrics=self._metrics,
+            clock=self._clock,
         )
 
 
@@ -101,8 +138,10 @@ class WindowedPingPongFailureDetector(PingPongFailureDetector):
 
     def __init__(self, address, subject, client, notifier,
                  window: int = 10, threshold: float = 0.4,
-                 metrics: Optional[Metrics] = None) -> None:
-        super().__init__(address, subject, client, notifier, metrics=metrics)
+                 metrics: Optional[Metrics] = None,
+                 clock: Optional[Callable[[], int]] = None) -> None:
+        super().__init__(address, subject, client, notifier, metrics=metrics,
+                         clock=clock)
         self._window: Deque[bool] = deque(maxlen=window)
         self._threshold = threshold
 
@@ -125,15 +164,18 @@ class WindowedPingPongFailureDetector(PingPongFailureDetector):
 class WindowedPingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
     def __init__(self, address: Endpoint, client: IMessagingClient,
                  window: int = 10, threshold: float = 0.4,
-                 metrics: Optional[Metrics] = None) -> None:
+                 metrics: Optional[Metrics] = None,
+                 clock: Optional[Callable[[], int]] = None) -> None:
         self._address = address
         self._client = client
         self._window = window
         self._threshold = threshold
         self._metrics = metrics
+        self._clock = clock
 
     def create_instance(self, subject, notifier):
         return WindowedPingPongFailureDetector(
             self._address, subject, self._client, notifier,
             self._window, self._threshold, metrics=self._metrics,
+            clock=self._clock,
         )

@@ -63,6 +63,7 @@ METRIC_CATALOG = frozenset({
     # failure detectors (monitoring/)
     "fd.probes",
     "fd.probe_failures",
+    "fd.rtt_ms",  # per-probe round trip (the gray-node observable)
     # cut detection (cut_detector.py)
     "cut.proposals_emitted",
     # consensus (fast_paxos.py / paxos.py)
@@ -79,10 +80,14 @@ METRIC_CATALOG = frozenset({
     "nemesis_delayed",
     "nemesis_reordered",
     "nemesis_passed",
+    "nemesis_slowed",          # SlowNodeRule applied (gray node)
+    "nemesis_wire_versioned",  # WireVersionRule codec round-trip applied
+    "nemesis_zone_detection_ms",  # per-zone detection->decision (scenarios)
     # retry combinator (messaging/retries.py)
     "retry_attempts",
     "retry_exhausted",
     "retry_deadline_exceeded",
+    "retry_backoff_ms",
     # simulator (sim/driver.py)
     "rounds",
     "device_dispatches",

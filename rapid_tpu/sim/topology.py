@@ -188,6 +188,103 @@ class VirtualCluster:
         )
 
 
+@dataclass(frozen=True)
+class LatencyTopology:
+    """Deterministic rack/zone/region placement with a tiered RTT model.
+
+    Node ``i`` lives in rack ``i % racks``, zone ``rack % zones``, region
+    ``zone % regions`` -- pure functions of the index, so the same topology
+    object describes the protocol plane (endpoints mapped to indices by the
+    fault plane) and the device plane (slots ARE indices) with no shared
+    state. The RTT between two nodes is the widest tier that separates them:
+
+        same rack    -> rack_rtt_ms      (ToR switch hop)
+        same zone    -> zone_rtt_ms      (aggregation fabric)
+        same region  -> region_rtt_ms    (inter-zone backbone)
+        cross-region -> inter_region_rtt_ms  (WAN)
+
+    Everything derives from these five integers; there is no RNG anywhere,
+    so a topology is replayable bit-identically wherever it is consulted.
+    """
+
+    racks: int = 4
+    zones: int = 2
+    regions: int = 1
+    rack_rtt_ms: int = 0
+    zone_rtt_ms: int = 1
+    region_rtt_ms: int = 2
+    inter_region_rtt_ms: int = 150
+
+    def __post_init__(self) -> None:
+        if not (self.racks >= self.zones >= self.regions >= 1):
+            raise ValueError(
+                f"need racks >= zones >= regions >= 1, got "
+                f"{self.racks}/{self.zones}/{self.regions}"
+            )
+        if not (0 <= self.rack_rtt_ms <= self.zone_rtt_ms
+                <= self.region_rtt_ms <= self.inter_region_rtt_ms):
+            raise ValueError("tier RTTs must be non-decreasing outward")
+
+    # -- placement (pure functions of the node index) -----------------------
+
+    def rack_of(self, i: int) -> int:
+        return i % self.racks
+
+    def zone_of(self, i: int) -> int:
+        return self.rack_of(i) % self.zones
+
+    def region_of(self, i: int) -> int:
+        return self.zone_of(i) % self.regions
+
+    # -- latency -------------------------------------------------------------
+
+    def rtt_ms(self, i: int, j: int) -> int:
+        if i == j:
+            return 0
+        if self.region_of(i) != self.region_of(j):
+            return self.inter_region_rtt_ms
+        if self.zone_of(i) != self.zone_of(j):
+            return self.region_rtt_ms
+        if self.rack_of(i) != self.rack_of(j):
+            return self.zone_rtt_ms
+        return self.rack_rtt_ms
+
+    def one_way_ms(self, i: int, j: int) -> int:
+        return self.rtt_ms(i, j) // 2
+
+    def rtt_matrix(self, n: int) -> np.ndarray:
+        """[n, n] int32 RTT matrix, vectorized over the tier comparisons."""
+        idx = np.arange(n, dtype=np.int64)
+        rack = idx % self.racks
+        zone = rack % self.zones
+        region = zone % self.regions
+        out = np.full((n, n), self.rack_rtt_ms, dtype=np.int32)
+        out[rack[:, None] != rack[None, :]] = self.zone_rtt_ms
+        out[zone[:, None] != zone[None, :]] = self.region_rtt_ms
+        out[region[:, None] != region[None, :]] = self.inter_region_rtt_ms
+        np.fill_diagonal(out, 0)
+        return out
+
+    # -- device-plane compilation helpers ------------------------------------
+
+    def group_assignment(self, capacity: int) -> np.ndarray:
+        """Per-slot delivery group (= zone id) for
+        ``Simulator.set_delivery_groups``: zones are the unit of broadcast
+        heterogeneity on the device plane."""
+        idx = np.arange(capacity, dtype=np.int64)
+        return ((idx % self.racks) % self.zones).astype(np.int32)
+
+    def delay_rounds(self, zone_a: int, zone_b: int, round_ms: int) -> int:
+        """One-way broadcast latency between two zones in whole device
+        rounds (floor: sub-round latency is absorbed by the round model,
+        mirroring the fault plane's DelayRule compilation rule)."""
+        if zone_a == zone_b:
+            return 0
+        if zone_a % self.regions != zone_b % self.regions:
+            return (self.inter_region_rtt_ms // 2) // round_ms
+        return (self.region_rtt_ms // 2) // round_ms
+
+
 def build_adjacency(
     cluster: VirtualCluster, active: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:

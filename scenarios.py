@@ -1,17 +1,40 @@
 """Run every BASELINE.json configuration and report time-to-stable-view.
 
 Prints one JSON line per scenario:
-  {"config", "n", "virtual_ms", "wall_s", "cut_ok", ...}
+  {"scenario", "config", "n", "virtual_ms", "wall_s", "cut_ok", ...}
 
 - virtual_ms: protocol time a real cluster would need (FD rounds + batching).
 - wall_s: simulation wall time on this host/chip.
 - cut_ok: the decided cut equals the injected fault set (cut-set parity).
 
-Scenario 1 is the cross-plane parity config: the *protocol plane* (full
-object-model cluster with real message passing on the deterministic
-virtual-time scheduler) and the *simulation plane* run the same 10-node
-membership with the same crash; their cuts, final memberships, and
-configuration behavior must agree.
+Every scenario is registered by name in REGISTRY with its parameters bound
+once (seed, scale, label), so the battery, ``--list`` and ``--scenario NAME``
+all read the same table instead of hand-rolling per-entry wiring:
+
+  python scenarios.py                  # the default battery
+  python scenarios.py --list           # names + parameters, no jax needed
+  python scenarios.py --scenario gray-slow-node [--seed 9]
+  python scenarios.py --fault-plan [seed]   # nemesis pair (protocol+device)
+  python scenarios.py --scale-1m       # battery + the 1M-node targets
+
+Scenario "cross-plane-10" is the cross-plane parity config: the *protocol
+plane* (full object-model cluster with real message passing on the
+deterministic virtual-time scheduler) and the *simulation plane* run the
+same 10-node membership with the same crash; their cuts, final memberships,
+and configuration behavior must agree.
+
+The gray-failure quartet (ISSUE 6) rides the same registry:
+
+- wan-zone-loss: a LatencyTopology (racks/zones/regions) compiled onto the
+  device plane's delivery groups + broadcast-delay rounds, then one whole
+  zone partitioned; reports per-zone detection->decision latency.
+- gray-slow-node: a node that answers EVERY message, just slower than the
+  probe deadline -- alive, processing, and evicted with zero collateral.
+- clock-skew: one node's entire timer stack runs on a drifted clock while
+  the cluster churns through a join + a crash around it.
+- rolling-upgrade: a mixed wire-version cluster (half the nodes encode with
+  reserved ``__``-prefixed extension keys / thinned optional fields)
+  converging through a join + removal wave under probe loss.
 """
 
 import json
@@ -38,9 +61,44 @@ def recomputed_config_id(sim) -> int:
     )
 
 
-def scenario_10_node_cross_plane():
+# ---------------------------------------------------------------------------
+# registry: one table binding scenario name -> (function, bound parameters);
+# the battery, --list and --scenario all read it (previously each main()
+# entry hand-rolled its own seed/label wiring)
+# ---------------------------------------------------------------------------
+
+REGISTRY: "dict[str, tuple]" = {}
+
+
+def register(name: str, fn, **params) -> None:
+    assert name not in REGISTRY, f"duplicate scenario name {name!r}"
+    REGISTRY[name] = (fn, params)
+
+
+def run_scenario(name: str, seed: "int | None" = None) -> dict:
+    """Run one registered scenario; ``seed`` overrides the bound seed."""
+    fn, params = REGISTRY[name]
+    if seed is not None:
+        params = {**params, "seed": seed}
+    result = fn(**params)
+    result["scenario"] = name
+    return result
+
+
+def _bootstrap(h, n: int) -> None:
+    """Sequential bootstrap to n nodes with per-step agreement, the armed
+    nemesis dormant (windows shifted to a far-future epoch) so fault windows
+    cannot starve join alerts; callers re-arm at plan-time zero afterwards."""
+    h.nemesis.arm(epoch_ms=1 << 40)
+    h.start_seed(0)
+    for i in range(1, n):
+        h.join(i)
+        h.wait_and_verify_agreement(i + 1)
+
+
+def scenario_10_node_cross_plane(seed=1):
     """10-node ring, 1 crash-stop: protocol plane vs simulation plane."""
-    
+
     from rapid_tpu import Endpoint
     from rapid_tpu.membership import MembershipView
     from rapid_tpu.sim.driver import Simulator
@@ -50,7 +108,7 @@ def scenario_10_node_cross_plane():
 
     t0 = time.perf_counter()
     # protocol plane
-    h = ClusterHarness(seed=1)
+    h = ClusterHarness(seed=seed)
     h.create_cluster(10, parallel=False)
     h.wait_and_verify_agreement(10)
     victim = h.addr(9)
@@ -62,7 +120,7 @@ def scenario_10_node_cross_plane():
     h.shutdown()
 
     # simulation plane: same shape of fault
-    sim = Simulator(10, seed=1)
+    sim = Simulator(10, seed=seed)
     sim.crash(np.array([9]))
     rec = sim.run_until_decision(max_rounds=40)
     cut_ok = rec is not None and list(rec.cut) == [9]
@@ -177,7 +235,7 @@ def scenario_flip_flop_with_join_wave(n, capacity, seed):
     }
 
 
-def scenario_nemesis_protocol(plan_seed=7, n=5):
+def scenario_nemesis_protocol(seed=7, n=5):
     """The protocol-plane leg of the nemesis run: the same FaultPlan class
     (one-way partition of one node) armed over an in-process virtual-time
     cluster with real ping-pong failure detectors. Rides the telemetry
@@ -190,14 +248,10 @@ def scenario_nemesis_protocol(plan_seed=7, n=5):
     from harness import ClusterHarness
 
     t0 = time.perf_counter()
-    h = ClusterHarness(seed=plan_seed, use_static_fd=False)
+    h = ClusterHarness(seed=seed, use_static_fd=False)
     victim = h.addr(n - 1)
-    h.with_faults(FaultPlan(seed=plan_seed).partition_one_way(dst=victim))
-    h.nemesis.arm(epoch_ms=1 << 40)  # windows far away during bootstrap
-    h.start_seed(0)
-    for i in range(1, n):
-        h.join(i)
-        h.wait_and_verify_agreement(i + 1)
+    h.with_faults(FaultPlan(seed=seed).partition_one_way(dst=victim))
+    _bootstrap(h, n)
     h.nemesis.arm()  # plan time zero = now: the partition opens
     start_virtual = h.scheduler.now_ms()
     vic = h.instances.pop(victim)
@@ -214,7 +268,7 @@ def scenario_nemesis_protocol(plan_seed=7, n=5):
     return {
         "config": (
             f"nemesis protocol plane: {n} in-process nodes, windowed "
-            f"one-way partition (plan seed {plan_seed})"
+            f"one-way partition (plan seed {seed})"
         ),
         "n": n,
         "virtual_ms": virtual_ms,
@@ -226,7 +280,7 @@ def scenario_nemesis_protocol(plan_seed=7, n=5):
     }
 
 
-def scenario_nemesis_smoke(n=1000, plan_seed=7):
+def scenario_nemesis_smoke(n=1000, seed=7):
     """One seeded FaultPlan compiled onto the device plane's fault arrays
     (rapid_tpu/faults.py): a 1% wave of one-way partitions whose windows
     open 2 s into the run, driven through every schedule boundary by
@@ -235,13 +289,13 @@ def scenario_nemesis_smoke(n=1000, plan_seed=7):
     from rapid_tpu.faults import FaultPlan, endpoint_slots, replay_on_simulator
     from rapid_tpu.sim.driver import Simulator
 
-    sim = Simulator(n, seed=plan_seed)
+    sim = Simulator(n, seed=seed)
     by_slot = {slot: ep for ep, slot in endpoint_slots(sim).items()}
-    rng = np.random.default_rng(plan_seed)
+    rng = np.random.default_rng(seed)
     victims = sorted(
         int(v) for v in rng.choice(n, size=max(1, n // 100), replace=False)
     )
-    plan = FaultPlan(seed=plan_seed)
+    plan = FaultPlan(seed=seed)
     for v in victims:
         plan.partition_one_way(dst=by_slot[v], windows=((2000, None),))
     t0 = time.perf_counter()
@@ -251,7 +305,7 @@ def scenario_nemesis_smoke(n=1000, plan_seed=7):
     return {
         "config": (
             f"nemesis smoke: {len(victims)} windowed one-way partitions "
-            f"(plan seed {plan_seed})"
+            f"(plan seed {seed})"
         ),
         "n": n,
         "virtual_ms": records[-1].virtual_time_ms if records else None,
@@ -262,6 +316,277 @@ def scenario_nemesis_smoke(n=1000, plan_seed=7):
             and records[-1].configuration_id == recomputed_config_id(sim)
         ),
     }
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: gray failures + WAN topology
+# ---------------------------------------------------------------------------
+
+
+def scenario_wan_zone_loss(seed=11, n=1024):
+    """WAN device plane: a 16-rack / 8-zone / 2-region LatencyTopology with a
+    1000 ms inter-region RTT compiled onto delivery groups + broadcast-delay
+    rounds, then every node of one zone one-way partitioned 2 s in. Reports
+    per-zone detection->decision latency (also observed into the
+    nemesis_zone_detection_ms histogram, so --metrics-out / --trace-out
+    exports carry it)."""
+    from rapid_tpu.faults import FaultPlan, endpoint_slots, replay_on_simulator
+    from rapid_tpu.observability import global_metrics
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.sim.engine import SimConfig
+    from rapid_tpu.sim.topology import LatencyTopology
+
+    topo = LatencyTopology(racks=16, zones=8, regions=2,
+                           rack_rtt_ms=0, zone_rtt_ms=2, region_rtt_ms=4,
+                           inter_region_rtt_ms=1000)
+    config = SimConfig(capacity=n, groups=8, max_delivery_delay=2,
+                       rounds_per_interval=4)
+    sim = Simulator(n, config=config, seed=seed)
+    by_slot = {slot: ep for ep, slot in endpoint_slots(sim).items()}
+    lost_zone = 7
+    victims = [i for i in range(n) if topo.zone_of(i) == lost_zone]
+    plan = FaultPlan(seed=seed).with_topology(topo)
+    for v in victims:
+        plan.partition_one_way(dst=by_slot[v], windows=((2000, None),))
+    t0 = time.perf_counter()
+    records = replay_on_simulator(sim, plan, duration_ms=120_000)
+    wall = time.perf_counter() - t0
+    cut = sorted({int(c) for rec in records for c in rec.cut})
+    # detection -> decision latency per zone touched by a decision, measured
+    # from the partition window opening (virtual_time_ms is absolute and the
+    # simulator starts at 0, so the offset is exactly the window start)
+    per_zone = {}
+    for rec in records:
+        for z in sorted({topo.zone_of(int(c)) for c in rec.cut}):
+            if z not in per_zone:
+                per_zone[z] = rec.virtual_time_ms - 2000
+                global_metrics().observe(
+                    "nemesis_zone_detection_ms", per_zone[z], zone=str(z)
+                )
+    return {
+        "config": (
+            f"WAN zone loss: {n} slots over 8 zones x 2 regions, 1000 ms "
+            f"inter-region RTT, zone {lost_zone} partitioned (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": records[-1].virtual_time_ms if records else None,
+        "wall_s": round(wall, 3),
+        "cut_ok": bool(cut == victims),
+        "config_id_ok": bool(
+            records
+            and records[-1].configuration_id == recomputed_config_id(sim)
+        ),
+        "zone_detection_ms": per_zone,
+    }
+
+
+def scenario_gray_slow_node(seed=7, n=5, response_delay_ms=5000):
+    """Gray failure: node n-1 answers EVERY message, just response_delay_ms
+    late -- past the probe deadline, so observers see timeouts while the
+    victim stays alive, keeps processing, and never crashes. The survivors
+    must evict exactly the slow node (zero collateral evictions), and the
+    same plan replayed on the device plane with the protocol plane's seated
+    identities must produce the same cut and configuration id."""
+    from rapid_tpu.faults import FaultPlan, replay_on_simulator
+    from rapid_tpu.observability import global_metrics
+    from rapid_tpu.sim.driver import Simulator
+    sys.path.insert(0, "tests")
+    from harness import ClusterHarness
+
+    t0 = time.perf_counter()
+    h = ClusterHarness(seed=seed, use_static_fd=False)
+    victim = h.addr(n - 1)
+
+    def plan():
+        return FaultPlan(seed=seed).slow_node(victim, response_delay_ms)
+
+    h.with_faults(plan())
+    _bootstrap(h, n)
+    full_cfg = (
+        h.instances[h.addr(0)]._membership_service._view.get_configuration()
+    )
+    hist = global_metrics().histogram("fd.rtt_ms")
+    rtt_before = hist["count"] if hist is not None else 0
+    h.nemesis.arm()  # the victim turns gray now
+    start_virtual = h.scheduler.now_ms()
+    vic = h.instances.pop(victim)  # keeps RUNNING: slow, not dead
+    try:
+        h.wait_and_verify_agreement(n - 1)
+        virtual_ms = h.scheduler.now_ms() - start_virtual
+        survivor = h.instances[h.addr(0)]
+        survivors = set(survivor.get_memberlist())
+        ip_config = survivor.get_current_configuration_id()
+        victim_alive = vic.get_membership_size() >= 1
+    finally:
+        vic.shutdown()
+        h.shutdown()
+    expected = {h.addr(i) for i in range(n - 1)}
+    hist = global_metrics().histogram("fd.rtt_ms")
+    rtt_samples = (hist["count"] if hist is not None else 0) - rtt_before
+
+    # device leg: seat the protocol plane's identities; a slower-than-round
+    # response compiles to the partition-equivalent cut
+    identities = [
+        (ep.hostname, ep.port, nid.high, nid.low)
+        for ep, nid in zip(
+            (h.addr(i) for i in range(n)), full_cfg.node_ids
+        )
+    ]
+    sim = Simulator(n, seed=seed, identities=identities)
+    records = replay_on_simulator(sim, plan(), duration_ms=40_000)
+    device_ok = (
+        len(records) == 1
+        and [int(c) for c in records[0].cut] == [n - 1]
+        and records[0].configuration_id == ip_config
+    )
+    return {
+        "config": (
+            f"gray slow node: {n} nodes, victim answers "
+            f"{response_delay_ms} ms late (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": virtual_ms,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": bool(survivors == expected and victim_alive),
+        "config_id_parity": bool(device_ok),
+        "fd_rtt_samples": int(rtt_samples),
+    }
+
+
+def scenario_clock_skew(seed=13, n=5, offset_ms=350, rate=1.25):
+    """One node's ENTIRE timer stack -- FD probe intervals, batching windows,
+    retry backoff, message deadlines -- runs on a clock drifting at ``rate``x
+    true time plus ``offset_ms``, while every peer keeps true time. The
+    cluster must still bootstrap, admit a joiner and evict a crashed node
+    with zero collateral eviction of the skewed node."""
+    from rapid_tpu.faults import FaultPlan
+    sys.path.insert(0, "tests")
+    from harness import ClusterHarness
+
+    t0 = time.perf_counter()
+    h = ClusterHarness(seed=seed, use_static_fd=False)
+    skewed = h.addr(1)
+    h.with_faults(
+        FaultPlan(seed=seed).clock_skew(skewed, offset_ms=offset_ms, rate=rate)
+    )
+    _bootstrap(h, n)
+    h.nemesis.arm()
+    start_virtual = h.scheduler.now_ms()
+    h.join(n)  # a join wave under skew ...
+    h.wait_and_verify_agreement(n + 1)
+    crashed = h.addr(n - 1)
+    h.fail_nodes([crashed])  # ... then a crash-stop beside the skewed node
+    try:
+        h.wait_and_verify_agreement(n)
+        virtual_ms = h.scheduler.now_ms() - start_virtual
+        members = set(h.instances[h.addr(0)].get_memberlist())
+        drift_ms = (
+            h.nemesis.scheduler_for(skewed).now_ms() - h.scheduler.now_ms()
+        )
+    finally:
+        h.shutdown()
+    ok = skewed in members and crashed not in members and len(members) == n
+    return {
+        "config": (
+            f"clock skew: {n} nodes + joiner, node 1 at {rate}x "
+            f"+{offset_ms} ms, one crash-stop (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": virtual_ms,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": bool(ok),
+        "skew_drift_ms": int(drift_ms),
+    }
+
+
+def scenario_rolling_upgrade(seed=21, n=6, version=2):
+    """Rolling upgrade: the even-indexed half of the cluster (and the
+    joiner) encodes every egress message at wire version ``version`` --
+    reserved ``__``-prefixed extension keys a v1 peer must ignore, optional
+    defaulted fields thinned -- while the rest speak v1, with a sustained 5%
+    probe-lossy link riding along. The mixed-version cluster bootstraps,
+    admits the upgraded joiner and evicts a v1 node, all on bytes a
+    same-version cluster never exercises (PR 3's __tc stripping generalized
+    into versioned-wire replay). Windowed FDs shed the probe loss."""
+    from rapid_tpu import Settings
+    from rapid_tpu.faults import FaultPlan
+    from rapid_tpu.types import ProbeMessage
+    sys.path.insert(0, "tests")
+    from harness import ClusterHarness
+
+    t0 = time.perf_counter()
+    settings = Settings(fd_policy="windowed")
+    h = ClusterHarness(seed=seed, use_static_fd=False, settings=settings)
+    plan = FaultPlan(seed=seed).lossy_link(0.05, msg_types=(ProbeMessage,))
+    for i in list(range(0, n, 2)) + [n]:
+        plan.wire_version(h.addr(i), version)
+    h.with_faults(plan)
+    # armed from epoch zero: the whole bootstrap runs on mixed wire versions
+    h.nemesis.arm()
+    h.start_seed(0)
+    for i in range(1, n):
+        h.join(i)
+        h.wait_and_verify_agreement(i + 1)
+    start_virtual = h.scheduler.now_ms()
+    h.join(n)  # the upgraded joiner arrives on v2 bytes
+    h.wait_and_verify_agreement(n + 1)
+    h.fail_nodes([h.addr(n - 1)])  # a v1 node leaves mid-upgrade
+    try:
+        h.wait_and_verify_agreement(n)
+        virtual_ms = h.scheduler.now_ms() - start_virtual
+        members = set(h.instances[h.addr(0)].get_memberlist())
+        versioned = h.nemesis.metrics.get("nemesis_wire_versioned")
+    finally:
+        h.shutdown()
+    expected = {h.addr(i) for i in range(n + 1)} - {h.addr(n - 1)}
+    return {
+        "config": (
+            f"rolling upgrade: {n} nodes half at wire v{version} + v{version} "
+            f"joiner, 5% probe loss, one v1 removal (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": virtual_ms,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": bool(members == expected),
+        "wire_versioned_msgs": int(versioned),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the registry table and batteries
+# ---------------------------------------------------------------------------
+
+register("cross-plane-10", scenario_10_node_cross_plane, seed=1)
+register("crash-1k", scenario_crash, n=1000, n_fail=1, seed=100,
+         label="1k virtual nodes, single crash-stop fault")
+register("crash-10k", scenario_crash, n=10_000, n_fail=100, seed=200,
+         label="10k virtual nodes, 1% correlated crash burst")
+register("one-way-loss-50k", scenario_one_way_loss, n=50_000, n_fail=500,
+         seed=300)
+register("flip-flop-join-100k", scenario_flip_flop_with_join_wave,
+         n=100_000, capacity=100_100, seed=400)
+register("nemesis-protocol", scenario_nemesis_protocol, seed=7, n=5)
+register("nemesis-smoke", scenario_nemesis_smoke, n=1000, seed=7)
+register("wan-zone-loss", scenario_wan_zone_loss, seed=11)
+register("gray-slow-node", scenario_gray_slow_node, seed=7)
+register("clock-skew", scenario_clock_skew, seed=13)
+register("rolling-upgrade", scenario_rolling_upgrade, seed=21)
+# 10x the north-star scale (VERDICT r4 item 3): every failure class the
+# paper holds stable, at 1M, with cut parity AND the from-scratch
+# configuration-id cross-check
+register("crash-1m", scenario_crash, n=1_000_000, n_fail=10_000, seed=500,
+         label="1M virtual nodes, 1% correlated crash burst (10x north star)")
+register("one-way-loss-1m", scenario_one_way_loss, n=1_000_000,
+         n_fail=10_000, seed=501)
+register("flip-flop-join-1m", scenario_flip_flop_with_join_wave,
+         n=1_000_000, capacity=1_001_000, seed=502)
+
+BATTERY = [
+    "cross-plane-10", "crash-1k", "crash-10k", "one-way-loss-50k",
+    "flip-flop-join-100k", "nemesis-smoke", "wan-zone-loss",
+    "gray-slow-node", "clock-skew", "rolling-upgrade",
+]
+SCALE_1M = ["crash-1m", "one-way-loss-1m", "flip-flop-join-1m"]
 
 
 def _flag_value(flag: str) -> str:
@@ -290,6 +615,18 @@ def _write_telemetry() -> None:
 
 
 def main() -> None:
+    if "--list" in sys.argv:
+        # pure registry dump: no jax import, usable on any host
+        for name, (fn, params) in REGISTRY.items():
+            battery = (
+                "battery" if name in BATTERY
+                else "scale-1m" if name in SCALE_1M else "on-demand"
+            )
+            print(json.dumps(
+                {"scenario": name, "fn": fn.__name__, "set": battery,
+                 **params}
+            ))
+        return
     if "--tpu" not in sys.argv:
         # pin the CPU backend via the CONFIG value (an injected accelerator
         # plugin ignores the env var, and a dead remote-TPU tunnel hangs
@@ -303,35 +640,24 @@ def main() -> None:
         #   python scenarios.py --fault-plan [seed] \
         #       [--trace-out trace.json] [--metrics-out metrics.prom]
         arg = _flag_value("--fault-plan")
-        plan_seed = int(arg) if arg.lstrip("-").isdigit() else 7
-        print(json.dumps(scenario_nemesis_protocol(plan_seed=plan_seed)))
-        print(json.dumps(scenario_nemesis_smoke(plan_seed=plan_seed)))
+        seed = int(arg) if arg.lstrip("-").isdigit() else 7
+        print(json.dumps(run_scenario("nemesis-protocol", seed=seed)))
+        print(json.dumps(run_scenario("nemesis-smoke", seed=seed)))
         _write_telemetry()
         return
-    results = [
-        scenario_10_node_cross_plane(),
-        scenario_crash(1000, 1, 100, "1k virtual nodes, single crash-stop fault"),
-        scenario_crash(10_000, 100, 200, "10k virtual nodes, 1% correlated crash burst"),
-        scenario_one_way_loss(50_000, 500, 300),
-        scenario_flip_flop_with_join_wave(100_000, 100_100, 400),
-        scenario_nemesis_smoke(),
-    ]
-    if "--scale-1m" in sys.argv:
-        # first-class targets at 10x the north-star scale (VERDICT r4 item
-        # 3): every failure class the paper holds stable, at 1M, with cut
-        # parity AND the from-scratch configuration-id cross-check
-        results.append(
-            scenario_crash(
-                1_000_000, 10_000, 500,
-                "1M virtual nodes, 1% correlated crash burst (10x north star)",
-            )
-        )
-        results.append(scenario_one_way_loss(1_000_000, 10_000, 501))
-        results.append(
-            scenario_flip_flop_with_join_wave(1_000_000, 1_001_000, 502)
-        )
-    for result in results:
-        print(json.dumps(result))
+    chosen = _flag_value("--scenario")
+    if chosen:
+        if chosen not in REGISTRY:
+            known = ", ".join(REGISTRY)
+            raise SystemExit(f"unknown scenario {chosen!r}; known: {known}")
+        seed_arg = _flag_value("--seed")
+        seed = int(seed_arg) if seed_arg else None
+        print(json.dumps(run_scenario(chosen, seed=seed)))
+        _write_telemetry()
+        return
+    names = BATTERY + (SCALE_1M if "--scale-1m" in sys.argv else [])
+    for name in names:
+        print(json.dumps(run_scenario(name)))
     _write_telemetry()
 
 

@@ -70,14 +70,18 @@ class ClusterHarness:
         server = InProcessServer(addr, self.network)
         self.servers[addr] = server
         client = InProcessClient(addr, self.network, self.settings)
+        scheduler = self.scheduler
         if self.nemesis is not None:
             client = self.nemesis.client(client, address=addr,
                                          settings=self.settings)
             server = self.nemesis.server(server, addr)
+            # a ClockSkewRule'd node runs its ENTIRE timer stack (FD probe
+            # intervals, batching windows, deadlines) on its drifted clock
+            scheduler = self.nemesis.scheduler_for(addr)
         builder = (
             ClusterBuilder(addr)
             .set_messaging_client_and_server(client, server)
-            .use_scheduler(self.scheduler)
+            .use_scheduler(scheduler)
             .use_settings(self.settings)
             .use_rng(random.Random(self.rng.getrandbits(64)))
         )

@@ -1,0 +1,499 @@
+"""Gray failures, WAN topology, skewed clocks, and versioned-wire replay
+(ISSUE 6).
+
+Pins the tentpole acceptance criteria: the new rule families (SlowNodeRule /
+LossyLinkRule / ClockSkewRule / WireVersionRule) are deterministic from the
+plan seed, validated at plan construction, device-replayable (or explicitly
+absorbed) per RULE_CATALOG, and parity-preserving across the protocol and
+device planes; the LatencyTopology tier math compiles onto delivery groups;
+and the hardened retry loop's decorrelated-jitter deadlines stay exact under
+injected DelayRule/DropRule links.
+"""
+
+import pytest
+
+from harness import ClusterHarness
+from rapid_tpu import Endpoint, Settings
+from rapid_tpu.faults import (
+    FaultPlan,
+    Nemesis,
+    SkewedScheduler,
+    UnsupportedDeviceFault,
+    _device_rules,
+    replay_on_simulator,
+)
+from rapid_tpu.messaging.retries import RetryPolicy, call_with_retries
+from rapid_tpu.observability import Metrics, global_metrics
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.runtime.scheduler import VirtualScheduler
+from rapid_tpu.sim.topology import LatencyTopology
+from rapid_tpu.types import ProbeMessage, ProbeResponse, Response
+
+A = Endpoint.from_parts("10.0.0.1", 50)
+B = Endpoint.from_parts("10.0.0.2", 50)
+
+
+# ---------------------------------------------------------------------------
+# LatencyTopology: tier math and device compilation inputs
+# ---------------------------------------------------------------------------
+
+
+def test_latency_topology_tiers_and_matrix():
+    topo = LatencyTopology(racks=8, zones=4, regions=2,
+                           rack_rtt_ms=1, zone_rtt_ms=4, region_rtt_ms=20,
+                           inter_region_rtt_ms=150)
+    n = 32
+    m = topo.rtt_matrix(n)
+    assert m.shape == (n, n)
+    for i in range(n):
+        assert m[i, i] == 0
+        for j in range(n):
+            assert m[i, j] == m[j, i] == topo.rtt_ms(i, j)
+    # widest separating tier wins: same rack -> rack RTT, same zone but
+    # different rack -> zone RTT, cross-region -> inter-region RTT
+    assert topo.rtt_ms(0, 8) == 1        # both rack 0
+    assert topo.rtt_ms(0, 4) == 4        # racks 0/4, both zone 0
+    assert topo.rtt_ms(0, 2) == 20       # zones 0/2, both region 0
+    assert topo.rtt_ms(0, 1) == 150      # regions 0/1
+    assert topo.one_way_ms(0, 1) == 75
+    groups = topo.group_assignment(n)
+    assert sorted(set(int(g) for g in groups)) == [0, 1, 2, 3]
+    assert all(int(groups[i]) == topo.zone_of(i) for i in range(n))
+    # inter-zone delay rounds: same-region zones sit below one 250 ms round,
+    # cross-region zones cost one-way 75 // 250 = 0 at 250 but 75 // 25 = 3
+    assert topo.delay_rounds(0, 2, round_ms=250) == 0
+    assert topo.delay_rounds(0, 1, round_ms=25) == 3
+
+
+def test_latency_topology_validation():
+    with pytest.raises(ValueError):
+        LatencyTopology(racks=2, zones=4)  # fewer racks than zones
+    with pytest.raises(ValueError):
+        LatencyTopology(zone_rtt_ms=10, region_rtt_ms=5)  # tiers decrease
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan construction-time validation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_rejects_bad_windows():
+    with pytest.raises(ValueError):
+        FaultPlan(seed=0).drop(0.5, windows=((1000, 1000),))  # end == start
+    with pytest.raises(ValueError):
+        FaultPlan(seed=0).drop(0.5, windows=((2000, 500),))  # end < start
+    with pytest.raises(ValueError):
+        FaultPlan(seed=0).drop(0.5, windows=((-5, 100),))  # negative start
+    # open-ended and well-ordered windows are fine
+    FaultPlan(seed=0).drop(0.5, windows=((0, None), (10, 20)))
+
+
+def test_fault_plan_rejects_contradictory_partition_overlap():
+    with pytest.raises(ValueError):
+        (
+            FaultPlan(seed=0)
+            .partition_one_way(dst=B, windows=((0, 5000),))
+            .partition_one_way(dst=B, windows=((4000, None),))
+        )
+    with pytest.raises(ValueError):
+        (
+            FaultPlan(seed=0)
+            .partition_one_way(dst=B)
+            .flip_flop(period_ms=2000, dst=B)
+        )
+    # disjoint windows on one link, or different links, are fine
+    (
+        FaultPlan(seed=0)
+        .partition_one_way(dst=B, windows=((0, 1000),))
+        .partition_one_way(dst=B, windows=((2000, 3000),))
+        .partition_one_way(dst=A)
+    )
+
+
+def test_lossy_link_probability_validation():
+    with pytest.raises(ValueError):
+        FaultPlan(seed=0).lossy_link(0.0)  # not lossy
+    with pytest.raises(ValueError):
+        FaultPlan(seed=0).lossy_link(1.0)  # that's a partition
+    FaultPlan(seed=0).lossy_link(0.05)
+
+
+# ---------------------------------------------------------------------------
+# SlowNodeRule: alive but late
+# ---------------------------------------------------------------------------
+
+
+class _RecordingClient:
+    def __init__(self, scheduler):
+        self.sched = scheduler
+        self.sent = []  # (virtual time, remote, msg)
+
+    def send_message_best_effort(self, remote, msg):
+        self.sent.append((self.sched.now_ms(), remote, msg))
+        return Promise.completed(Response())
+
+    def send_message(self, remote, msg):
+        return self.send_message_best_effort(remote, msg)
+
+    def shutdown(self):
+        pass
+
+
+def test_slow_node_past_timeout_times_out_sender_but_delivers():
+    sched = VirtualScheduler()
+    settings = Settings()
+    nem = Nemesis(FaultPlan(seed=1).slow_node(B, response_delay_ms=5000),
+                  sched, metrics=Metrics()).arm(0)
+    inner = _RecordingClient(sched)
+    client = nem.client(inner, address=A, settings=settings)
+    p = client.send_message_best_effort(B, ProbeMessage(sender=A))
+    # the sender's deadline expires first ...
+    sched.run_for(settings.probe_message_timeout_ms - 1)
+    assert not p.done() and inner.sent == []
+    sched.run_for(2)
+    assert p.done() and isinstance(p.exception(), TimeoutError)
+    # ... but the message IS delivered, 5000 ms late: alive, not dead
+    sched.run_for(5000)
+    assert [t for t, _, _ in inner.sent] == [5000]
+    assert nem.metrics.get("nemesis_slowed") == 1
+
+
+def test_slow_node_within_timeout_only_inflates_latency():
+    sched = VirtualScheduler()
+    nem = Nemesis(FaultPlan(seed=1).slow_node(B, response_delay_ms=300),
+                  sched, metrics=Metrics()).arm(0)
+    inner = _RecordingClient(sched)
+    client = nem.client(inner, address=A, settings=Settings())
+    p = client.send_message_best_effort(B, ProbeMessage(sender=A))
+    sched.run_for(299)
+    assert not p.done()
+    sched.run_for(2)
+    assert p.done() and p.exception() is None
+    assert [t for t, _, _ in inner.sent] == [300]
+
+
+def test_fd_rtt_estimate_tracks_probe_latency():
+    """fd.rtt_ms: the observable separating a gray node from a dead one --
+    the EWMA inflates while probes still answer inside the timeout."""
+    from rapid_tpu.monitoring.pingpong import PingPongFailureDetector
+
+    sched = VirtualScheduler()
+
+    class _LaggedResponder:
+        def __init__(self, lag_ms):
+            self.lag_ms = lag_ms
+
+        def send_message_best_effort(self, remote, msg):
+            p = Promise()
+            sched.schedule(
+                self.lag_ms, lambda: p.try_set_result(ProbeResponse())
+            )
+            return p
+
+    metrics = Metrics()
+    fd = PingPongFailureDetector(
+        A, B, _LaggedResponder(120), notifier=lambda: None,
+        metrics=metrics, clock=sched.now_ms,
+    )
+    assert fd.rtt_ms() is None
+    fd()
+    sched.run_for(121)
+    assert fd.rtt_ms() == 120.0
+    hist = metrics.histogram("fd.rtt_ms")
+    assert hist is not None and hist["count"] == 1
+    # EWMA: a second, slower answer drags the estimate up by alpha
+    fd._client = _LaggedResponder(520)  # the node turns gray
+    fd()
+    sched.run_for(521)
+    assert fd.rtt_ms() == pytest.approx(0.875 * 120 + 0.125 * 520)
+
+
+# ---------------------------------------------------------------------------
+# ClockSkewRule: one node's drifted timer stack
+# ---------------------------------------------------------------------------
+
+
+def test_skewed_scheduler_arithmetic_exact():
+    inner = VirtualScheduler()
+    sk = SkewedScheduler(inner, offset_ms=100, rate=2.0)
+    assert sk.now_ms() == 100
+    fired = []
+    sk.schedule(200, lambda: fired.append(sk.now_ms()))  # 200 skewed = 100 true
+    inner.run_for(99)
+    assert fired == []
+    inner.run_for(2)
+    assert fired == [100 + 2 * inner.now_ms() - 2]  # fired at true 100
+    assert sk.now_ms() == 100 + 2 * inner.now_ms()
+
+
+def test_clock_skew_scheduler_for_and_retry_backoff():
+    """A skewed node's retry backoff runs on ITS clock: delays it asks for
+    in its own time cost delay/rate of true time."""
+    sched = VirtualScheduler()
+    nem = Nemesis(FaultPlan(seed=3).clock_skew(A, rate=2.0), sched,
+                  metrics=Metrics()).arm(0)
+    skewed = nem.scheduler_for(A)
+    assert isinstance(skewed, SkewedScheduler)
+    assert nem.scheduler_for(B) is sched  # only the named node drifts
+    assert nem.scheduler_for(A) is skewed  # cached, one clock per node
+
+    outcomes = [RuntimeError("x")] * 3 + ["ok"]
+    times = []
+
+    def attempt():
+        times.append(sched.now_ms())  # record TRUE time
+        out = outcomes.pop(0)
+        p = Promise()
+        if isinstance(out, Exception):
+            p.try_set_exception(out)
+        else:
+            p.try_set_result(out)
+        return p
+
+    p = call_with_retries(
+        attempt, 3, scheduler=skewed,
+        policy=RetryPolicy(base_delay_ms=100, max_delay_ms=1000, jitter="none"),
+    )
+    assert sched.run_until(p.done, timeout_ms=60_000)
+    assert p.peek() == "ok"
+    # skewed delays 100, 200, 400 cost true 50, 100, 200
+    assert times == [0, 50, 150, 350]
+
+
+def test_clock_skew_cluster_converges_with_no_collateral(  # noqa: D103
+):
+    n = 4
+    h = ClusterHarness(seed=5, use_static_fd=False)
+    skewed = h.addr(1)
+    h.with_faults(FaultPlan(seed=5).clock_skew(skewed, offset_ms=350, rate=1.25))
+    h.nemesis.arm()
+    try:
+        h.create_cluster(n, parallel=False)
+        h.wait_and_verify_agreement(n)
+        h.fail_nodes([h.addr(n - 1)])
+        h.wait_and_verify_agreement(n - 1)
+        members = set(h.instances[h.addr(0)].get_memberlist())
+        assert skewed in members  # skew alone never evicts
+        assert members == {h.addr(i) for i in range(n - 1)}
+        drift = h.nemesis.scheduler_for(skewed).now_ms() - h.scheduler.now_ms()
+        assert drift > 0
+    finally:
+        h.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# WireVersionRule: versioned-wire rolling-upgrade replay
+# ---------------------------------------------------------------------------
+
+
+def test_wire_roundtrip_identity_across_versions():
+    from rapid_tpu.messaging.codec import (
+        WIRE_VERSION,
+        encode,
+        encode_versioned,
+        wire_roundtrip,
+    )
+    from rapid_tpu.types import (
+        AlertMessage,
+        BatchedAlertMessage,
+        EdgeStatus,
+        JoinResponse,
+        JoinStatusCode,
+        NodeId,
+    )
+
+    alert = AlertMessage(
+        edge_src=A, edge_dst=B, edge_status=EdgeStatus.DOWN,
+        configuration_id=-42, ring_numbers=(0, 3),
+    )
+    messages = [
+        ProbeMessage(sender=A),
+        ProbeResponse(),
+        Response(),
+        alert,
+        BatchedAlertMessage(sender=A, messages=(alert,)),
+        JoinResponse(sender=B, status_code=JoinStatusCode.SAFE_TO_JOIN,
+                     configuration_id=7, endpoints=(A, B),
+                     identifiers=(NodeId(1, 2),)),
+    ]
+    for msg in messages:
+        # current version: byte parity with the plain encoder
+        assert encode_versioned(9, msg, WIRE_VERSION) == encode(9, msg)
+        for version in (0, 1, 2, 7):
+            assert wire_roundtrip(msg, version) == msg
+        # a NEWER dialect differs on the wire (reserved __-prefixed
+        # extension keys) yet decodes to the same value
+        assert encode_versioned(9, msg, WIRE_VERSION + 1) != encode(9, msg)
+
+
+def test_wire_versioned_cluster_converges_through_churn():
+    n = 4
+    h = ClusterHarness(seed=21, use_static_fd=False)
+    plan = FaultPlan(seed=21)
+    for i in (0, 2):  # half the cluster already upgraded
+        plan.wire_version(h.addr(i), version=2)
+    h.with_faults(plan)
+    h.nemesis.arm()  # versioned from the very first join byte
+    try:
+        h.create_cluster(n, parallel=False)
+        h.wait_and_verify_agreement(n)
+        h.fail_nodes([h.addr(n - 1)])
+        h.wait_and_verify_agreement(n - 1)
+        assert h.nemesis.metrics.get("nemesis_wire_versioned") > 0
+    finally:
+        h.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# retry deadlines under injected links (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _run_retry_under_faulty_link():
+    """send_message (the hardened loop: decorrelated jitter from the plan's
+    per-sender rng, per-type deadline) across a link that drops everything
+    for 3 s then only delays: the schedule must be identical on every
+    replay and the post-heal attempt must land inside the deadline."""
+    sched = VirtualScheduler()
+    settings = Settings(retry_base_delay_ms=200, retry_max_delay_ms=2000)
+    plan = (
+        FaultPlan(seed=17)
+        .drop(1.0, dst=B, windows=((0, 3000),))
+        .delay(base_ms=600, dst=B, windows=((3000, None),))
+    )
+    nem = Nemesis(plan, sched, metrics=Metrics()).arm(0)
+    inner = _RecordingClient(sched)
+    client = nem.client(inner, address=A, settings=settings)
+    p = client.send_message(B, ProbeMessage(sender=A))
+    assert sched.run_until(p.done, timeout_ms=60_000)
+    assert p.exception() is None  # healed within the 6000 ms deadline
+    assert len(inner.sent) == 1
+    delivered_at = inner.sent[0][0]
+    assert 3600 <= delivered_at < 6000  # post-heal, DelayRule-inflated
+    backoff = nem.metrics.histogram("retry_backoff_ms")
+    assert backoff is not None and backoff["count"] >= 1
+    return sched.now_ms(), delivered_at, nem.metrics.get("retry_attempts")
+
+
+def test_retry_deadline_under_faulty_link_is_deterministic():
+    assert _run_retry_under_faulty_link() == _run_retry_under_faulty_link()
+
+
+# ---------------------------------------------------------------------------
+# device plane: compilation bounds, topology replay, and parity
+# ---------------------------------------------------------------------------
+
+
+def test_device_rule_bounds_for_gray_rules():
+    # wire versioning and mild skew are invisible to the round model
+    absorbed = (
+        FaultPlan(seed=0)
+        .wire_version(B, version=2)
+        .clock_skew(B, rate=1.25)
+        .slow_node(B, response_delay_ms=100)  # under one round: absorbed
+    )
+    assert _device_rules(absorbed, round_ms=1000) == []
+    # a slower-than-round node compiles (partition-equivalent cut)
+    slow = FaultPlan(seed=0).slow_node(B, response_delay_ms=1000)
+    assert [idx for idx, _ in _device_rules(slow, round_ms=1000)] == [0]
+    # a lossy link compiles onto ingress_loss
+    lossy = FaultPlan(seed=0).lossy_link(0.2, dst=B)
+    assert [idx for idx, _ in _device_rules(lossy, round_ms=1000)] == [0]
+    # extreme skew would shear FD deadlines across nodes: refused, loudly
+    with pytest.raises(UnsupportedDeviceFault):
+        _device_rules(FaultPlan(seed=0).clock_skew(B, rate=3.0), round_ms=1000)
+
+
+def _zone_loss_replay(seed):
+    from rapid_tpu.faults import endpoint_slots
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.sim.engine import SimConfig
+
+    n = 64
+    topo = LatencyTopology(racks=8, zones=4, regions=2,
+                           rack_rtt_ms=0, zone_rtt_ms=2, region_rtt_ms=4,
+                           inter_region_rtt_ms=1000)
+    config = SimConfig(capacity=n, groups=4, max_delivery_delay=2,
+                       rounds_per_interval=4)
+    sim = Simulator(n, config=config, seed=seed)
+    by_slot = {slot: ep for ep, slot in endpoint_slots(sim).items()}
+    victims = [i for i in range(n) if topo.zone_of(i) == 3]
+    plan = FaultPlan(seed=seed).with_topology(topo)
+    for v in victims:
+        plan.partition_one_way(dst=by_slot[v], windows=((2000, None),))
+    records = replay_on_simulator(sim, plan, duration_ms=60_000)
+    cut = sorted({int(c) for rec in records for c in rec.cut})
+    assert cut == victims
+    return [
+        (tuple(int(c) for c in rec.cut), rec.configuration_id,
+         rec.virtual_time_ms)
+        for rec in records
+    ]
+
+
+def test_topology_zone_loss_device_replay_is_deterministic():
+    first = _zone_loss_replay(31)
+    assert first == _zone_loss_replay(31)
+    assert first != _zone_loss_replay(32)  # the seed is load-bearing
+
+
+def test_slow_node_two_plane_parity():
+    """The gray-node acceptance pin: one seeded SlowNodeRule plan replayed
+    on the protocol plane (in-process virtual-time cluster, real FDs) and
+    the device plane (seated identities) produces the same single cut --
+    exactly the slow node, zero collateral -- and the same config id."""
+    from rapid_tpu.sim.driver import Simulator
+
+    n = 4
+    h = ClusterHarness(seed=7, use_static_fd=False)
+    victim = h.addr(n - 1)
+
+    def plan():
+        return FaultPlan(seed=7).slow_node(victim, response_delay_ms=5000)
+
+    h.with_faults(plan())
+    h.nemesis.arm(epoch_ms=1 << 40)  # dormant during bootstrap
+    h.create_cluster(n, parallel=False)
+    h.wait_and_verify_agreement(n)
+    full_cfg = (
+        h.instances[h.addr(0)]._membership_service._view.get_configuration()
+    )
+    h.nemesis.arm()  # the victim turns gray now
+    vic = h.instances.pop(victim)  # keeps running: slow, not dead
+    try:
+        h.wait_and_verify_agreement(n - 1)
+        survivor = h.instances[h.addr(0)]
+        ip_members = tuple(survivor.get_memberlist())
+        ip_config = survivor.get_current_configuration_id()
+        assert vic.get_membership_size() >= 1  # the gray node is alive
+    finally:
+        vic.shutdown()
+        h.shutdown()
+    assert victim not in ip_members and len(ip_members) == n - 1
+
+    identities = [
+        (ep.hostname, ep.port, nid.high, nid.low)
+        for ep, nid in zip(
+            (h.addr(i) for i in range(n)), full_cfg.node_ids
+        )
+    ]
+    sim = Simulator(n, seed=7, identities=identities)
+    records = replay_on_simulator(sim, plan(), duration_ms=40_000)
+    assert len(records) == 1
+    assert [int(c) for c in records[0].cut] == [n - 1]
+    assert records[0].configuration_id == ip_config
+
+
+def test_gray_slow_node_records_rtt_before_eviction():
+    """The fd.rtt_ms histogram accumulates while the cluster runs -- the
+    observable a gray-failure dashboard would watch."""
+    hist = global_metrics().histogram("fd.rtt_ms")
+    before = hist["count"] if hist is not None else 0
+    h = ClusterHarness(seed=9, use_static_fd=False)
+    try:
+        h.create_cluster(3, parallel=False)
+        h.wait_and_verify_agreement(3)
+    finally:
+        h.shutdown()
+    hist = global_metrics().histogram("fd.rtt_ms")
+    assert hist is not None and hist["count"] > before

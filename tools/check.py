@@ -428,6 +428,63 @@ def check_wire_tags() -> list[Finding]:
     return findings
 
 
+def check_fault_rules() -> list[Finding]:
+    """Fault-rule catalog lint over rapid_tpu/faults.py.
+
+    Every Rule subclass must have a device-plane story: an entry in
+    RULE_CATALOG saying whether _device_rules compiles it onto the fault
+    arrays ("compiled") or the round model absorbs it ("absorbed"). A rule
+    class added without a catalog entry would silently skip the device
+    plane's three-way parity contract; a stale entry would document a rule
+    that no longer exists. (The companion constraint -- every fd.* /
+    nemesis_* metric the fault plane emits is in METRIC_CATALOG -- is
+    enforced by the unknown-metric rule on the same files.)"""
+    findings: list[Finding] = []
+    path = REPO / "rapid_tpu" / "faults.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+
+    rule_classes: dict[str, int] = {}
+    known = {"Rule"}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+        if bases & known:
+            known.add(node.name)
+            rule_classes[node.name] = node.lineno
+
+    lits = _module_literals(path, {"RULE_CATALOG"})
+    if "RULE_CATALOG" not in lits:
+        findings.append(Finding(
+            path, 0, "fault-catalog",
+            "RULE_CATALOG not found or not a pure literal",
+        ))
+        return findings
+    catalog, line = lits["RULE_CATALOG"]
+
+    for name, lineno in sorted(rule_classes.items()):
+        if name not in catalog:
+            findings.append(Finding(
+                path, lineno, "fault-catalog",
+                f"Rule subclass {name!r} missing from RULE_CATALOG: does "
+                "_device_rules compile or absorb it?",
+            ))
+    for name, story in catalog.items():
+        if name not in rule_classes:
+            findings.append(Finding(
+                path, line, "fault-catalog",
+                f"RULE_CATALOG lists {name!r} but no such Rule subclass "
+                "exists",
+            ))
+        if story not in ("compiled", "absorbed"):
+            findings.append(Finding(
+                path, line, "fault-catalog",
+                f"RULE_CATALOG[{name!r}] must be 'compiled' or 'absorbed', "
+                f"got {story!r}",
+            ))
+    return findings
+
+
 def check_file(path: Path) -> list[Finding]:
     source = path.read_text()
     try:
@@ -456,6 +513,7 @@ def main(argv: list[str]) -> int:
     for f in files:
         findings.extend(check_file(f))
     findings.extend(check_wire_tags())
+    findings.extend(check_fault_rules())
     for finding in findings:
         print(finding)
     print(f"checked {len(files)} files: "
