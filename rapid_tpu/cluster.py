@@ -29,6 +29,7 @@ from .monitoring.pingpong import PingPongFailureDetectorFactory
 from .observability import FlightRecorder, Metrics, Tracer, global_metrics
 from .placement.engine import DEFAULT_WEIGHT_KEY, PlacementConfig
 from .runtime.futures import Promise, successful_as_list
+from .runtime.lockdep import make_lock
 from .runtime.resources import SharedResources
 from .runtime.scheduler import Scheduler
 from .service import MembershipService, SubscriptionCallback
@@ -75,7 +76,8 @@ class Cluster:
         self._membership_service = membership_service
         self._resources = resources
         self._listen_address = listen_address
-        self._has_shutdown = False
+        self._shutdown_lock = make_lock("Cluster._shutdown_lock")
+        self._has_shutdown = False  # guarded-by: _shutdown_lock
 
     @property
     def listen_address(self) -> Endpoint:
@@ -158,12 +160,16 @@ class Cluster:
         self.leave_gracefully_async().result(timeout)
 
     def shutdown(self) -> None:
-        if self._has_shutdown:
-            return
+        # shutdown() races leave_gracefully_async's completion callback with a
+        # user-thread call; flip the flag under a lock so exactly one caller
+        # runs the teardown, and tear down outside it (it blocks on joins)
+        with self._shutdown_lock:
+            if self._has_shutdown:
+                return
+            self._has_shutdown = True
         self._server.shutdown()
         self._membership_service.shutdown()
         self._resources.shutdown()
-        self._has_shutdown = True
 
     def _check_running(self) -> None:
         if self._has_shutdown:

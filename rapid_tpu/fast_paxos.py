@@ -32,7 +32,7 @@ from .types import (
 BASE_DELAY_MS = 1000
 
 
-class FastPaxos:
+class FastPaxos:  # guarded-by: protocol-executor
     def __init__(
         self,
         my_addr: Endpoint,
@@ -46,6 +46,7 @@ class FastPaxos:
         rng: Optional[random.Random] = None,
         metrics: Optional[Metrics] = None,
         tracer: Optional[Tracer] = None,
+        serialize: Optional[Callable[[Callable[[], None]], None]] = None,
     ) -> None:
         self._metrics = metrics
         self._tracer = tracer
@@ -54,6 +55,12 @@ class FastPaxos:
         self._n = membership_size
         self._broadcaster = broadcaster
         self._scheduler = scheduler
+        # Consensus state is protocol-executor confined; the classic-round
+        # fallback timer fires on the scheduler thread in real deployments,
+        # so it re-enters through this serializer (the service injects
+        # protocol_executor.execute). Default: direct call, for the
+        # single-threaded virtual plane and standalone tests.
+        self._serialize = serialize if serialize is not None else (lambda fn: fn())
         self._base_delay_ms = consensus_fallback_base_delay_ms
         self._rng = rng if rng is not None else random.Random()
         # Mean of the expovariate jitter is N seconds => ~one classic-round
@@ -103,7 +110,7 @@ class FastPaxos:
         if recovery_delay_ms is None:
             recovery_delay_ms = self._random_delay_ms()
         self._scheduled_classic_round = self._scheduler.schedule(
-            recovery_delay_ms, self.start_classic_paxos_round
+            recovery_delay_ms, self._classic_round_fallback
         )
 
     def _handle_fast_round_proposal(self, msg: FastRoundPhase2bMessage) -> None:
@@ -145,6 +152,11 @@ class FastPaxos:
         else:
             raise TypeError(f"unexpected consensus message: {type(msg).__name__}")
         return ConsensusResponse()
+
+    def _classic_round_fallback(self) -> None:
+        # runs on the timer thread; hop back onto the protocol serializer
+        # before touching consensus state
+        self._serialize(self.start_classic_paxos_round)
 
     def start_classic_paxos_round(self) -> None:
         """Fallback entry: classic rounds start at round 2 (FastPaxos.java:189-195)."""

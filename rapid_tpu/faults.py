@@ -51,11 +51,11 @@ import hashlib
 import math
 import random
 import struct
-import threading
 import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from .runtime.lockdep import make_lock
 from .messaging.base import IMessagingClient, IMessagingServer
 from .messaging.retries import call_with_retries
 from .observability import Metrics, global_metrics
@@ -490,7 +490,7 @@ class Nemesis:
         self._epoch: Optional[int] = None
         # (rule index, src str, dst str) -> decisions drawn so far
         self._seq: Dict[Tuple[int, str, str], int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Nemesis._lock")
         # one skewed clock per ClockSkewRule'd node, cached so every consumer
         # of a node's clock (client deadlines, FD intervals, retry backoff)
         # shares the same drifted view

@@ -24,9 +24,9 @@ budget for that source is spent and failover is the right response.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..runtime.lockdep import make_rlock
 from ..observability import (
     HANDOFF_BYTES_BUCKETS,
     HANDOFF_CHUNKS_BUCKETS,
@@ -119,7 +119,7 @@ class HandoffEngine:
         self.chunk_size = chunk_size
         self.max_inflight = max_inflight
         self.verify_attempts = verify_attempts
-        self._lock = threading.RLock()
+        self._lock = make_rlock("HandoffEngine._lock")
         self._sessions: Dict[int, _Session] = {}
         self._completed = 0
         self._failed = 0

@@ -9,10 +9,10 @@ tests, the simulator, and the examples.
 
 from __future__ import annotations
 
-import threading
 from abc import ABC, abstractmethod
 from typing import Dict, Optional, Tuple
 
+from ..runtime.lockdep import make_lock
 from .plan import content_fingerprint
 
 
@@ -53,7 +53,7 @@ class InMemoryPartitionStore(PartitionStore):
     lookups rather than O(bytes) rehashes."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("InMemoryPartitionStore._lock")
         self._data: Dict[int, bytes] = {}
         self._fingerprints: Dict[int, int] = {}
 

@@ -12,12 +12,12 @@ tags playing the role of the reference's protobuf ``oneof`` envelope
 from __future__ import annotations
 
 import struct
-import threading
 from collections import OrderedDict
 from typing import Any, Dict, Tuple, Type
 
 import msgpack
 
+from ..runtime.lockdep import make_lock
 from .. import types as T
 from ..observability import TraceContext, stamp_trace_context, trace_context_of
 
@@ -36,7 +36,7 @@ _enc_memo: "OrderedDict[int, Tuple[tuple, list]]" = OrderedDict()
 # exactly the >=4096-element JoinResponse case the memo targets): guard the
 # OrderedDict mutations, or one thread's eviction races another's
 # move_to_end into a KeyError and corrupts the dict's internal list
-_enc_memo_lock = threading.Lock()
+_enc_memo_lock = make_lock("codec._enc_memo_lock")
 
 # stable wire tags per message type (appending only; never renumber)
 _TYPES: Tuple[Type, ...] = (
@@ -160,7 +160,7 @@ _BODY_MEMO_CAP = 32
 _BODY_MEMO_BYTES = 64 * 1024 * 1024  # pinned bodies are MBs at 100k scale
 _body_memo: "OrderedDict[int, Tuple[Any, bytes]]" = OrderedDict()
 _body_memo_bytes = 0
-_body_memo_lock = threading.Lock()
+_body_memo_lock = make_lock("codec._body_memo_lock")
 
 
 def encode(request_no: int, msg: Any) -> bytes:

@@ -41,6 +41,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from ..runtime.lockdep import make_lock
 from ..runtime.futures import Promise
 from ..runtime.scheduler import RealScheduler
 from ..settings import Settings
@@ -120,7 +121,7 @@ class GatewayRoutedClient(IMessagingClient):
         self._direct_hosts.add(address.hostname)
         self._request_no = itertools.count(1)
         self._conn: Optional[_Connection] = None
-        self._conn_lock = threading.Lock()
+        self._conn_lock = make_lock("GatewayRoutedClient._conn_lock")
 
     def _is_direct(self, remote: Endpoint) -> bool:
         return remote.hostname in self._direct_hosts
@@ -128,7 +129,11 @@ class GatewayRoutedClient(IMessagingClient):
     def _connection(self) -> _Connection:
         with self._conn_lock:
             if self._conn is None or self._conn.closed:
-                self._conn = _Connection(
+                # deliberately dialing under the lock: there is exactly ONE
+                # upstream (the gateway), so no unrelated sender is stalled,
+                # and serializing the dial prevents a thundering herd of
+                # duplicate gateway connections after a drop
+                self._conn = _Connection(  # noqa: blocking-under-lock
                     self.gateway, self._settings.message_timeout_ms / 1000.0
                 )
             return self._conn
@@ -300,7 +305,7 @@ class _GatewayNetwork:
         self._out = out_client
         self._handlers: List[object] = []
         self._watch: Dict[Endpoint, _LivenessState] = {}
-        self._watch_lock = threading.Lock()
+        self._watch_lock = make_lock("_GatewayNetwork._watch_lock")
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="gateway-liveness", daemon=True
@@ -547,8 +552,9 @@ class SwarmGateway:
         ]
         self._running = False
         self._decisions: List[object] = []
-        self._decision_lock = threading.Lock()
-        self._warned_unowned: set = set()
+        self._decision_lock = make_lock("SwarmGateway._decision_lock")
+        self._warned_lock = make_lock("SwarmGateway._warned_lock")
+        self._warned_unowned: set = set()  # guarded-by: _warned_lock
 
     # task classes for the protocol thread's priority queue. The pump
     # shares the frame class on purpose: at a strictly lower priority a
@@ -819,6 +825,16 @@ class SwarmGateway:
             f"frame:{type(msg).__name__}",
         )
 
+    def _warn_unowned_once(self, dst: Endpoint) -> bool:
+        """True exactly once per unowned endpoint. The probe fast path warns
+        from the reader thread while routed frames warn from the protocol
+        thread, so the warn-once set needs its own guard."""
+        with self._warned_lock:
+            if dst in self._warned_unowned:
+                return False
+            self._warned_unowned.add(dst)
+            return True
+
     def _answer_probe(self, reply_send, request_no: int, dst: Endpoint) -> None:
         slot = self.bridge._slot_of.get(dst)  # noqa: SLF001
         if slot is None or dst in self.bridge._real:  # noqa: SLF001
@@ -826,8 +842,7 @@ class SwarmGateway:
             # but keep the warn-once misroute diagnostic (probes are the
             # dominant peer traffic; silently eating them would turn a
             # missing --direct-host into an undiagnosed cut cascade)
-            if dst not in self._warned_unowned:
-                self._warned_unowned.add(dst)
+            if self._warn_unowned_once(dst):
                 LOG.warning(
                     "routed probe for non-virtual endpoint %s dropped; if "
                     "this is a real agent's address, its peers need it in "
@@ -862,8 +877,7 @@ class SwarmGateway:
             # virtual node here; the sender's deadline handles it. Warn once
             # per endpoint -- a steady stream of these means an agent is
             # misrouting peer traffic here (missing --direct-host)
-            if dst not in self._warned_unowned:
-                self._warned_unowned.add(dst)
+            if self._warn_unowned_once(dst):
                 LOG.warning(
                     "routed frame for non-virtual endpoint %s dropped; if this "
                     "is a real agent's address, its peers need it in their "

@@ -20,6 +20,7 @@ from typing import Dict, Optional
 import grpc
 import grpc.aio
 
+from ..runtime.lockdep import make_lock
 from .. import types as T
 from ..observability import TraceContext, stamp_trace_context, trace_context_of
 from ..runtime.futures import Promise
@@ -403,7 +404,7 @@ class _SharedAioLoop:
     process -- individual servers start/stop on it without tearing it down.
     """
 
-    _lock = threading.Lock()
+    _lock = make_lock("_SharedAioLoop._lock")
     _loop: Optional[asyncio.AbstractEventLoop] = None
 
     @classmethod
@@ -537,7 +538,7 @@ class GrpcClient(IMessagingClient):
         self._stubs: Dict[T.Endpoint, object] = {}
         self._last_used: Dict[T.Endpoint, float] = {}
         self._retired: list = []  # [(retired_at, channel)]
-        self._lock = threading.Lock()
+        self._lock = make_lock("GrpcClient._lock")
 
     def _stub(self, remote: T.Endpoint):
         now = time.monotonic()

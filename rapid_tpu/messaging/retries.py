@@ -17,10 +17,10 @@ change behavior until Settings opts them in.
 from __future__ import annotations
 
 import random
-import threading
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..runtime.lockdep import make_lock
 from ..runtime.futures import Promise
 from ..runtime.scheduler import Scheduler
 
@@ -61,7 +61,7 @@ class RetryPolicy:
 # Wall-clock scheduler shared by socket transports that have no scheduler of
 # their own (TCP/gRPC clients): one timer thread lazily created on the first
 # backoff/deadline actually requested, never for the 0-delay default path.
-_wall_lock = threading.Lock()
+_wall_lock = make_lock("retries._wall_lock")
 _wall_scheduler: Optional[Scheduler] = None
 
 

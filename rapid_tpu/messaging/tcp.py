@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..runtime.lockdep import make_condition, make_lock
 from ..runtime.futures import Promise
 from ..settings import Settings
 from ..types import Endpoint, NodeStatus, ProbeMessage, ProbeResponse, RapidMessage
@@ -65,7 +66,7 @@ class _Connection:
             (remote.hostname.decode(), remote.port), timeout=timeout_s
         )
         self.sock.settimeout(None)
-        self.lock = threading.Lock()
+        self.lock = make_lock("_Connection.lock")
         self.outstanding: Dict[int, Promise] = {}
         self.closed = False
         self.reader = threading.Thread(
@@ -142,7 +143,7 @@ class FramedTcpServer:
         self._server_sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._accepted: set = set()
-        self._accepted_lock = threading.Lock()
+        self._accepted_lock = make_lock("FramedTcpServer._accepted_lock")
         self._running = False
 
     def start(self) -> None:
@@ -196,7 +197,7 @@ class FramedTcpServer:
             ).start()
 
     def _serve_connection(self, sock: socket.socket) -> None:
-        write_lock = threading.Lock()
+        write_lock = make_lock("FramedTcpServer.write_lock")
         try:
             while True:
                 frame = _read_frame(sock)
@@ -229,7 +230,7 @@ class _TimeoutWheel:
     def __init__(self) -> None:
         self._heap: list = []
         self._seq = itertools.count()
-        self._cond = threading.Condition()
+        self._cond = make_condition("_TimeoutWheel._cond")
         self._thread: Optional[threading.Thread] = None
 
     def arm(self, timeout_s: float, promise: Promise, remote: Endpoint) -> None:
@@ -273,7 +274,9 @@ def send_framed(conn: _Connection, request_no: int, frame: bytes,
     try:
         with conn.lock:
             conn.outstanding[request_no] = out
-            _write_frame(conn.sock, frame)
+            # sendall under the connection lock is the point: concurrent
+            # senders must not interleave partial frames on one socket
+            _write_frame(conn.sock, frame)  # noqa: blocking-under-lock
     except OSError as e:
         if not out.done():
             out.set_exception(e)
@@ -299,7 +302,7 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
         self._service = None
         self._request_no = itertools.count()
         self._connections: Dict[Endpoint, _Connection] = {}
-        self._conn_lock = threading.Lock()
+        self._conn_lock = make_lock("TcpClientServer._conn_lock")
         self._framed = FramedTcpServer(listen_address, self._on_frame, "tcp-server")
 
     # -- server side ---------------------------------------------------------
@@ -323,7 +326,9 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
             return
         try:
             with write_lock:
-                _write_frame(sock, encode(request_no, response))
+                # replies from concurrent protocol tasks share one socket;
+                # the per-connection write lock keeps frames whole
+                _write_frame(sock, encode(request_no, response))  # noqa: blocking-under-lock
         except OSError:
             pass
 
@@ -346,10 +351,21 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
     def _connection(self, remote: Endpoint) -> _Connection:
         with self._conn_lock:
             conn = self._connections.get(remote)
-            if conn is None or conn.closed:
-                conn = _Connection(remote, self._settings.message_timeout_ms / 1000.0)
-                self._connections[remote] = conn
-            return conn
+            if conn is not None and not conn.closed:
+                return conn
+        # dial OUTSIDE the lock: connect() can block for seconds on an
+        # unreachable peer, and the cache lock is shared across all remotes
+        # -- one dead peer must not stall every sender on the node
+        fresh = _Connection(remote, self._settings.message_timeout_ms / 1000.0)
+        with self._conn_lock:
+            conn = self._connections.get(remote)
+            if conn is not None and not conn.closed:
+                winner = conn  # lost a dial race; keep the established one
+            else:
+                winner = self._connections[remote] = fresh
+        if winner is not fresh:
+            fresh.close()
+        return winner
 
     def _send_once(self, remote: Endpoint, msg: RapidMessage,
                    timeout_ms: Optional[int] = None) -> Promise:

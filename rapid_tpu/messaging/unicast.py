@@ -22,7 +22,7 @@ from .base import IBroadcaster, IMessagingClient
 class UnicastToAllBroadcaster(IBroadcaster):
     def __init__(self, client: IMessagingClient, rng: Optional[random.Random] = None) -> None:
         self._client = client
-        self._recipients: List[Endpoint] = []
+        self._recipients: List[Endpoint] = []  # guarded-by: protocol-executor
         self._rng = rng if rng is not None else random.Random()
 
     def broadcast(self, msg: RapidMessage) -> List[Promise]:

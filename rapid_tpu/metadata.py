@@ -15,7 +15,7 @@ FrozenMetadata = Tuple[Tuple[str, bytes], ...]
 
 class MetadataManager:
     def __init__(self) -> None:
-        self._table: Dict[Endpoint, FrozenMetadata] = {}
+        self._table: Dict[Endpoint, FrozenMetadata] = {}  # guarded-by: protocol-executor
 
     def get(self, node: Endpoint) -> FrozenMetadata:
         return self._table.get(node, ())

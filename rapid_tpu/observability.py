@@ -45,6 +45,12 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+try:
+    from .runtime.lockdep import make_lock
+except ImportError:  # loaded standalone by tools/check.py (no parent package)
+    def make_lock(name: str) -> threading.Lock:  # noqa: ARG001
+        return threading.Lock()
+
 # --------------------------------------------------------------------------- #
 # Metric name catalog
 # --------------------------------------------------------------------------- #
@@ -282,7 +288,7 @@ class Metrics:
 
     def __init__(self, parent: Optional["Metrics"] = None,
                  **const_labels: object) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("Metrics._lock")
         self._counters: Dict[Tuple[str, LabelItems], int] = {}
         self._gauges: Dict[Tuple[str, LabelItems], float] = {}
         self._histograms: Dict[Tuple[str, LabelItems], Histogram] = {}
@@ -295,7 +301,7 @@ class Metrics:
         # cyclic GC can run inside this registry's own locked sections (any
         # allocation can trigger it), and a lock-taking finalizer would then
         # self-deadlock the thread. list.append is atomic and lock-free.
-        self._pending_absorbs: List[tuple] = []
+        self._pending_absorbs: List[tuple] = []  # guarded-by: gil-atomic-append
         if parent is not None:
             parent.attach(self)
 
@@ -513,7 +519,7 @@ def global_metrics() -> Metrics:
 # --------------------------------------------------------------------------- #
 
 _SPAN_IDS = itertools.count(1)
-_SPAN_ID_LOCK = threading.Lock()
+_SPAN_ID_LOCK = make_lock("observability._SPAN_ID_LOCK")
 
 # One process-wide current-span so nesting works across tracer instances
 # (e.g. a fault-plane event inside a protocol-plane span): each task/thread
@@ -631,12 +637,12 @@ class Tracer:
         self.plane = plane
         self.track = track
         self._max_spans = max_spans
-        self._lock = threading.Lock()
+        self._lock = make_lock("Tracer._lock")
         self._children: List["weakref.ref[Tracer]"] = []
         # dead children's (spans, dropped_box), appended by GC finalizers --
         # lock-free on purpose: cyclic GC can fire inside this tracer's own
         # locked sections, so a lock-taking finalizer would self-deadlock.
-        self._pending_absorbs: List[tuple] = []
+        self._pending_absorbs: List[tuple] = []  # guarded-by: gil-atomic-append
         if parent is not None:
             parent.attach(self)
 
@@ -853,8 +859,8 @@ class StableViewTimer:
         self._metrics = metrics
         self._plane = plane
         self._clock = clock
-        self._detect_ms: Optional[int] = None
-        self._decide_ms: Optional[int] = None
+        self._detect_ms: Optional[int] = None  # guarded-by: protocol-thread
+        self._decide_ms: Optional[int] = None  # guarded-by: protocol-thread
 
     def _now(self, now_ms: Optional[int]) -> int:
         return int(now_ms if now_ms is not None else self._clock())
@@ -915,7 +921,7 @@ class FlightRecorder:
         self.node = node
         self._clock = clock
         self._seq = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FlightRecorder._lock")
         self._events: "collections.deque[Dict[str, object]]" = (
             collections.deque(maxlen=max(1, capacity))
         )

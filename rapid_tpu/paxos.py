@@ -40,7 +40,7 @@ def paxos_node_index(addr: Endpoint) -> int:
     return h - (1 << 32) if h >= (1 << 31) else h
 
 
-class Paxos:
+class Paxos:  # guarded-by: protocol-executor
     def __init__(
         self,
         my_addr: Endpoint,

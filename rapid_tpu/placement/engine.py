@@ -311,8 +311,8 @@ class PlacementEngine:
 
     def __init__(self, config: PlacementConfig) -> None:
         self.config = config
-        self.map: Optional[PlacementMap] = None
-        self.last_diff: Optional[PlacementDiff] = None
+        self.map: Optional[PlacementMap] = None  # guarded-by: protocol-executor
+        self.last_diff: Optional[PlacementDiff] = None  # guarded-by: protocol-executor
 
     def update(
         self,

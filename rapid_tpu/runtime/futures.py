@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Generic, List, Optional, TypeVar
 
+from .lockdep import make_lock
+
 T = TypeVar("T")
 
 
@@ -24,7 +26,7 @@ class Promise(Generic[T]):
 
     def __init__(self) -> None:
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("Promise._lock")
         self._result: Optional[T] = None
         self._exception: Optional[BaseException] = None
         self._done = False
@@ -116,7 +118,7 @@ def successful_as_list(promises: List[Promise[T]]) -> Promise[List[Optional[T]]]
         return out
     remaining = [len(promises)]
     results: List[Optional[T]] = [None] * len(promises)
-    lock = threading.Lock()
+    lock = make_lock("futures.successful_as_list.lock")
 
     def make_cb(i: int) -> Callable[[Promise[T]], None]:
         def cb(p: Promise[T]) -> None:

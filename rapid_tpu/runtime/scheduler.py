@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from .lockdep import make_condition
+
 
 class ScheduledTask:
     """Cancellable handle, akin to java.util.concurrent.ScheduledFuture."""
@@ -131,7 +133,7 @@ class RealScheduler(Scheduler):
     def __init__(self, name: str = "rapid-scheduler") -> None:
         self._heap: List[Tuple[float, int, ScheduledTask]] = []
         self._seq = itertools.count()
-        self._cond = threading.Condition()
+        self._cond = make_condition("RealScheduler._cond")
         self._shutdown = False
         self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
         self._thread.start()

@@ -818,6 +818,7 @@ class MembershipService:
             rng=self._rng,
             metrics=self.metrics,
             tracer=self.tracer,
+            serialize=self._resources.protocol_executor.execute,
         )
 
     def _on_consensus_decide(self, proposal: List[Endpoint]) -> None:
@@ -913,7 +914,15 @@ class MembershipService:
 
     def _alert_batcher_tick(self) -> None:
         """Quiescence-based flush: only send once a full batching window has
-        passed since the last enqueue (MembershipService.java:602-626)."""
+        passed since the last enqueue (MembershipService.java:602-626).
+
+        The tick fires on the scheduler's timer thread in real deployments
+        while _enqueue_alert appends on the protocol executor; the
+        check-and-flush body hops onto the executor so the queue is only
+        ever touched from one context."""
+        self._resources.protocol_executor.execute(self._alert_batcher_flush)
+
+    def _alert_batcher_flush(self) -> None:
         if not self._alert_send_queue or self._last_enqueue_ms < 0:
             return
         if (
@@ -985,7 +994,11 @@ class MembershipService:
             return
         self._shut_down = True
         self._alert_batcher_job.cancel()
-        self._cancel_failure_detectors()
+        # _failure_detector_jobs is only ever touched on the protocol
+        # executor (_create_failure_detectors runs there); keep shutdown's
+        # cancel on the same context instead of racing it from the caller's
+        # thread. SharedResources.shutdown drains the executor afterwards.
+        self._resources.protocol_executor.execute(self._cancel_failure_detectors)
         self._client.shutdown()
 
     # ------------------------------------------------------------------ #

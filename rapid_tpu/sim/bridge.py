@@ -54,12 +54,12 @@ from __future__ import annotations
 import dataclasses
 import logging
 import pickle
-import threading
 from collections import OrderedDict, deque
 from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..runtime.lockdep import make_lock
 from ..runtime.futures import Promise
 from ..service import address_comparator_key
 from ..types import (
@@ -106,7 +106,7 @@ def _failed(exc: BaseException) -> Promise:
     return p
 
 
-class TpuSimMessaging:
+class TpuSimMessaging:  # guarded-by: sim-loop
     """A multi-endpoint handler on an InProcessNetwork hosting N virtual
     nodes in the TPU simulator (the BASELINE.json north star's plugin)."""
 
@@ -294,7 +294,7 @@ class TpuSimMessaging:
         # member. Mutated from delivery-callback threads.
         self._undelivered: Dict[Endpoint, int] = {}
         self._chain_inflight: set = set()
-        self._undelivered_lock = threading.Lock()
+        self._undelivered_lock = make_lock("SwarmBridge._undelivered_lock")
 
     def _endpoint(self, slot: int) -> Endpoint:
         ep = self._ep_cache.get(slot)

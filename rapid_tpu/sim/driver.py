@@ -78,7 +78,7 @@ class ViewChangeRecord:
     via_classic_round: bool = False  # decided by the Paxos fallback
 
 
-class Simulator:
+class Simulator:  # guarded-by: sim-loop
     def __init__(
         self,
         n_nodes: int,

@@ -12,6 +12,29 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Runtime lockdep is on for the whole tier-1 suite: every lock created in
+# rapid_tpu/ is instrumented, so every existing cluster/handoff/nemesis test
+# doubles as a deadlock probe. MUST be set before anything imports rapid_tpu:
+# class-level locks (e.g. grpc's shared-loop lock) are created at import time.
+# Opt out for A/B timing with RAPID_LOCKDEP=0.
+os.environ.setdefault("RAPID_LOCKDEP", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockdep_gate():
+    """Fail the session if any lock-order violation was recorded, even one
+    swallowed by a protocol thread's blanket exception handler."""
+    yield
+    from rapid_tpu.runtime import lockdep
+
+    assert lockdep.violations() == [], (
+        "lockdep recorded lock-order violations during the run:\n"
+        + "\n".join(lockdep.violations())
+    )
+
+
 if os.environ.get("RAPID_TPU_PALLAS_HW"):
     # opt-in hardware runs (test_pallas_kernels.py::test_hardware_*) keep the
     # real accelerator visible

@@ -1,0 +1,12 @@
+"""blocking-under-lock: sleeping while every other acquirer stalls."""
+import threading
+import time
+
+
+class Throttle:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+
+    def pace(self) -> None:
+        with self._lock:
+            time.sleep(0.1)

@@ -1,0 +1,14 @@
+"""callback-under-lock: user callbacks invoked while holding the lock can
+re-enter this object (or block) and deadlock every other caller."""
+import threading
+
+
+class Publisher:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._subscribers = []
+
+    def publish(self, event) -> None:
+        with self._lock:
+            for callback in self._subscribers:
+                callback(event)

@@ -1,0 +1,17 @@
+"""unguarded-write (declared-guard variant): the attribute promises
+'# guarded-by: _lock' but one write path skips the lock."""
+import threading
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock
+        self._worker = threading.Thread(target=self._sweep, daemon=True)
+
+    def _sweep(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def put(self, key: str, value: str) -> None:
+        self._entries[key] = value

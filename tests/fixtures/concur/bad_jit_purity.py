@@ -1,0 +1,13 @@
+"""jit-purity: wall-clock read + print inside a jitted function run once at
+trace time and never again -- the timestamp is baked into the graph."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def stamped_sum(x):
+    started = time.time()
+    print("tracing", started)
+    return jnp.sum(x) + started

@@ -1,0 +1,8 @@
+"""thread-daemon: a non-daemon thread keeps the process alive at exit."""
+import threading
+
+
+def start_worker(fn) -> threading.Thread:
+    worker = threading.Thread(target=fn, name="worker")
+    worker.start()
+    return worker

@@ -1,0 +1,12 @@
+"""unbalanced-acquire: manual acquire with the release outside a finally --
+any exception between them leaks the lock forever."""
+import threading
+
+state_lock = threading.Lock()
+state = []
+
+
+def update(item) -> None:
+    state_lock.acquire()
+    state.append(item)
+    state_lock.release()

@@ -1,0 +1,16 @@
+"""unguarded-write: a counter bumped from a thread AND public callers,
+with a lock present but not actually taken around the writes."""
+import threading
+
+
+class Collector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self) -> None:
+        self._count += 1
+
+    def add(self, n: int) -> None:
+        self._count += n

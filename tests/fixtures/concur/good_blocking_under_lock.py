@@ -1,0 +1,13 @@
+"""blocking-under-lock corrected: decide under the lock, block outside."""
+import threading
+import time
+
+
+class Throttle:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+
+    def pace(self) -> None:
+        with self._lock:
+            delay = 0.1
+        time.sleep(delay)

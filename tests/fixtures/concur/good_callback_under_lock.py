@@ -1,0 +1,14 @@
+"""callback-under-lock corrected: snapshot under the lock, call after."""
+import threading
+
+
+class Publisher:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._subscribers = []
+
+    def publish(self, event) -> None:
+        with self._lock:
+            snapshot = list(self._subscribers)
+        for callback in snapshot:
+            callback(event)

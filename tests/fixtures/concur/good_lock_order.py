@@ -1,0 +1,17 @@
+"""lock-order corrected: both paths honor the same A-before-B hierarchy."""
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def transfer_forward() -> None:
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def transfer_backward() -> None:
+    with lock_a:
+        with lock_b:
+            pass

@@ -1,0 +1,8 @@
+"""thread-daemon corrected: daemon=True so shutdown never hangs on it."""
+import threading
+
+
+def start_worker(fn) -> threading.Thread:
+    worker = threading.Thread(target=fn, name="worker", daemon=True)
+    worker.start()
+    return worker

@@ -1,0 +1,14 @@
+"""unbalanced-acquire corrected: release lives in a finally block.
+(A `with` statement is better still; this pins the minimal correction.)"""
+import threading
+
+state_lock = threading.Lock()
+state = []
+
+
+def update(item) -> None:
+    state_lock.acquire()
+    try:
+        state.append(item)
+    finally:
+        state_lock.release()

@@ -1,0 +1,17 @@
+"""unguarded-write corrected: every write holds the declared guard."""
+import threading
+
+
+class Collector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0  # guarded-by: _lock
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._count += n

@@ -12,6 +12,10 @@ One test per finding, each constructed to fail on the pre-fix code:
    could never reach;
 5. gateway: the liveness monitor thread only starts after the dial/delivery
    executors it dereferences are assigned.
+
+Plus one test per concurrency finding surfaced by tools/concur.py (the
+lock-graph static analyzer) and fixed in the same PR that introduced it --
+see the "concur.py findings" section at the bottom.
 """
 
 import random
@@ -204,3 +208,230 @@ def test_gateway_monitor_thread_starts_after_executors(monkeypatch):
         net._dialers.shutdown(wait=False)
         for lane in net._delivery:
             lane.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# concur.py findings: each test fails on the pre-fix code
+# ---------------------------------------------------------------------------
+
+from types import SimpleNamespace  # noqa: E402
+
+from rapid_tpu.cluster import Cluster  # noqa: E402
+from rapid_tpu.fast_paxos import FastPaxos  # noqa: E402
+from rapid_tpu.messaging.gateway import SwarmGateway  # noqa: E402
+from rapid_tpu.runtime.lockdep import make_lock  # noqa: E402
+from rapid_tpu.service import MembershipService  # noqa: E402
+
+
+class _RecordingExecutor:
+    """Captures protocol_executor.execute posts without running them."""
+
+    def __init__(self):
+        self.posted = []
+
+    def execute(self, task):
+        self.posted.append(task)
+
+
+def test_alert_batcher_tick_hops_onto_protocol_executor():
+    """concur: the batching-window tick fires on the scheduler's timer
+    thread while _enqueue_alert appends on the protocol executor; the tick
+    body must run on the executor, not touch the queue in place."""
+    executor = _RecordingExecutor()
+    fake = SimpleNamespace(
+        _resources=SimpleNamespace(protocol_executor=executor),
+        _alert_batcher_flush=lambda: None,
+    )
+    MembershipService._alert_batcher_tick(fake)
+    assert executor.posted == [fake._alert_batcher_flush]
+
+
+def test_service_shutdown_cancels_detectors_on_protocol_executor():
+    """concur: _failure_detector_jobs is protocol-executor confined
+    (_create_failure_detectors runs there); shutdown must post the cancel
+    instead of mutating the list from the caller's thread."""
+    executor = _RecordingExecutor()
+    client_calls = []
+    fake = SimpleNamespace(
+        _shut_down=False,
+        _alert_batcher_job=SimpleNamespace(cancel=lambda: None),
+        _resources=SimpleNamespace(protocol_executor=executor),
+        _client=SimpleNamespace(shutdown=lambda: client_calls.append(1)),
+        _cancel_failure_detectors=lambda: None,
+    )
+    MembershipService.shutdown(fake)
+    assert executor.posted == [fake._cancel_failure_detectors]
+    assert client_calls == [1]
+    # idempotent: a second call must not re-post or re-shutdown
+    MembershipService.shutdown(fake)
+    assert len(executor.posted) == 1 and client_calls == [1]
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.scheduled = []
+
+    def schedule(self, delay_ms, fn):
+        task = SimpleNamespace(fn=fn, cancelled=False)
+        task.cancel = lambda: setattr(task, "cancelled", True)
+        self.scheduled.append(task)
+        return task
+
+
+def _fast_paxos(serialize):
+    from rapid_tpu.types import Endpoint
+
+    me = Endpoint.from_parts("10.0.0.1", 1)
+    client = SimpleNamespace(
+        send_message_best_effort=lambda remote, msg: None
+    )
+    broadcaster = SimpleNamespace(broadcast=lambda msg: None)
+    sched = _FakeScheduler()
+    fp = FastPaxos(
+        me, configuration_id=7, membership_size=4, client=client,
+        broadcaster=broadcaster, scheduler=sched,
+        on_decide=lambda hosts: None, serialize=serialize,
+    )
+    return fp, sched, me
+
+
+def test_fast_paxos_fallback_reenters_through_serializer():
+    """concur: the classic-round fallback fires on the timer thread; it must
+    hop through the injected serializer before touching consensus state, not
+    call start_classic_paxos_round in place."""
+    posted = []
+    fp, sched, me = _fast_paxos(serialize=posted.append)
+    fp.propose([me], recovery_delay_ms=5)
+    assert len(sched.scheduled) == 1
+    sched.scheduled[0].fn()  # the timer firing
+    # nothing ran yet: the round start is parked on the serializer
+    assert posted == [fp.start_classic_paxos_round]
+
+
+def test_fast_paxos_default_serializer_is_direct_call():
+    """The single-threaded virtual plane passes no serializer; the fallback
+    must still reach the classic round synchronously."""
+    fp, sched, me = _fast_paxos(serialize=None)
+    started = []
+    fp.start_classic_paxos_round = lambda: started.append(1)
+    fp._classic_round_fallback()
+    assert started == [1]
+
+
+def test_gateway_warn_once_is_thread_safe():
+    """concur: the warn-once set is hit by the probe reader thread and the
+    protocol thread; exactly one of N concurrent callers may win."""
+    from rapid_tpu.types import Endpoint
+
+    fake = SimpleNamespace(
+        _warned_lock=make_lock("test.SwarmGateway._warned_lock"),
+        _warned_unowned=set(),
+    )
+    dst = Endpoint.from_parts("10.0.0.9", 9)
+    wins = []
+    barrier = threading.Barrier(8, timeout=20)
+
+    def race():
+        barrier.wait()
+        if SwarmGateway._warn_unowned_once(fake, dst):
+            wins.append(1)
+
+    threads = [threading.Thread(target=race, daemon=True) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert len(wins) == 1
+    # a different endpoint warns independently
+    other = Endpoint.from_parts("10.0.0.10", 9)
+    assert SwarmGateway._warn_unowned_once(fake, other)
+    assert not SwarmGateway._warn_unowned_once(fake, other)
+
+
+def test_tcp_dial_happens_outside_the_connection_cache_lock(monkeypatch):
+    """concur: connect() can block for seconds on a dead peer; dialing under
+    _conn_lock stalls every sender on the node. The dial must run with the
+    lock released."""
+    from rapid_tpu.messaging import tcp as tcp_mod
+    from rapid_tpu.types import Endpoint
+
+    cs = tcp_mod.TcpClientServer(Endpoint.from_parts("127.0.0.1", 0))
+    held_during_dial = []
+
+    class _FakeConn:
+        def __init__(self, remote, timeout_s):
+            held_during_dial.append(cs._conn_lock.locked())
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    monkeypatch.setattr(tcp_mod, "_Connection", _FakeConn)
+    remote = Endpoint.from_parts("10.0.0.2", 4)
+    conn = cs._connection(remote)
+    assert held_during_dial == [False]
+    assert cs._connection(remote) is conn  # cached: no second dial
+    assert held_during_dial == [False]
+
+
+def test_tcp_dial_race_loser_closes_its_fresh_connection(monkeypatch):
+    """Two threads dialing the same remote: the loser must adopt the winner's
+    established connection and close its own, never clobber the cache."""
+    from rapid_tpu.messaging import tcp as tcp_mod
+    from rapid_tpu.types import Endpoint
+
+    cs = tcp_mod.TcpClientServer(Endpoint.from_parts("127.0.0.1", 0))
+    remote = Endpoint.from_parts("10.0.0.3", 4)
+    winner = SimpleNamespace(closed=False, close=lambda: None)
+    fresh_conns = []
+
+    class _RacingConn:
+        def __init__(self, r, timeout_s):
+            # while this thread was dialing, another thread won the race
+            cs._connections[remote] = winner
+            self.closed = False
+            fresh_conns.append(self)
+
+        def close(self):
+            self.closed = True
+
+    monkeypatch.setattr(tcp_mod, "_Connection", _RacingConn)
+    got = cs._connection(remote)
+    assert got is winner
+    assert cs._connections[remote] is winner  # cache not clobbered
+    # the loser's fresh socket was closed, not leaked
+    assert len(fresh_conns) == 1 and fresh_conns[0].closed
+
+
+def test_cluster_shutdown_runs_teardown_exactly_once_under_races():
+    """concur: shutdown() races leave_gracefully_async's completion callback
+    against user-thread calls; exactly one caller may run the (blocking)
+    teardown, and it must run outside the flag lock."""
+    calls = {"server": 0, "service": 0, "resources": 0}
+    fake = SimpleNamespace(
+        _shutdown_lock=make_lock("test.Cluster._shutdown_lock"),
+        _has_shutdown=False,
+        _server=SimpleNamespace(
+            shutdown=lambda: calls.__setitem__("server", calls["server"] + 1)
+        ),
+        _membership_service=SimpleNamespace(
+            shutdown=lambda: calls.__setitem__("service", calls["service"] + 1)
+        ),
+        _resources=SimpleNamespace(
+            shutdown=lambda: calls.__setitem__(
+                "resources", calls["resources"] + 1
+            )
+        ),
+    )
+    barrier = threading.Barrier(6, timeout=20)
+
+    def caller():
+        barrier.wait()
+        Cluster.shutdown(fake)
+
+    threads = [threading.Thread(target=caller, daemon=True) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert calls == {"server": 1, "service": 1, "resources": 1}

@@ -198,3 +198,73 @@ def test_telemetry_overhead_within_budget():
         f"telemetry overhead: instrumented={instrumented * 1e3:.1f}ms "
         f"noop={noop * 1e3:.1f}ms"
     )
+
+
+def test_lockdep_overhead_within_budget(monkeypatch):
+    """RAPID_LOCKDEP=1 is on for the whole tier-1 battery (conftest), so the
+    instrumentation must be cheap enough to leave the bench contract intact:
+    the warmed decision loop with instrumented locks stays within the same
+    envelope as plain threading locks, and the wrapper's per-acquire cost is
+    bounded in absolute terms.
+
+    enabled() is sampled at make_lock() time, so toggling the env var around
+    construction is what flips a scenario between plain and instrumented.
+    """
+    import sys
+    import time
+
+    import numpy as np
+
+    from rapid_tpu.observability import Metrics
+    from rapid_tpu.runtime import lockdep
+    from rapid_tpu.sim.driver import Simulator
+
+    # tools/coverage.py's settrace collector pays a call event on every
+    # wrapper frame the plain C lock never makes; timing bounds are
+    # meaningless under it
+    traced = sys.gettrace() is not None
+
+    # -- micro: the wrapper itself ----------------------------------------
+    def per_op(lock, ops=20_000, runs=3):
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                with lock:
+                    pass
+            best = min(best, time.perf_counter() - t0)
+        return best / ops
+
+    monkeypatch.setenv("RAPID_LOCKDEP", "0")
+    plain_op = per_op(lockdep.make_lock("bench.plain"))
+    monkeypatch.setenv("RAPID_LOCKDEP", "1")
+    inst_op = per_op(lockdep.make_lock("bench.instrumented"))
+    # TLS stack walk + one graph-lock hop: an order of magnitude over a raw
+    # lock is expected; tens of microseconds per op is not
+    budget = 200e-6 if traced else 20e-6
+    assert inst_op < budget, f"instrumented acquire: {inst_op * 1e6:.1f}us/op"
+    assert inst_op < plain_op * 200 + budget
+
+    # -- macro: the warmed decision loop, locks created under each mode ----
+    def best_of(runs=5):
+        best = float("inf")
+        for _ in range(runs):
+            sim = Simulator(64, seed=5, metrics=Metrics())
+            sim.ready()
+            sim.crash(np.array([3]))
+            t0 = time.perf_counter()
+            record = sim.run_until_decision(max_rounds=40)
+            best = min(best, time.perf_counter() - t0)
+            assert record is not None
+        return best
+
+    best_of(runs=1)  # jit warmup, shapes shared by both sides
+    monkeypatch.setenv("RAPID_LOCKDEP", "0")
+    plain = best_of()
+    monkeypatch.setenv("RAPID_LOCKDEP", "1")
+    instrumented = best_of()
+    slack = 0.25 if traced else 0.05
+    assert instrumented <= plain * 1.10 + slack, (
+        f"lockdep overhead: instrumented={instrumented * 1e3:.1f}ms "
+        f"plain={plain * 1e3:.1f}ms"
+    )

@@ -1,0 +1,318 @@
+"""The concurrency correctness suite is itself under test.
+
+Three layers, all tier-1:
+
+1. repo gates: ``python tools/concur.py`` and ``python tools/check.py --all``
+   must exit 0 on today's tree (the analyzers are a merge gate, so the tree
+   must stay finding-free);
+2. rule fixtures: every rule fires on its ``tests/fixtures/concur/bad_*.py``
+   exemplar and stays silent on the matching ``good_*.py`` -- both
+   directions pinned, so a rule can neither silently die nor start
+   misfiring on the corrected idiom;
+3. runtime lockdep: the make_lock seam fails fast on order cycles and
+   non-reentrant re-entry, records through blanket exception handlers, and
+   costs nothing when RAPID_LOCKDEP is off.
+
+The fixtures are never imported (several would deadlock); the analyzers read
+them as text, and lintlib excludes ``fixtures`` dirs from every default scan.
+"""
+
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "concur"
+
+sys.path.insert(0, str(REPO / "tools"))
+
+import check  # noqa: E402
+import concur  # noqa: E402
+from lintlib import Finding, iter_py_files  # noqa: E402
+
+
+def _concur_rules(path: Path) -> set:
+    return {f.rule for f in concur.run([str(path)])}
+
+
+def _hygiene_rules(path: Path) -> set:
+    # the two concurrency-hygiene rules live in check.py; general code-health
+    # rules (unused-import etc.) are not what the fixtures pin
+    return {
+        f.rule
+        for f in check.check_file(path)
+        if f.rule in ("thread-daemon", "callback-under-lock")
+    }
+
+
+# ---------------------------------------------------------------------------
+# 1. repo gates
+# ---------------------------------------------------------------------------
+
+
+def _run_tool(*argv):
+    return subprocess.run(
+        [sys.executable, *argv],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_concur_clean_on_repo():
+    proc = _run_tool("tools/concur.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "concur: OK" in proc.stdout
+
+
+def test_check_all_clean_on_repo():
+    proc = _run_tool("tools/check.py", "--all")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check+concur: OK" in proc.stdout
+
+
+def test_check_rules_prints_full_catalog():
+    proc = _run_tool("tools/check.py", "--rules")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for rule in check.RULE_DOCS:
+        assert rule in proc.stdout
+
+
+def test_default_scan_skips_fixture_corpus():
+    """The deliberately-bad exemplars must never leak into a default scan."""
+    scanned = iter_py_files([Path("tests")])
+    assert scanned, "tests/ scan came back empty"
+    assert not any("fixtures" in f.parts for f in scanned)
+
+
+# ---------------------------------------------------------------------------
+# 2. rule fixtures, both directions
+# ---------------------------------------------------------------------------
+
+CONCUR_FIXTURES = [
+    ("bad_lock_order.py", "lock-order"),
+    ("bad_unguarded_write.py", "unguarded-write"),
+    ("bad_guard_not_held.py", "unguarded-write"),
+    ("bad_blocking_under_lock.py", "blocking-under-lock"),
+    ("bad_unbalanced_acquire.py", "unbalanced-acquire"),
+    ("bad_jit_purity.py", "jit-purity"),
+]
+
+HYGIENE_FIXTURES = [
+    ("bad_thread_daemon.py", "thread-daemon"),
+    ("bad_callback_under_lock.py", "callback-under-lock"),
+]
+
+GOOD_CONCUR = [
+    "good_lock_order.py",
+    "good_unguarded_write.py",
+    "good_blocking_under_lock.py",
+    "good_unbalanced_acquire.py",
+    "good_jit_purity.py",
+]
+
+GOOD_HYGIENE = [
+    "good_thread_daemon.py",
+    "good_callback_under_lock.py",
+]
+
+
+def test_fixture_corpus_is_complete():
+    """Every fixture on disk is pinned by exactly one table above, and every
+    table entry exists on disk -- a new fixture without a test (or a renamed
+    fixture orphaning its pin) fails here."""
+    on_disk = {f.name for f in FIXTURES.glob("*.py")}
+    pinned = (
+        {name for name, _ in CONCUR_FIXTURES}
+        | {name for name, _ in HYGIENE_FIXTURES}
+        | set(GOOD_CONCUR)
+        | set(GOOD_HYGIENE)
+    )
+    assert pinned == on_disk
+
+
+@pytest.mark.parametrize("name,rule", CONCUR_FIXTURES)
+def test_concur_rule_fires_on_bad_fixture(name, rule):
+    assert rule in _concur_rules(FIXTURES / name)
+
+
+@pytest.mark.parametrize("name,rule", HYGIENE_FIXTURES)
+def test_hygiene_rule_fires_on_bad_fixture(name, rule):
+    assert rule in _hygiene_rules(FIXTURES / name)
+
+
+@pytest.mark.parametrize("name", GOOD_CONCUR)
+def test_concur_silent_on_good_fixture(name):
+    assert _concur_rules(FIXTURES / name) == set()
+
+
+@pytest.mark.parametrize("name", GOOD_HYGIENE)
+def test_hygiene_silent_on_good_fixture(name):
+    assert _hygiene_rules(FIXTURES / name) == set()
+
+
+def test_noqa_suppresses_concur_finding(tmp_path):
+    """`# noqa: RULE` is the one shared escape hatch; case-insensitive."""
+    bad = (FIXTURES / "bad_blocking_under_lock.py").read_text()
+    assert "time.sleep" in bad
+    # suppress only the sleeping line, not the whole file; mixed case on
+    # purpose -- rule matching is case-insensitive
+    out = []
+    for line in bad.splitlines(keepends=True):
+        if "time.sleep" in line:
+            line = line.rstrip("\n") + "  # noqa: Blocking-Under-Lock\n"
+        out.append(line)
+    target = tmp_path / "suppressed.py"
+    target.write_text("".join(out))
+    assert "blocking-under-lock" not in _concur_rules(target)
+
+
+def test_every_emitted_rule_is_documented():
+    """RULE_DOCS is the catalog of record: any rule a fixture can emit must
+    have a one-line rationale there."""
+    emitted = set()
+    for name, rule in CONCUR_FIXTURES + HYGIENE_FIXTURES:
+        emitted.add(rule)
+    assert emitted <= set(check.RULE_DOCS)
+
+
+def test_finding_renders_repo_relative():
+    f = Finding(REPO / "rapid_tpu" / "cluster.py", 7, "lock-order", "boom")
+    assert str(f) == "rapid_tpu/cluster.py:7: lock-order boom"
+
+
+# ---------------------------------------------------------------------------
+# 3. runtime lockdep
+# ---------------------------------------------------------------------------
+
+from rapid_tpu.runtime import lockdep  # noqa: E402
+
+
+def test_lockdep_enabled_by_conftest():
+    # the whole tier-1 suite runs instrumented (conftest sets RAPID_LOCKDEP=1
+    # before any rapid_tpu import)
+    assert lockdep.enabled()
+
+
+def test_lockdep_detects_order_cycle():
+    a = lockdep.make_lock("t_cycle.A")
+    b = lockdep.make_lock("t_cycle.B")
+    with a:
+        with b:
+            pass  # teaches the graph A -> B
+    with b:
+        with pytest.raises(lockdep.LockOrderViolation) as exc:
+            a.acquire()
+        assert "t_cycle.A" in str(exc.value) and "t_cycle.B" in str(exc.value)
+    recorded = lockdep.consume_violations()
+    assert len(recorded) == 1 and "closes a cycle" in recorded[0]
+
+
+def test_lockdep_transitive_cycle_through_third_class():
+    a = lockdep.make_lock("t_chain.A")
+    b = lockdep.make_lock("t_chain.B")
+    c = lockdep.make_lock("t_chain.C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(lockdep.LockOrderViolation):
+            a.acquire()  # A reaches C via B: C -> A closes the loop
+    assert lockdep.consume_violations()
+
+
+def test_lockdep_same_instance_reentry_fails_without_deadlocking():
+    lock = lockdep.make_lock("t_reentry.L")
+    with lock:
+        # a plain threading.Lock would hang this thread forever here; the
+        # wrapper must report instead of blocking
+        with pytest.raises(lockdep.LockOrderViolation) as exc:
+            lock.acquire()
+    assert "re-entry" in str(exc.value)
+    assert lockdep.consume_violations()
+
+
+def test_lockdep_rlock_reentry_is_fine():
+    lock = lockdep.make_rlock("t_rlock.L")
+    with lock:
+        with lock:
+            pass
+    assert lockdep.violations() == []
+
+
+def test_lockdep_same_class_cross_instance_nesting_allowed():
+    parent = lockdep.make_lock("t_sibling.Node._lock")
+    child = lockdep.make_lock("t_sibling.Node._lock")
+    with parent:
+        with child:  # same class, different instances: no edge, no cycle
+            pass
+    assert lockdep.violations() == []
+
+
+def test_lockdep_consistent_order_never_fires():
+    a = lockdep.make_lock("t_consistent.A")
+    b = lockdep.make_lock("t_consistent.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockdep.violations() == []
+
+
+def test_lockdep_violation_recorded_even_when_swallowed():
+    """Protocol threads run under blanket handlers; the raise may vanish but
+    the session gate must still see the violation."""
+    a = lockdep.make_lock("t_swallow.A")
+    b = lockdep.make_lock("t_swallow.B")
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except Exception:
+            pass  # the blanket handler
+
+    t = threading.Thread(target=inverted, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    recorded = lockdep.consume_violations()
+    assert len(recorded) == 1 and "t_swallow" in recorded[0]
+
+
+def test_lockdep_locked_matches_threading_surface():
+    lock = lockdep.make_lock("t_surface.L")
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+def test_lockdep_off_returns_plain_primitives(monkeypatch):
+    monkeypatch.setenv("RAPID_LOCKDEP", "0")
+    assert not lockdep.enabled()
+    lock = lockdep.make_lock("t_off.L")
+    rlock = lockdep.make_rlock("t_off.R")
+    assert not isinstance(lock, lockdep._InstrumentedLock)
+    assert not isinstance(rlock, lockdep._InstrumentedLock)
+    with lock:
+        pass
+    with rlock:
+        with rlock:
+            pass
+
+
+def test_lockdep_condition_never_instrumented():
+    cond = lockdep.make_condition("t_cond.C")
+    assert isinstance(cond, threading.Condition)

@@ -12,9 +12,18 @@ build-common/). This repo's equivalents:
   `== None` comparisons, always-true tuple asserts, duplicate dict keys,
   debugger/print leftovers in library code).
 
-Suppress a single line with `# noqa` or `# noqa: RULE`.
+Concurrency hygiene rules that belong with general code health live here too
+(thread-daemon, callback-under-lock); the deep concurrency analysis (lock
+graphs, write contexts, jit purity) is tools/concur.py. `--all` runs both
+with one merged exit code. Rule names and one-line rationales: RULE_DOCS
+below (printed by `--rules`), with the full convention write-up in
+ARCHITECTURE.md "Concurrency discipline & static analysis".
 
-Usage: python tools/check.py [paths...]   (default: the repo's source roots)
+Suppress a single line with `# noqa` or `# noqa: RULE` (rule names are
+case-insensitive; shared with tools/concur.py via tools/lintlib.py).
+
+Usage: python tools/check.py [--all|--rules] [paths...]
+       (default paths: the repo's source roots)
 """
 
 from __future__ import annotations
@@ -24,9 +33,49 @@ import importlib.util
 import sys
 from pathlib import Path
 
+if __package__ in (None, ""):
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from lintlib import Finding, iter_py_files, noqa_lines, suppressed
+else:  # pragma: no cover - imported as a package module
+    from .lintlib import Finding, iter_py_files, noqa_lines, suppressed
+
 REPO = Path(__file__).resolve().parent.parent
 DEFAULT_PATHS = ["rapid_tpu", "tests", "examples", "experiments", "tools",
                  "bench.py", "scenarios.py", "__graft_entry__.py"]
+
+# one-line rationale per rule, both analyzers (`--rules` prints this)
+RULE_DOCS = {
+    # tools/check.py -- code health
+    "syntax": "file must byte-compile; everything else assumes it does",
+    "unused-import": "dead imports hide real dependencies and slow startup",
+    "mutable-default": "def f(x=[]) shares one list across all calls",
+    "bare-except": "except: swallows KeyboardInterrupt/SystemExit too",
+    "none-compare": "== None matches __eq__ overrides; use 'is None'",
+    "assert-tuple": "assert (x, msg) is always true -- a silent no-op test",
+    "dup-dict-key": "duplicate literal keys: the first value silently loses",
+    "print-in-lib": "library code must log or record, not print",
+    "debugger": "breakpoint()/pdb left in committed code",
+    "unknown-metric": "metric names outside the catalog fork the series",
+    "unknown-span": "span/event names outside the catalog fork the trace",
+    "wire-tag": "wire tags must stay unique and append-only across versions",
+    "fault-catalog": "fault rules must declare a compiled/absorbed story",
+    # tools/check.py -- concurrency hygiene
+    "thread-daemon": "a non-daemon thread outlives shutdown and hangs exit; "
+                     "mark daemon=True or provably join it",
+    "callback-under-lock": "user callbacks invoked under a lock can re-enter "
+                           "and deadlock; call them after release",
+    # tools/concur.py -- concurrency correctness
+    "lock-order": "a cycle in the held->acquired lock graph is a potential "
+                  "deadlock; keep the hierarchy acyclic",
+    "unguarded-write": "an attribute written from >=2 execution contexts "
+                       "with no common lock is a data race",
+    "blocking-under-lock": "blocking (socket/sleep/result/wait/join) while "
+                           "holding a lock stalls every other acquirer",
+    "unbalanced-acquire": "manual acquire() without release() in a finally "
+                          "leaks the lock on any exception; use 'with'",
+    "jit-purity": "side effects in jit/pallas/shard_map functions run once "
+                  "at trace time, then never again -- silent wrong results",
+}
 
 # modules where `print` is the intended UI (CLIs, benchmarks, experiments)
 PRINT_OK_ROOTS = ("examples", "experiments", "tools", "tests")
@@ -59,36 +108,12 @@ SPAN_METHODS = ("span", "begin", "remote_span")
 EVENT_METHODS = ("event", "record")
 
 
-class Finding:
-    def __init__(self, path: Path, line: int, rule: str, msg: str) -> None:
-        self.path, self.line, self.rule, self.msg = path, line, rule, msg
-
-    def __str__(self) -> str:
-        rel = self.path.relative_to(REPO) if self.path.is_absolute() else self.path
-        return f"{rel}:{self.line}: {self.rule} {self.msg}"
-
-
-def _noqa_lines(source: str) -> dict[int, set[str]]:
-    """line -> suppressed rules ('*' = all)."""
-    out: dict[int, set[str]] = {}
-    for i, line in enumerate(source.splitlines(), 1):
-        if "# noqa" not in line:
-            continue
-        _, _, tail = line.partition("# noqa")
-        tail = tail.strip()
-        if tail.startswith(":"):
-            out[i] = {r.strip() for r in tail[1:].split(",")}
-        else:
-            out[i] = {"*"}
-    return out
-
-
 class Checker(ast.NodeVisitor):
     def __init__(self, path: Path, source: str, tree: ast.Module) -> None:
         self.path = path
         self.tree = tree
         self.findings: list[Finding] = []
-        self._noqa = _noqa_lines(source)
+        self._noqa = noqa_lines(source)
         rel = path.relative_to(REPO)
         self.print_ok = (
             rel.parts[0] in PRINT_OK_ROOTS or rel.name in PRINT_OK_FILES
@@ -101,8 +126,7 @@ class Checker(ast.NodeVisitor):
 
     def report(self, node: ast.AST, rule: str, msg: str) -> None:
         line = getattr(node, "lineno", 0)
-        suppressed = self._noqa.get(line, set())
-        if "*" in suppressed or rule in suppressed:
+        if suppressed(self._noqa, line, rule):
             return
         self.findings.append(Finding(self.path, line, rule, msg))
 
@@ -485,7 +509,82 @@ def check_fault_rules() -> list[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# concurrency hygiene (library code + analyzer fixtures only: tests, CLIs
+# and experiments legitimately make short-lived foreground threads and
+# invoke callables however they like)
+# ---------------------------------------------------------------------------
+
+CALLBACK_NAMES = {
+    "callback", "callbacks", "cb", "fn", "func", "handler", "handlers",
+    "subscriber", "subscribers", "listener", "listeners", "notifier",
+    "hook", "hooks",
+}
+_LOCKISH = ("lock", "mutex", "cond")
+
+
+def _hygiene_target(path: Path) -> bool:
+    parts = set(path.parts)
+    return "rapid_tpu" in parts or "fixtures" in parts
+
+
+class _HygieneVisitor(ast.NodeVisitor):
+    """thread-daemon + callback-under-lock, tracked through `with` bodies."""
+
+    def __init__(self, path: Path, noqa: "dict[int, set[str]]") -> None:
+        self.path = path
+        self.noqa = noqa
+        self.findings: list[Finding] = []
+        self._locks_held = 0
+
+    def _report(self, node: ast.AST, rule: str, msg: str) -> None:
+        if not suppressed(self.noqa, node.lineno, rule):
+            self.findings.append(Finding(self.path, node.lineno, rule, msg))
+
+    @staticmethod
+    def _terminal(expr: ast.expr) -> "str | None":
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = sum(
+            1 for item in node.items
+            if (name := self._terminal(item.context_expr)) is not None
+            and any(t in name.lower() for t in _LOCKISH)
+        )
+        self._locks_held += lockish
+        self.generic_visit(node)
+        self._locks_held -= lockish
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self._terminal(node.func)
+        if name == "Thread":
+            daemon = next(
+                (kw.value for kw in node.keywords if kw.arg == "daemon"), None
+            )
+            if not (isinstance(daemon, ast.Constant) and daemon.value is True):
+                self._report(
+                    node, "thread-daemon",
+                    "threading.Thread in library code must be daemon=True "
+                    "(or join it on shutdown and suppress this line)",
+                )
+        if self._locks_held and name is not None:
+            if name in CALLBACK_NAMES or name.startswith("on_"):
+                self._report(
+                    node, "callback-under-lock",
+                    f"calling {name}() while holding a lock: a callback "
+                    f"that re-enters this object deadlocks; snapshot under "
+                    f"the lock, call after release",
+                )
+        self.generic_visit(node)
+
+
 def check_file(path: Path) -> list[Finding]:
+    if not path.is_absolute():
+        path = REPO / path
     source = path.read_text()
     try:
         # compile() rather than py_compile: Python 3.12 refuses non-regular
@@ -497,27 +596,44 @@ def check_file(path: Path) -> list[Finding]:
     checker = Checker(path, source, tree)
     checker.check_unused_imports()
     checker.visit(tree)
-    return checker.findings
+    findings = checker.findings
+    if _hygiene_target(path):
+        hygiene = _HygieneVisitor(path, noqa_lines(source))
+        hygiene.visit(tree)
+        findings.extend(hygiene.findings)
+    return findings
 
 
-def main(argv: list[str]) -> int:
-    roots = [Path(p) for p in (argv or DEFAULT_PATHS)]
-    files: list[Path] = []
-    for root in roots:
-        root = (REPO / root) if not root.is_absolute() else root
-        if root.is_dir():
-            files.extend(sorted(root.rglob("*.py")))
-        elif root.exists():
-            files.append(root)
+def run(paths: "list[str] | None" = None) -> list[Finding]:
+    """Importable entry point (mirrors concur.run)."""
+    files = iter_py_files([Path(p) for p in (paths or DEFAULT_PATHS)])
     findings: list[Finding] = []
     for f in files:
         findings.extend(check_file(f))
     findings.extend(check_wire_tags())
     findings.extend(check_fault_rules())
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    if "--rules" in argv:
+        width = max(len(r) for r in RULE_DOCS)
+        for rule, why in RULE_DOCS.items():
+            print(f"{rule:<{width}}  {why}")
+        return 0
+    run_all = "--all" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    findings = run(paths or None)
+    if run_all:
+        if __package__ in (None, ""):
+            import concur
+        else:  # pragma: no cover - imported as a package module
+            from . import concur
+        findings.extend(concur.run())  # concur's own default: rapid_tpu
     for finding in findings:
         print(finding)
-    print(f"checked {len(files)} files: "
-          f"{'OK' if not findings else f'{len(findings)} findings'}")
+    label = "check+concur" if run_all else "check"
+    print(f"{label}: {'OK' if not findings else f'{len(findings)} findings'}")
     return 1 if findings else 0
 
 
