@@ -174,6 +174,49 @@ def topr_full(
     return assign, scores
 
 
+# jitted whole-map builders, keyed by the two Python values baked into the
+# trace -- a fresh ``jax.jit`` per ``build_jit`` call would otherwise start
+# an empty compile cache every call and re-trace the identical program
+_BUILD_CACHE: dict = {}
+
+
+def _builder(n_instances: int, replicas: int):
+    """The jitted map builder for a (virtual-instance, replica) shape,
+    compiled once per process and shared by every later ``build_jit``."""
+    import jax.numpy as jnp
+
+    from ..runtime.jitwatch import make_jit
+
+    cached = _BUILD_CACHE.get((n_instances, replicas))
+    if cached is not None:
+        return cached
+
+    def _build(p32, inst, w, act):
+        acc = jnp.zeros((p32.shape[0], inst.shape[1]), dtype=jnp.uint32)
+        for v in range(n_instances):
+            h = (p32[:, None] ^ inst[v][None, :]) * jnp.uint32(MIX1)
+            h = h ^ (h >> jnp.uint32(15))
+            h = h * jnp.uint32(MIX2)
+            h = h ^ (h >> jnp.uint32(13))
+            h = jnp.where(w[None, :] > v, h, jnp.uint32(0))
+            acc = jnp.maximum(acc, h)
+        key = jnp.where(act[None, :], acc, jnp.uint32(0))
+        col = jnp.arange(key.shape[1], dtype=jnp.int32)[None, :]
+        picks, vals = [], []
+        for _ in range(replicas):
+            a = jnp.argmax(key, axis=1).astype(jnp.int32)
+            v = jnp.max(key, axis=1)
+            picks.append(jnp.where(v > 0, a, jnp.int32(-1)))
+            vals.append(v)
+            key = jnp.where(col == a[:, None], jnp.uint32(0), key)
+        return jnp.stack(picks, axis=1), jnp.stack(vals, axis=1)
+
+    jitted = _BUILD_CACHE[(n_instances, replicas)] = make_jit(  # devlint: jit-cached
+        "placement.build_jit", _build
+    )
+    return jitted
+
+
 def build_jit(
     part32: np.ndarray,
     inst32: np.ndarray,
@@ -195,28 +238,7 @@ def build_jit(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n_instances = int(inst32.shape[0])
-
-    @jax.jit
-    def _build(p32, inst, w, act):
-        acc = jnp.zeros((p32.shape[0], inst.shape[1]), dtype=jnp.uint32)
-        for v in range(n_instances):
-            h = (p32[:, None] ^ inst[v][None, :]) * jnp.uint32(MIX1)
-            h = h ^ (h >> jnp.uint32(15))
-            h = h * jnp.uint32(MIX2)
-            h = h ^ (h >> jnp.uint32(13))
-            h = jnp.where(w[None, :] > v, h, jnp.uint32(0))
-            acc = jnp.maximum(acc, h)
-        key = jnp.where(act[None, :], acc, jnp.uint32(0))
-        col = jnp.arange(key.shape[1], dtype=jnp.int32)[None, :]
-        picks, vals = [], []
-        for _ in range(replicas):
-            a = jnp.argmax(key, axis=1).astype(jnp.int32)
-            v = jnp.max(key, axis=1)
-            picks.append(jnp.where(v > 0, a, jnp.int32(-1)))
-            vals.append(v)
-            key = jnp.where(col == a[:, None], jnp.uint32(0), key)
-        return jnp.stack(picks, axis=1), jnp.stack(vals, axis=1)
+    _build = _builder(int(inst32.shape[0]), replicas)
 
     p32 = jnp.asarray(part32, dtype=jnp.uint32)
     inst = jnp.asarray(inst32, dtype=jnp.uint32)

@@ -1,0 +1,446 @@
+"""Runtime compile / host-transfer watchdog for the device plane.
+
+The runtime half of the device-plane performance suite (the static half is
+tools/devlint.py), built on the same seam discipline as lockdep.py: device
+modules never call ``jax.jit`` / ``pl.pallas_call`` directly -- they go
+through :func:`make_jit` / :func:`make_pallas_call`, which return the plain
+jax objects when ``RAPID_JITWATCH`` is unset (zero overhead in production)
+and instrumented wrappers when ``RAPID_JITWATCH=1`` (the tier-1 conftest
+default).
+
+What the wrapper records, per call-site *class* (the name passed to
+``make_jit``):
+
+- every compilation, detected from the jit object's executable-cache growth
+  (``_cache_size``), so recompiles my own signature model would miss --
+  donation, sharding or weak-type cache splits -- still count. Each event
+  carries the *abstract signature* of the triggering call (shape / dtype /
+  weak-type per traced leaf, values for statics), the wall time of that
+  first call (trace + compile + execute -- the cost a steady-state caller
+  would NOT have paid), and whether a timed window was open.
+- a per-class compile budget (default ``RAPID_JITWATCH_BUDGET``, 512): a
+  class that keeps compiling is leaking cache keys. Breaches record a
+  violation *then* raise, so blanket ``except Exception`` handlers cannot
+  swallow them silently -- the session-end conftest gate re-checks
+  :func:`violations`.
+
+Timed windows (:func:`timed_window`) declare a measured steady-state region:
+any compilation inside one is a violation (warmup belongs outside), and
+``jax.transfer_guard("disallow")`` is armed so implicit host transfers --
+``int()`` on a traced value, numpy operands handed to a jitted call, python
+scalars materialized per dispatch -- fail at the offending line. Deliberate
+transfers route through the audited seams: :func:`fetch` (the one
+device->host sync a protocol batch is allowed), :func:`drain` (a
+block-until-ready barrier outside the measured region), and
+:func:`host_transfer` (re-allows transfers for a labeled block, e.g. a
+one-time scalar-constant upload). The guard is thread-local, so the
+speculation worker's uploads never trip a window armed on the main thread.
+
+Env vars:
+
+- ``RAPID_JITWATCH=1``     enable (sampled at seam-creation time, like
+                           lockdep; the wrapper also re-checks per call so
+                           overhead A/B tests can toggle it)
+- ``RAPID_JITWATCH_BUDGET`` per-class compile budget (default 512)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+
+class JitwatchViolation(RuntimeError):
+    """A compile-budget breach or steady-state recompile."""
+
+
+def enabled() -> bool:
+    """Whether jitwatch is armed. Sampled at seam *creation* time to pick
+    raw-vs-wrapped, and again per call so a wrapper created under
+    ``RAPID_JITWATCH=1`` can be silenced for A/B overhead measurements."""
+    return os.environ.get("RAPID_JITWATCH", "") == "1"
+
+
+def _default_budget() -> int:
+    return int(os.environ.get("RAPID_JITWATCH_BUDGET", "512"))
+
+
+@dataclass(frozen=True)
+class CompileEvent:
+    """One recorded compilation (or pallas trace) of a watched class."""
+
+    name: str  # call-site class (the make_jit name)
+    signature: Tuple[Any, ...]  # abstract signature of the triggering call
+    wall_s: float  # wall time of the compiling call (trace+compile+run)
+    steady: bool  # a timed window was open on the calling thread
+    kind: str  # "jit" | "pallas"
+
+
+_LOCK = threading.Lock()
+_EVENTS: List[CompileEvent] = []
+_COUNTS: Dict[str, int] = {}
+_SYNCS: Dict[str, int] = {}
+_VIOLATIONS: List[str] = []
+_TLS = threading.local()
+
+
+def _windows() -> List[str]:
+    stack = getattr(_TLS, "windows", None)
+    if stack is None:
+        stack = _TLS.windows = []
+    return stack
+
+
+def _fail(msg: str) -> None:
+    """Record then raise, so a blanket handler around the call site cannot
+    make the violation disappear -- the conftest session gate re-reads
+    ``violations()`` (the lockdep precedent)."""
+    with _LOCK:
+        _VIOLATIONS.append(msg)
+    raise JitwatchViolation(msg)
+
+
+def _abstract_leaf(leaf: Any) -> Tuple[Any, ...]:
+    shape = getattr(leaf, "shape", None)
+    if shape is not None:
+        return (
+            tuple(shape),
+            str(getattr(leaf, "dtype", "?")),
+            bool(getattr(leaf, "weak_type", False)),
+        )
+    return ("py", type(leaf).__name__)
+
+
+def _static_key(value: Any) -> Any:
+    try:
+        hash(value)
+        return value
+    except TypeError:
+        return ("unhashable", repr(type(value)))
+
+
+class _WatchedJit:
+    """Instrumented stand-in for a ``jax.jit``-wrapped callable."""
+
+    def __init__(
+        self,
+        name: str,
+        jitted: Callable,
+        static_argnums: Tuple[int, ...],
+        static_argnames: Tuple[str, ...],
+        compile_budget: Optional[int],
+    ) -> None:
+        self.name = name
+        self._jitted = jitted
+        self._static_argnums = static_argnums
+        self._static_argnames = static_argnames
+        self.compile_budget = (
+            compile_budget if compile_budget is not None else _default_budget()
+        )
+        self._lock = threading.Lock()
+        self._cache_size = getattr(jitted, "_cache_size", None)
+        self._last_size = 0  # guarded-by: _lock
+        # fallback compile detection when the jit object has no cache
+        # counter: first sight of an abstract signature
+        self._seen = set()  # guarded-by: _lock
+
+    def signature_of(self, *args: Any, **kwargs: Any) -> Tuple[Any, ...]:
+        """The abstract signature this wrapper classes calls by: static args
+        by value, traced args by per-leaf (shape, dtype, weak_type)."""
+        pos = []
+        for i, a in enumerate(args):
+            if i in self._static_argnums:
+                pos.append(("static", _static_key(a)))
+            else:
+                pos.append(
+                    ("traced", tuple(
+                        _abstract_leaf(leaf)
+                        for leaf in jax.tree_util.tree_leaves(a)
+                    ))
+                )
+        kw = []
+        for k in sorted(kwargs):
+            if k in self._static_argnames:
+                kw.append((k, "static", _static_key(kwargs[k])))
+            else:
+                kw.append(
+                    (k, "traced", tuple(
+                        _abstract_leaf(leaf)
+                        for leaf in jax.tree_util.tree_leaves(kwargs[k])
+                    ))
+                )
+        return (tuple(pos), tuple(kw))
+
+    # -- underlying jax.jit API worth forwarding -------------------------- #
+
+    def lower(self, *args: Any, **kwargs: Any):
+        return self._jitted.lower(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        if not enabled():
+            return self._jitted(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        wall = time.perf_counter() - t0
+        compiled = False
+        if self._cache_size is not None:
+            size = self._cache_size()
+            with self._lock:
+                if size != self._last_size:
+                    self._last_size = size
+                    compiled = True
+        else:  # pragma: no cover - older jax without _cache_size
+            sig = self.signature_of(*args, **kwargs)
+            with self._lock:
+                if sig not in self._seen:
+                    self._seen.add(sig)
+                    compiled = True
+        if not compiled:
+            return out
+        signature = self.signature_of(*args, **kwargs)
+        steady = bool(_windows())
+        with _LOCK:
+            _EVENTS.append(
+                CompileEvent(self.name, signature, wall, steady, "jit")
+            )
+            count = _COUNTS[self.name] = _COUNTS.get(self.name, 0) + 1
+        if steady:
+            _fail(
+                f"jitwatch: steady-state recompile of '{self.name}' inside "
+                f"timed window '{_windows()[-1]}' (signature {signature!r}) "
+                "-- warm this call class before the measured region"
+            )
+        if count > self.compile_budget:
+            _fail(
+                f"jitwatch: '{self.name}' compiled {count} times, over its "
+                f"budget of {self.compile_budget} -- the call site is "
+                "leaking jit cache keys (varying static values, shapes, or "
+                "weak types)"
+            )
+        return out
+
+
+def make_jit(
+    name: str,
+    fun: Optional[Callable] = None,
+    *,
+    static_argnums: Any = (),
+    static_argnames: Any = (),
+    donate_argnums: Any = (),
+    compile_budget: Optional[int] = None,
+) -> Callable:
+    """The device plane's only route to ``jax.jit`` (seam, lockdep-style).
+
+    ``name`` is the call-site class every compilation is recorded under.
+    With ``fun`` omitted it curries, so the decorator form mirrors the old
+    ``functools.partial(jax.jit, static_argnums=...)`` idiom::
+
+        @functools.partial(make_jit, "sim.engine.step", static_argnums=0)
+        def step(config, state): ...
+
+    When jitwatch is disabled at creation time the plain ``jax.jit`` object
+    is returned -- zero added overhead, and (like lockdep locks) the wrapper
+    cannot be armed later.
+    """
+    if fun is None:
+        def _bind(f: Callable) -> Callable:
+            return make_jit(
+                name, f, static_argnums=static_argnums,
+                static_argnames=static_argnames,
+                donate_argnums=donate_argnums,
+                compile_budget=compile_budget,
+            )
+        return _bind
+    nums = (
+        (static_argnums,) if isinstance(static_argnums, int) else
+        tuple(static_argnums)
+    )
+    names = (
+        (static_argnames,) if isinstance(static_argnames, str) else
+        tuple(static_argnames)
+    )
+    jitted = jax.jit(
+        fun, static_argnums=nums, static_argnames=names,
+        donate_argnums=donate_argnums,
+    )
+    if not enabled():
+        return jitted
+    return _WatchedJit(name, jitted, nums, names, compile_budget)
+
+
+def make_pallas_call(name: str, kernel: Callable, **kwargs: Any) -> Callable:
+    """Seam over ``pl.pallas_call``. The returned callable runs at trace
+    time of the enclosing jit, so each invocation IS a (re)trace of the
+    kernel class -- recorded as a pallas event; the enclosing ``make_jit``
+    class carries the budget."""
+    from jax.experimental import pallas as pl
+
+    inner = pl.pallas_call(kernel, **kwargs)
+    if not enabled():
+        return inner
+
+    def traced(*args: Any):
+        if enabled():
+            with _LOCK:
+                _EVENTS.append(
+                    CompileEvent(
+                        name,
+                        tuple(_abstract_leaf(a) for a in args),
+                        0.0,
+                        bool(_windows()),
+                        "pallas",
+                    )
+                )
+                _COUNTS[name] = _COUNTS.get(name, 0) + 1
+        return inner(*args)
+
+    return traced
+
+
+# --------------------------------------------------------------------- #
+# Declared timed windows + audited transfer seams
+# --------------------------------------------------------------------- #
+
+
+@contextlib.contextmanager
+def timed_window(name: str):
+    """Declare a measured steady-state region: compiles on this thread
+    become violations and ``jax.transfer_guard("disallow")`` is armed, so
+    implicit host transfers fail at the offending line. A transfer-guard
+    error propagating out is also recorded in ``violations()`` (in case an
+    outer handler then swallows it)."""
+    if not enabled():
+        yield
+        return
+    stack = _windows()
+    stack.append(name)
+    try:
+        with jax.transfer_guard("disallow"):
+            yield
+    except JitwatchViolation:
+        raise
+    except Exception as exc:
+        text = str(exc)
+        if "transfer" in text.lower():
+            with _LOCK:
+                _VIOLATIONS.append(
+                    f"jitwatch: transfer-guard violation in timed window "
+                    f"'{name}': {text.splitlines()[0]}"
+                )
+        raise
+    finally:
+        stack.pop()
+
+
+@contextlib.contextmanager
+def host_transfer(label: str):
+    """Audited transfer seam: re-allows transfers for a labeled block
+    inside a timed window (e.g. a one-time scalar-constant upload) and
+    counts it, so 'zero unaudited transfers' stays checkable."""
+    if not enabled():
+        yield
+        return
+    with _LOCK:
+        _SYNCS[label] = _SYNCS.get(label, 0) + 1
+    with jax.transfer_guard("allow"):
+        yield
+
+
+def fetch(label: str, tree: Any) -> Any:
+    """THE audited device->host sync: one explicit ``jax.device_get``,
+    counted per label. Device modules route every fetch through here so
+    devlint has a single annotated seam instead of ad-hoc call sites."""
+    if enabled():
+        with _LOCK:
+            _SYNCS[label] = _SYNCS.get(label, 0) + 1
+    return jax.device_get(tree)  # devlint: sync-point
+
+
+def drain(label: str, *trees: Any) -> None:
+    """Audited block-until-ready barrier (setup/teardown sync, not a data
+    fetch): separates construction cost from measured protocol time."""
+    if enabled():
+        with _LOCK:
+            _SYNCS[label] = _SYNCS.get(label, 0) + 1
+    jax.block_until_ready(trees)  # devlint: sync-point
+
+
+# --------------------------------------------------------------------- #
+# Introspection
+# --------------------------------------------------------------------- #
+
+
+def compile_events() -> List[CompileEvent]:
+    with _LOCK:
+        return list(_EVENTS)
+
+
+def compile_count(name: Optional[str] = None) -> int:
+    with _LOCK:
+        if name is not None:
+            return _COUNTS.get(name, 0)
+        return sum(_COUNTS.values())
+
+
+def compile_wall_s(name: Optional[str] = None) -> float:
+    with _LOCK:
+        return sum(
+            e.wall_s for e in _EVENTS if name is None or e.name == name
+        )
+
+
+def signatures(name: str) -> List[Tuple[Any, ...]]:
+    """Distinct abstract signatures recorded for a class, in first-compile
+    order -- the 'why did this recompile' forensic view."""
+    with _LOCK:
+        out, seen = [], set()
+        for e in _EVENTS:
+            if e.name == name and e.signature not in seen:
+                seen.add(e.signature)
+                out.append(e.signature)
+        return out
+
+
+def sync_counts() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_SYNCS)
+
+
+def stats() -> Dict[str, Any]:
+    """Aggregate snapshot for bench records: total compiles and compile
+    wall time so far (diff two snapshots to scope a phase)."""
+    with _LOCK:
+        return {
+            "compiles": sum(_COUNTS.values()),
+            "compile_wall_s": sum(e.wall_s for e in _EVENTS),
+        }
+
+
+def violations() -> List[str]:
+    with _LOCK:
+        return list(_VIOLATIONS)
+
+
+def consume_violations() -> List[str]:
+    global _VIOLATIONS
+    with _LOCK:
+        out = _VIOLATIONS
+        _VIOLATIONS = []
+        return out
+
+
+def reset() -> None:
+    """Clear the recorded log (events, counts, syncs, violations). Wrapper
+    cache baselines persist -- jax's own caches do too."""
+    global _EVENTS, _COUNTS, _SYNCS, _VIOLATIONS
+    with _LOCK:
+        _EVENTS = []
+        _COUNTS = {}
+        _SYNCS = {}
+        _VIOLATIONS = []

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..runtime.jitwatch import make_jit
 from ..sim.engine import (
     RoundInputs,
     SimConfig,
@@ -326,7 +327,6 @@ def make_sharded_run(
         check_vma=False,
     )
 
-    @jax.jit
     def run(state: SimState, inputs: RoundInputs) -> SimState:
         def scan_body(carry, _):
             return body(carry, inputs), ()
@@ -334,12 +334,14 @@ def make_sharded_run(
         final, _ = jax.lax.scan(scan_body, state, None, length=rounds)
         return final
 
-    return run
+    # a fresh jit per factory call by design: the caller (driver) caches the
+    # returned runner per (rounds, random_loss)  # devlint: jit-cached
+    return make_jit("shard.engine.sharded_run", run)
 
 
 def make_sharded_run_until(
     config: SimConfig, mesh: Mesh, random_loss: bool = True,
-    stop_when_announced: bool = False,
+    stop_when_announced: bool = False, donate: bool = False,
 ):
     """One-dispatch mesh decision loop: a while_loop of shard_map'd rounds.
 
@@ -383,4 +385,12 @@ def make_sharded_run_until(
         out_specs=state_specs,
         check_vma=False,
     )
-    return jax.jit(sharded)
+    # fresh jit per factory call by design; the driver caches the runner per
+    # (random_loss, stop_when_announced)  # devlint: jit-cached
+    return make_jit(
+        "shard.engine.sharded_run_until", sharded,
+        # ``donate=True`` is the driver's carried-state loop: the input
+        # state dies with the dispatch, so its shards are donated in place.
+        # Differential callers that reuse the input keep the default.
+        donate_argnums=(0,) if donate else (),
+    )

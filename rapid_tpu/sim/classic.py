@@ -63,6 +63,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..runtime import jitwatch
+from ..runtime.jitwatch import make_jit
 from .engine import FAST_RANK, RANK_BITS, SimConfig, SimState
 
 
@@ -121,7 +123,7 @@ def _inbox_cutoff(
     return responders & (resp_time <= cutoff), cutoff
 
 
-@functools.partial(jax.jit, static_argnums=0)
+@functools.partial(make_jit, "sim.classic.phase1", static_argnums=0)
 def phase1(
     config: SimConfig,
     state: SimState,
@@ -172,7 +174,7 @@ def phase1(
     return dataclasses.replace(state, classic_rnd=classic_rnd), summary
 
 
-@functools.partial(jax.jit, static_argnums=0)
+@functools.partial(make_jit, "sim.classic.phase2", static_argnums=0)
 def phase2(
     config: SimConfig,
     state: SimState,
@@ -239,11 +241,13 @@ class ClassicCoordinator:
 
     def phase1(self) -> bool:
         """Run phase1a/1b; True iff a majority of the membership promised."""
-        self.sim.state, summary = phase1(
+        # the classic exchange is the cold recovery path and its input state
+        # is shared with concurrent coordinators, so it stays undonated
+        self.sim.state, summary = phase1(  # devlint: no-donate
             self.sim.config, self.sim.state, jnp.int32(self.rank),
             self._hears_coord, self._coord_hears, self._resp_time,
         )
-        self._summary = jax.device_get(summary)
+        self._summary = jitwatch.fetch("sim.classic.phase1b", summary)
         self.elapsed_rounds += int(self._summary.cutoff)
         n = int(self.sim.active.sum())
         return int(self._summary.promised) > n // 2
@@ -275,12 +279,14 @@ class ClassicCoordinator:
         """Run phase2a/2b for ``row``; returns the row iff a majority
         accepted (the decision), else None (outranked by a concurrent
         coordinator)."""
-        self.sim.state, accepted, cutoff = phase2(
+        self.sim.state, accepted, cutoff = phase2(  # devlint: no-donate
             self.sim.config, self.sim.state, jnp.int32(self.rank),
             jnp.int32(row), self._hears_coord, self._coord_hears,
             self._resp_time,
         )
-        accepted, cutoff = jax.device_get((accepted, cutoff))
+        accepted, cutoff = jitwatch.fetch(
+            "sim.classic.phase2b", (accepted, cutoff)
+        )
         self.elapsed_rounds += int(cutoff)
         n = int(self.sim.active.sum())
         return row if int(accepted) > n // 2 else None

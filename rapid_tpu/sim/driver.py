@@ -39,14 +39,15 @@ from ..observability import (
     global_metrics,
     global_tracer,
 )
+from ..runtime import jitwatch
 from .engine import (
     RoundInputs,
     SimConfig,
     SimState,
     device_initial_state,
     pack_decision,
-    run_rounds_const,
-    run_until_decided_const,
+    run_rounds_const_donated,
+    run_until_decided_const_donated,
     unpack_decision,
 )
 from .topology import (
@@ -62,6 +63,20 @@ from .topology import (
 # billed instead (sim/classic.py: each phase closes when the majority's
 # responses have arrived)
 _CLASSIC_ROUND_HOPS = 4
+
+
+def _pow2_chunks(n: int, batch: int) -> List[int]:
+    """Split ``n`` rounds into scan lengths drawn from {batch} and powers of
+    two. The scan length is a static argument of run_rounds_const (a distinct
+    executable per value), so an arbitrary tail (max_rounds % batch) would
+    mint unbounded compile classes; power-of-two tails cap them at
+    log2(batch) + 1 while executing exactly ``n`` rounds."""
+    chunks: List[int] = []
+    while n > 0:
+        step = batch if n >= batch else 1 << (n.bit_length() - 1)
+        chunks.append(step)
+        n -= step
+    return chunks
 
 
 @dataclass
@@ -272,6 +287,7 @@ class Simulator:  # guarded-by: sim-loop
         self._ones_deliver = self._rep(np.ones((g, c), bool))
         self._zero_delay = self._rep(np.zeros((g, c), np.int32))
         self._deliver_delay = np.zeros((g, c), dtype=np.int32)
+        self._i32_cache: dict = {}  # py int -> device int32 scalar
         self._deliver_delay_dev: Optional[jax.Array] = None
         self._alive_dev: Optional[jax.Array] = None
         self._probe_drop_dev: Optional[jax.Array] = None
@@ -279,6 +295,17 @@ class Simulator:  # guarded-by: sim-loop
         self._observers_host: Optional[np.ndarray] = None
         self._ring_nodes: Optional[List[np.ndarray]] = None
         self._ids_sorted: Optional[np.ndarray] = None
+
+    def _i32(self, n: int) -> jax.Array:
+        """Cached device int32 scalar for dispatch budgets: a run uses a
+        handful of distinct batch sizes, so each is uploaded once instead of
+        minting a fresh host->device transfer on every dispatch."""
+        dev = self._i32_cache.get(n)
+        if dev is None:
+            with jitwatch.host_transfer("sim.batch_budget"):
+                dev = jnp.int32(n)
+            self._i32_cache[n] = dev
+        return dev
 
     def _fresh_state(self, seed: int) -> SimState:
         """Fresh-configuration state, built on device (engine.device_initial_state)."""
@@ -811,12 +838,21 @@ class Simulator:  # guarded-by: sim-loop
             return False  # dedup by sender (FastPaxos.java:134-141)
         from .engine import FAST_RANK
 
-        if int(np.asarray(self.state.classic_rnd[slot])) >= FAST_RANK:
-            # the slot already joined a classic round: its fast vote must not
-            # count toward a fast quorum (registerFastRoundVote refuses once
-            # rnd.round > 1, Paxos.java:246-248) -- same gate the engine
-            # applies to auto-voting slots
-            return False
+        if self._classic_attempts > 0:
+            # only the classic fallback raises per-node round ranks past the
+            # fast rank, so until one has run this configuration the device
+            # rank is the fresh-state zero and the gate below cannot fire --
+            # no host sync on the common (fast-path-only) registration
+            rank = int(np.asarray(
+                jitwatch.fetch("sim.extern_vote_rank",
+                               self.state.classic_rnd[slot])
+            ))
+            if rank >= FAST_RANK:
+                # the slot already joined a classic round: its fast vote must
+                # not count toward a fast quorum (registerFastRoundVote
+                # refuses once rnd.round > 1, Paxos.java:246-248) -- same
+                # gate the engine applies to auto-voting slots
+                return False
         mask = np.zeros(self.config.capacity, dtype=bool)
         mask[np.atleast_1d(cut)] = True
         key = mask.tobytes()
@@ -854,6 +890,7 @@ class Simulator:  # guarded-by: sim-loop
         if self._ingress_partitioned:
             mask[list(self._ingress_partitioned)] = True
         if self._subjects_host is None:
+            # cached once per adjacency rebuild  # devlint: sync-point
             self._subjects_host = np.asarray(self.state.subjects)
         return mask[self._subjects_host]
 
@@ -870,6 +907,7 @@ class Simulator:  # guarded-by: sim-loop
             mask = self._injected_down.copy()
             if self._pending_leavers:
                 if self._observers_host is None:
+                    # cached once per adjacency rebuild  # devlint: sync-point
                     self._observers_host = np.asarray(self.state.observers)
                 leavers = sorted(self._pending_leavers)
                 obs = self._observers_host[leavers]  # [L, K]
@@ -952,6 +990,7 @@ class Simulator:  # guarded-by: sim-loop
         self._join_reports_armed = True
         k = self.config.k
         join_reports = np.zeros((self.config.capacity, k), dtype=bool)
+        # once per join wave, not per dispatch  # devlint: sync-point
         observers = np.asarray(self.state.observers).copy()
         for node in sorted(self._pending_joiners):
             obs_ids, obs_alive = self._expected_observers(node)
@@ -1032,23 +1071,31 @@ class Simulator:  # guarded-by: sim-loop
                     # the while_loop runner exits at the decision round (and,
                     # for the bridge's phase A, at the announcement round) and
                     # takes the budget as a dynamic operand (no re-jit when the
-                    # batch size changes)
+                    # batch size changes). The carried state is donated: the
+                    # pre-dispatch shards die with the call.
                     self.state = self._sharded_run_until(
                         random_loss, stop_when_announced
-                    )(self.state, inputs, jnp.int32(n))
+                    )(self.state, inputs, self._i32(n))
                 elif random_loss:
                     # the per-round RNG-consuming scan path: random ingress
                     # loss is the one fault with no closed form (both FD
-                    # policies have one under a deterministic constant plane)
-                    self.state = run_rounds_const(
-                        self.config, self.state, inputs, n, random_loss
-                    )
+                    # policies have one under a deterministic constant plane).
+                    # The scan length is static, so an arbitrary tail length
+                    # (max_rounds % batch) would mint a fresh executable per
+                    # distinct value; power-of-two tail chunks bound the
+                    # compile classes at log2(batch) while executing exactly
+                    # the same number of rounds.
+                    for chunk in _pow2_chunks(n, batch):
+                        # chunk values bounded by _pow2_chunks  # devlint: static-shape
+                        self.state = run_rounds_const_donated(
+                            self.config, self.state, inputs, chunk, random_loss
+                        )
                 else:
                     # deterministic constant plane: one early-exiting
                     # dispatch (pauses at announcements under
                     # stop_when_announced)
-                    self.state = run_until_decided_const(
-                        self.config, self.state, inputs, jnp.int32(n),
+                    self.state = run_until_decided_const_donated(
+                        self.config, self.state, inputs, self._i32(n),
                         bool(self._deliver.all()), stop_when_announced,
                     )
                 # ONE host<->device round trip syncs the batch and fetches
@@ -1063,7 +1110,7 @@ class Simulator:  # guarded-by: sim-loop
                 # matches the decision).
                 packed = pack_decision(self.config, self.state)
                 spec_worker = self._speculate_view_change()
-                words = jax.device_get(packed)
+                words = jitwatch.fetch("sim.decision_words", packed)
                 if spec_worker is not None:
                     spec_worker.join()
                 (decided, announced_np, announced_round_np, proposal_np,
@@ -1236,7 +1283,8 @@ class Simulator:  # guarded-by: sim-loop
             from ..shard.engine import make_sharded_run_until
 
             self._sharded_runs[key] = make_sharded_run_until(
-                self.config, self.mesh, random_loss, stop_when_announced
+                self.config, self.mesh, random_loss, stop_when_announced,
+                donate=True,
             )
         return self._sharded_runs[key]
 
@@ -1531,10 +1579,11 @@ class Simulator:  # guarded-by: sim-loop
     def ready(self) -> "Simulator":
         """Block until construction/rebuild work has drained from the device
         queue -- separates setup cost from measured protocol time."""
-        jax.block_until_ready(jax.tree_util.tree_leaves(self.state))
-        jax.block_until_ready(
+        jitwatch.drain(
+            "sim.ready",
+            jax.tree_util.tree_leaves(self.state),
             (self._zero_ck, self._zero_ck_row, self._zero_drop_prob,
-             self._ones_deliver)
+             self._ones_deliver),
         )
         return self
 

@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..runtime.jitwatch import make_jit
 from .topology import VirtualCluster, build_adjacency
 
 
@@ -600,7 +601,7 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
     )
 
 
-@functools.partial(jax.jit, static_argnums=0)
+@functools.partial(make_jit, "sim.engine.run_rounds", static_argnums=0)
 def run_rounds(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimState:
     """Scan ``step`` over stacked per-round inputs (leading axis = rounds)."""
 
@@ -611,8 +612,7 @@ def run_rounds(config: SimConfig, state: SimState, inputs: RoundInputs) -> SimSt
     return final
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4))
-def run_rounds_const(
+def _run_rounds_const(
     config: SimConfig, state: SimState, inputs: RoundInputs, rounds: int,
     random_loss: bool = True,
 ) -> SimState:
@@ -627,8 +627,25 @@ def run_rounds_const(
     return final
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5))
-def run_until_decided_const(
+# ``rounds`` is the scan length, so it must stay static; the driver bounds
+# the distinct values it dispatches (power-of-two tail chunks) to keep this
+# class's compile count flat.  # devlint: static-shape
+run_rounds_const = make_jit(
+    "sim.engine.run_rounds_const", _run_rounds_const,
+    static_argnums=(0, 3, 4),
+)
+# The driver's carried-state variant: the previous round batch's state is
+# dead the moment the call returns, so its buffers are donated to the
+# output (no [C, K]-scale copy per dispatch). Tests and differential
+# callers that reuse the input state must use the plain variant above.
+# Same bounded scan-length discipline as above.  # devlint: static-shape
+run_rounds_const_donated = make_jit(
+    "sim.engine.run_rounds_const.donated", _run_rounds_const,
+    static_argnums=(0, 3, 4), donate_argnums=(1,),
+)
+
+
+def _run_until_decided_const(
     config: SimConfig,
     state: SimState,
     inputs: RoundInputs,
@@ -806,7 +823,21 @@ def run_until_decided_const(
     return dataclasses.replace(final, fd_fail=fd_fail, alerted=alerted)
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
+run_until_decided_const = make_jit(
+    "sim.engine.run_until_decided_const", _run_until_decided_const,
+    static_argnums=(0, 4, 5),
+)
+# Carried-state variant for the driver's decision loop (see
+# run_rounds_const_donated): the input state is donated, so callers must
+# not reuse it after the dispatch.
+run_until_decided_const_donated = make_jit(
+    "sim.engine.run_until_decided_const.donated", _run_until_decided_const,
+    static_argnums=(0, 4, 5), donate_argnums=(1,),
+)
+
+
+@functools.partial(make_jit, "sim.engine.device_initial_state",
+                   static_argnums=(0,))
 def device_initial_state(
     config: SimConfig,
     ring_rank: jax.Array,  # int32[K, C] rank of each node in the full ring order
@@ -900,7 +931,7 @@ def _words_per(n: int) -> int:
     return (n + 31) // 32
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
+@functools.partial(make_jit, "sim.engine.pack_decision", static_argnums=(0,))
 def pack_decision(config: SimConfig, state: SimState) -> jax.Array:
     """Bit-pack the decision-relevant slice of ``state`` into one uint32
     array (see layout note above). Dispatch is async; the caller fetches the

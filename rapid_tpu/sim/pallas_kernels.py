@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..runtime.jitwatch import make_jit, make_pallas_call
+
 
 def _fd_phase_kernel(
     edge_live_ref,  # bool[B, K] edge exists (active obs & active subj)
@@ -75,7 +77,10 @@ def _fd_phase_kernel(
     new_down_out_ref[:] = new_down
 
 
-@functools.partial(jax.jit, static_argnames=("threshold", "block_rows", "interpret"))
+# ``block_rows`` is a compile-time tile-size knob (a handful of values per
+# process), not a per-call-varying shape.  # devlint: static-shape
+@functools.partial(make_jit, "sim.pallas.fd_phase",
+                   static_argnames=("threshold", "block_rows", "interpret"))
 def fd_phase(
     edge_live: jax.Array,
     observer_up: jax.Array,
@@ -105,7 +110,8 @@ def fd_phase(
     def row_spec():
         return pl.BlockSpec((block_rows, k), lambda i: (i, 0), memory_space=pltpu.VMEM)
 
-    out = pl.pallas_call(
+    out = make_pallas_call(
+        "sim.pallas.fd_phase_kernel",
         _fd_phase_kernel,
         grid=grid,
         in_specs=[

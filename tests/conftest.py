@@ -19,6 +19,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Opt out for A/B timing with RAPID_LOCKDEP=0.
 os.environ.setdefault("RAPID_LOCKDEP", "1")
 
+# Runtime jitwatch is on for the whole tier-1 suite: every device-plane jit
+# entry is created through the make_jit seam, so every test doubles as a
+# recompile/compile-budget probe (and timed windows arm jax.transfer_guard).
+# Same ordering constraint as lockdep: the seam samples the env at module
+# import. Opt out for A/B timing with RAPID_JITWATCH=0.
+os.environ.setdefault("RAPID_JITWATCH", "1")
+
 import pytest  # noqa: E402
 
 
@@ -32,6 +39,20 @@ def _lockdep_gate():
     assert lockdep.violations() == [], (
         "lockdep recorded lock-order violations during the run:\n"
         + "\n".join(lockdep.violations())
+    )
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _jitwatch_gate():
+    """Fail the session if any jitwatch violation (steady-state recompile,
+    compile-budget breach, transfer-guard trip) was recorded, even one
+    swallowed by a blanket exception handler."""
+    yield
+    from rapid_tpu.runtime import jitwatch
+
+    assert jitwatch.violations() == [], (
+        "jitwatch recorded violations during the run:\n"
+        + "\n".join(jitwatch.violations())
     )
 
 

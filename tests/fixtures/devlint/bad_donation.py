@@ -1,0 +1,17 @@
+"""donation-hygiene: the carried state is threaded through a jitted update
+with no donate_argnums -- the pre-call buffers stay live until the call
+returns, doubling peak memory at state scale on every dispatch."""
+from rapid_tpu.runtime.jitwatch import make_jit
+
+
+def _advance(state, inputs):
+    return state + inputs
+
+
+advance = make_jit("fixture.advance", _advance)
+
+
+def drive(state, inputs):
+    for _ in range(8):
+        state = advance(state, inputs)
+    return state

@@ -1,0 +1,17 @@
+"""dtype-discipline: constructions without an explicit dtype depend on the
+x64 flag and weak-type promotion (a silent compile-cache split); float
+arithmetic and true division on the pinned narrow state fields silently
+widen them."""
+import jax.numpy as jnp
+
+
+def build(n):
+    hist = jnp.zeros((n, 8))
+    ticks = jnp.arange(n)
+    return hist, ticks
+
+
+def decay(state):
+    fd_fail = state.fd_fail * 0.5
+    rate = state.fd_hist / state.rounds
+    return fd_fail, rate

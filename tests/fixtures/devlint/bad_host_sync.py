@@ -1,0 +1,23 @@
+"""host-sync: int()/.item()/np.asarray on device-resident state and a raw
+jax.device_get are blocking, un-audited device->host round trips; the
+jitted body branches in python on a traced parameter."""
+import jax
+import numpy as np
+
+from rapid_tpu.runtime.jitwatch import make_jit
+
+
+def decide(state):
+    if int(state.round_no) > 3:
+        return np.asarray(state.votes)
+    total = state.total.item()
+    return jax.device_get(state.votes), total
+
+
+def _step(x, flag):
+    if flag:
+        return x + 1
+    return x
+
+
+step = make_jit("fixture.step", _step)

@@ -1,0 +1,33 @@
+"""recompile-hazard: raw jax.jit bypasses the make_jit seam (its compiles
+are invisible to jitwatch); a jit wrapper minted inside a function body is a
+fresh executable per call; a shape-like static parameter mints one
+executable per distinct value; the loop feeds its index into a static
+slot."""
+import jax
+import jax.numpy as jnp
+
+from rapid_tpu.runtime.jitwatch import make_jit
+
+
+@jax.jit
+def raw_step(x):
+    return x + jnp.int32(1)
+
+
+def per_call_wrapper(x):
+    step = make_jit("fixture.step", lambda v: v * 2)
+    return step(x)
+
+
+def _scan(x, rounds):
+    return x * rounds
+
+
+scan = make_jit("fixture.scan", _scan, static_argnums=(1,))
+
+
+def drive(x):
+    out = []
+    for i in range(8):
+        out.append(scan(x, i))
+    return out

@@ -1,0 +1,24 @@
+"""donation-hygiene corrected: the driver-owned carried state is donated
+(dead the moment the call returns); the differential path keeps the plain
+entry and declares why the input must stay alive."""
+from rapid_tpu.runtime.jitwatch import make_jit
+
+
+def _advance(state, inputs):
+    return state + inputs
+
+
+advance = make_jit("fixture.advance", _advance, donate_argnums=(0,))
+advance_shared = make_jit("fixture.advance.shared", _advance)
+
+
+def drive(state, inputs):
+    for _ in range(8):
+        state = advance(state, inputs)
+    return state
+
+
+def replay(state, inputs):
+    # differential readers still hold the input  # devlint: no-donate
+    state = advance_shared(state, inputs)
+    return state

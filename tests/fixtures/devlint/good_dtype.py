@@ -1,0 +1,15 @@
+"""dtype-discipline corrected: every construction pins its dtype and the
+narrow fields widen only through an explicit, audited .astype()."""
+import jax.numpy as jnp
+
+
+def build(n):
+    hist = jnp.zeros((n, 8), dtype=jnp.uint8)
+    ticks = jnp.arange(n, dtype=jnp.int32)
+    return hist, ticks
+
+
+def decay(state):
+    fd_fail = state.fd_fail.astype(jnp.float32) * 0.5
+    rate = state.fd_hist.astype(jnp.float32) / state.rounds
+    return fd_fail, rate

@@ -1,0 +1,29 @@
+"""recompile-hazard corrected: every jit goes through the make_jit seam,
+the per-key wrapper is cached (and tagged), the bounded static is tagged,
+and the loop dispatches a fixed static value."""
+import jax.numpy as jnp
+
+from rapid_tpu.runtime.jitwatch import make_jit
+
+_CACHE = {}
+
+
+def cached_wrapper(key):
+    if key not in _CACHE:
+        _CACHE[key] = make_jit("fixture.step", lambda v: v * 2)  # devlint: jit-cached
+    return _CACHE[key]
+
+
+def _scan(x, rounds):
+    return x * rounds
+
+
+# rounds is drawn from a bounded set  # devlint: static-shape
+scan = make_jit("fixture.scan", _scan, static_argnums=(1,))
+
+
+def drive(x):
+    out = []
+    for _ in range(8):
+        out.append(scan(x, 8))
+    return out

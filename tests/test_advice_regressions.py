@@ -16,6 +16,11 @@ One test per finding, each constructed to fail on the pre-fix code:
 Plus one test per concurrency finding surfaced by tools/concur.py (the
 lock-graph static analyzer) and fixed in the same PR that introduced it --
 see the "concur.py findings" section at the bottom.
+
+Plus one test per device-plane finding surfaced by tools/devlint.py and the
+runtime jitwatch (unbounded scan-length compile classes, per-dispatch scalar
+uploads, a host sync on the extern-vote fast path, a per-call jit rebuild in
+the placement builder) -- see the "devlint/jitwatch findings" section.
 """
 
 import random
@@ -435,3 +440,104 @@ def test_cluster_shutdown_runs_teardown_exactly_once_under_races():
     for t in threads:
         t.join(timeout=20)
     assert calls == {"server": 1, "service": 1, "resources": 1}
+
+
+# ---------------------------------------------------------------------------
+# devlint/jitwatch findings: each test fails on the pre-fix code
+# ---------------------------------------------------------------------------
+
+import numpy as np  # noqa: E402
+
+from rapid_tpu.runtime import jitwatch  # noqa: E402
+from rapid_tpu.sim.driver import Simulator, _pow2_chunks  # noqa: E402
+
+
+def test_pow2_chunks_bounds_the_compile_classes():
+    """devlint recompile-hazard: the random-loss path scanned an arbitrary
+    remainder length, giving every distinct max_rounds its own jit cache
+    entry. Chunk lengths must come from {batch} + powers of two below it, so
+    the cache holds at most log2(batch)+1 entries regardless of caller."""
+    assert _pow2_chunks(37, 8) == [8, 8, 8, 8, 4, 1]
+    assert _pow2_chunks(8, 8) == [8]
+    assert _pow2_chunks(1, 8) == [1]
+    assert _pow2_chunks(40, 16) == [16, 16, 8]
+    for n in range(1, 200):
+        chunks = _pow2_chunks(n, 16)
+        assert sum(chunks) == n
+        assert set(chunks) <= {16, 8, 4, 2, 1}  # the bounded class alphabet
+
+
+def test_driver_uploads_each_round_budget_once():
+    """devlint host-sync: run_until_decision materialized jnp.int32(n) per
+    dispatch -- a per-call host->device transfer inside the hot loop. The
+    scalar must be uploaded through the audited seam once per distinct
+    value, then served from the cache."""
+    sim = Simulator(16, seed=3).ready()
+    before = jitwatch.sync_counts().get("sim.batch_budget", 0)
+    a = sim._i32(16)
+    b = sim._i32(16)
+    c = sim._i32(16)
+    assert a is b is c  # cached device scalar, not re-uploaded
+    assert jitwatch.sync_counts().get("sim.batch_budget", 0) == before + 1
+    sim._i32(8)  # a new value is one more audited upload
+    assert jitwatch.sync_counts().get("sim.batch_budget", 0) == before + 2
+
+
+def test_extern_vote_fast_path_does_not_sync_host():
+    """devlint host-sync: register_extern_vote fetched the slot's classic
+    round rank on EVERY registration, but the rank can only exceed the fast
+    rank after a classic fallback has run. Until then the fetch is pure
+    overhead -- the fast path must do zero device->host syncs."""
+    from rapid_tpu.sim.engine import SimConfig
+
+    sim = Simulator(
+        16, config=SimConfig(capacity=16, extern_proposals=1), seed=3
+    ).ready()
+    assert sim._classic_attempts == 0
+    before = jitwatch.sync_counts().get("sim.extern_vote_rank", 0)
+    assert sim.register_extern_vote(5, np.array([2]))
+    assert jitwatch.sync_counts().get("sim.extern_vote_rank", 0) == before
+
+
+def test_placement_builder_jit_is_cached_per_shape():
+    """devlint recompile-hazard: build_jit created a fresh make_jit (fresh
+    jax cache) on every call, recompiling the whole map builder per
+    rebalance. The jitted object must be cached by (n_instances, replicas)
+    and reused."""
+    from rapid_tpu.placement import device as pdev
+
+    first = pdev._builder(4, 2)
+    assert pdev._builder(4, 2) is first  # same object, same jit cache
+    assert pdev._builder(4, 3) is not first  # distinct shape class
+
+    # dispatching the cached builder twice with same-shaped inputs compiles
+    # at most once more (the second call is a pure cache hit)
+    p32 = np.arange(8, dtype=np.uint32)
+    inst = np.arange(4 * 6, dtype=np.uint32).reshape(4, 6)
+    w = np.full(6, 4, dtype=np.uint32)
+    act = np.ones(6, dtype=bool)
+    first(p32, inst, w, act)
+    n_compiles = jitwatch.compile_count("placement.build_jit")
+    first(p32, inst, w, act)
+    assert jitwatch.compile_count("placement.build_jit") == n_compiles
+
+
+def test_warmed_decision_loop_is_steady_state_clean():
+    """The headline property the whole suite defends: a warmed simulator
+    reaches a decision inside a declared timed window -- zero recompiles,
+    zero unaudited host transfers -- with the decided cut intact."""
+    sim = Simulator(64, seed=5).ready()
+    sim.crash(np.array([3]))
+    record = sim.run_until_decision(max_rounds=40)  # warmup decision
+    assert record is not None
+
+    sim2 = Simulator(64, seed=5).ready()  # same shapes: fully warm
+    sim2.crash(np.array([3]))
+    before = jitwatch.stats()
+    with jitwatch.timed_window("test.steady_decision"):
+        record2 = sim2.run_until_decision(max_rounds=40)
+    after = jitwatch.stats()
+    assert record2 is not None
+    assert 3 in record2.cut
+    assert after["compiles"] == before["compiles"]
+    assert jitwatch.violations() == []

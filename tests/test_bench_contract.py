@@ -268,3 +268,116 @@ def test_lockdep_overhead_within_budget(monkeypatch):
         f"lockdep overhead: instrumented={instrumented * 1e3:.1f}ms "
         f"plain={plain * 1e3:.1f}ms"
     )
+
+
+def test_bench_headline_steady_state_compiles_zero():
+    """The real warmed_run (small n) must report zero steady-state
+    recompilations: all compilation belongs to the warmup phase, and the
+    timed window (armed inside warmed_run) would have raised on any compile
+    or implicit transfer in the measured region."""
+    wall_ms, record, build_s, warm_wall = bench.warmed_run(256, seed=9)
+    stats = dict(bench._LAST_JIT_STATS)
+    assert stats["jit_compiles_steady"] == 0, stats
+    assert stats["jit_compile_ms_steady"] == 0.0, stats
+    # warmup compiles are >= 0 (0 when an earlier in-process test already
+    # populated jax's caches for these shapes) and the wall-time field is
+    # consistent with the count
+    assert stats["jit_compiles_warmup"] >= 0
+    if stats["jit_compiles_warmup"] == 0:
+        assert stats["jit_compile_ms_warmup"] == 0.0
+
+
+def test_bench_sweep_entries_carry_jit_stats(monkeypatch):
+    """The per-sweep-point JSON entries include the compile telemetry
+    captured by the last warmed_run."""
+    def fake(n_nodes, seed, fail_fraction=bench.FAIL_FRACTION,
+             placement_partitions=0, handoff_partitions=0):
+        bench._LAST_JIT_STATS.clear()
+        bench._LAST_JIT_STATS.update({
+            "jit_compiles_warmup": 7, "jit_compile_ms_warmup": 123.0,
+            "jit_compiles_steady": 0, "jit_compile_ms_steady": 0.0,
+        })
+        return 50.0, _FakeRecord(), 1.0, 2.0
+
+    monkeypatch.setattr(bench, "warmed_run", fake)
+    sweep = bench.run_sweep("tpu", seed=42)
+    for entry in sweep:
+        assert entry["jit_compiles_warmup"] == 7
+        assert entry["jit_compiles_steady"] == 0
+
+
+def test_jitwatch_overhead_within_budget(monkeypatch):
+    """RAPID_JITWATCH=1 is on for the whole tier-1 battery (conftest), so the
+    make_jit wrapper must be cheap enough to leave the bench contract intact:
+    a warm watched dispatch stays within microseconds of the raw jitted call,
+    and the warmed decision loop with recording on stays within the same
+    envelope as with recording off.
+
+    enabled() picks raw-vs-wrapped at make_jit() time but is re-checked per
+    call, so toggling the env var around the *calls* is what flips a wrapper
+    between recording and pass-through (the A/B this test needs).
+    """
+    import sys
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rapid_tpu.observability import Metrics
+    from rapid_tpu.runtime import jitwatch
+    from rapid_tpu.sim.driver import Simulator
+
+    # tools/coverage.py's settrace collector pays a call event on every
+    # wrapper frame the raw jit call never makes; timing bounds are
+    # meaningless under it
+    traced = sys.gettrace() is not None
+
+    # -- micro: the wrapper itself ----------------------------------------
+    def per_op(fn, x, ops=2_000, runs=3):
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                fn(x)
+            best = min(best, time.perf_counter() - t0)
+        return best / ops
+
+    import jax
+
+    x = jnp.zeros((8,), jnp.int32)
+    raw = jax.jit(lambda v: v + 1)
+    watched = jitwatch.make_jit("bench.jw_micro", lambda v: v + 1)
+    assert isinstance(watched, jitwatch._WatchedJit)
+    raw(x), watched(x)  # warm both
+    raw_op = per_op(raw, x)
+    inst_op = per_op(watched, x)
+    # env read + two clock reads + a cache-size probe on top of dispatch
+    budget = 200e-6 if traced else 20e-6
+    assert inst_op - raw_op < budget, (
+        f"jitwatch wrapper: {inst_op * 1e6:.1f}us/op vs raw "
+        f"{raw_op * 1e6:.1f}us/op"
+    )
+
+    # -- macro: the warmed decision loop, recording off vs on --------------
+    def best_of(runs=5):
+        best = float("inf")
+        for _ in range(runs):
+            sim = Simulator(64, seed=5, metrics=Metrics())
+            sim.ready()
+            sim.crash(np.array([3]))
+            t0 = time.perf_counter()
+            record = sim.run_until_decision(max_rounds=40)
+            best = min(best, time.perf_counter() - t0)
+            assert record is not None
+        return best
+
+    best_of(runs=1)  # jit warmup, shapes shared by both sides
+    monkeypatch.setenv("RAPID_JITWATCH", "0")
+    plain = best_of()
+    monkeypatch.setenv("RAPID_JITWATCH", "1")
+    instrumented = best_of()
+    slack = 0.25 if traced else 0.05
+    assert instrumented <= plain * 1.10 + slack, (
+        f"jitwatch overhead: instrumented={instrumented * 1e3:.1f}ms "
+        f"plain={plain * 1e3:.1f}ms"
+    )

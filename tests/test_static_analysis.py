@@ -1,17 +1,23 @@
-"""The concurrency correctness suite is itself under test.
+"""The static-analysis + runtime-watchdog suites are themselves under test.
 
-Three layers, all tier-1:
+Five layers, all tier-1:
 
-1. repo gates: ``python tools/concur.py`` and ``python tools/check.py --all``
-   must exit 0 on today's tree (the analyzers are a merge gate, so the tree
-   must stay finding-free);
-2. rule fixtures: every rule fires on its ``tests/fixtures/concur/bad_*.py``
-   exemplar and stays silent on the matching ``good_*.py`` -- both
-   directions pinned, so a rule can neither silently die nor start
-   misfiring on the corrected idiom;
+1. repo gates: ``python tools/concur.py``, ``python tools/devlint.py`` and
+   ``python tools/check.py --all`` must exit 0 on today's tree (the
+   analyzers are merge gates, so the tree must stay finding-free);
+2. concurrency rule fixtures: every rule fires on its
+   ``tests/fixtures/concur/bad_*.py`` exemplar and stays silent on the
+   matching ``good_*.py`` -- both directions pinned, so a rule can neither
+   silently die nor start misfiring on the corrected idiom;
 3. runtime lockdep: the make_lock seam fails fast on order cycles and
    non-reentrant re-entry, records through blanket exception handlers, and
-   costs nothing when RAPID_LOCKDEP is off.
+   costs nothing when RAPID_LOCKDEP is off;
+4. device-plane rule fixtures: same both-directions contract for devlint's
+   ``tests/fixtures/devlint`` corpus (recompile-hazard, host-sync,
+   dtype-discipline, donation-hygiene);
+5. runtime jitwatch: the make_jit seam records every compilation, enforces
+   per-class budgets and steady-state timed windows (transfer guard armed),
+   and records through blanket handlers like lockdep.
 
 The fixtures are never imported (several would deadlock); the analyzers read
 them as text, and lintlib excludes ``fixtures`` dirs from every default scan.
@@ -26,16 +32,22 @@ import pytest
 
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = REPO / "tests" / "fixtures" / "concur"
+DEV_FIXTURES = REPO / "tests" / "fixtures" / "devlint"
 
 sys.path.insert(0, str(REPO / "tools"))
 
 import check  # noqa: E402
 import concur  # noqa: E402
+import devlint  # noqa: E402
 from lintlib import Finding, iter_py_files  # noqa: E402
 
 
 def _concur_rules(path: Path) -> set:
     return {f.rule for f in concur.run([str(path)])}
+
+
+def _devlint_rules(path: Path) -> set:
+    return {f.rule for f in devlint.run([str(path)])}
 
 
 def _hygiene_rules(path: Path) -> set:
@@ -72,7 +84,20 @@ def test_concur_clean_on_repo():
 def test_check_all_clean_on_repo():
     proc = _run_tool("tools/check.py", "--all")
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "check+concur: OK" in proc.stdout
+    assert "check+concur+devlint: OK" in proc.stdout
+
+
+def test_devlint_clean_on_repo():
+    proc = _run_tool("tools/devlint.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "devlint: OK" in proc.stdout
+
+
+def test_devlint_device_plane_paths_exist():
+    """The default scan list must track the tree -- a renamed device module
+    silently dropping out of the scan is itself a finding."""
+    for rel in devlint.DEVICE_PLANE:
+        assert (REPO / rel).exists(), f"devlint scans missing path {rel}"
 
 
 def test_check_rules_prints_full_catalog():
@@ -316,3 +341,233 @@ def test_lockdep_off_returns_plain_primitives(monkeypatch):
 def test_lockdep_condition_never_instrumented():
     cond = lockdep.make_condition("t_cond.C")
     assert isinstance(cond, threading.Condition)
+
+
+# ---------------------------------------------------------------------------
+# 4. devlint rule fixtures, both directions
+# ---------------------------------------------------------------------------
+
+DEVLINT_FIXTURES = [
+    ("bad_recompile.py", "recompile-hazard"),
+    ("bad_host_sync.py", "host-sync"),
+    ("bad_dtype.py", "dtype-discipline"),
+    ("bad_donation.py", "donation-hygiene"),
+]
+
+GOOD_DEVLINT = [
+    "good_recompile.py",
+    "good_host_sync.py",
+    "good_dtype.py",
+    "good_donation.py",
+]
+
+
+def test_devlint_fixture_corpus_is_complete():
+    on_disk = {f.name for f in DEV_FIXTURES.glob("*.py")}
+    pinned = {name for name, _ in DEVLINT_FIXTURES} | set(GOOD_DEVLINT)
+    assert pinned == on_disk
+
+
+@pytest.mark.parametrize("name,rule", DEVLINT_FIXTURES)
+def test_devlint_rule_fires_on_bad_fixture(name, rule):
+    # exactly its rule: the corpus is built so no exemplar cross-fires,
+    # which keeps each bad_* a clean regression pin for one rule
+    assert _devlint_rules(DEV_FIXTURES / name) == {rule}
+
+
+@pytest.mark.parametrize("name", GOOD_DEVLINT)
+def test_devlint_silent_on_good_fixture(name):
+    assert _devlint_rules(DEV_FIXTURES / name) == set()
+
+
+def test_devlint_tag_suppresses_finding(tmp_path):
+    """`# devlint: <tag>` on (or up to TAG_WINDOW lines before) the finding
+    line waives exactly the mapped rule -- the annotation system the real
+    device plane uses for its deliberate sync points."""
+    bad = (DEV_FIXTURES / "bad_donation.py").read_text()
+    assert "state = advance(state, inputs)" in bad
+    out = bad.replace(
+        "state = advance(state, inputs)",
+        "state = advance(state, inputs)  # devlint: no-donate",
+    )
+    target = tmp_path / "waived.py"
+    target.write_text(out)
+    assert _devlint_rules(target) == set()
+
+
+def test_devlint_tag_window_is_backward_looking(tmp_path):
+    """A tag placed AFTER the finding line must NOT suppress: annotations
+    belong on or above the code they waive."""
+    bad = (DEV_FIXTURES / "bad_donation.py").read_text()
+    out = bad.replace(
+        "state = advance(state, inputs)",
+        "state = advance(state, inputs)\n        # devlint: no-donate",
+    )
+    target = tmp_path / "late_tag.py"
+    target.write_text(out)
+    assert "donation-hygiene" in _devlint_rules(target)
+
+
+def test_devlint_honors_noqa(tmp_path):
+    """lintlib's `# noqa: RULE` escape hatch works for devlint rules too."""
+    bad = (DEV_FIXTURES / "bad_dtype.py").read_text()
+    out = []
+    for line in bad.splitlines(keepends=True):
+        if "jnp." in line or "fd_" in line:
+            line = line.rstrip("\n") + "  # noqa: dtype-discipline\n"
+        out.append(line)
+    target = tmp_path / "suppressed.py"
+    target.write_text("".join(out))
+    assert "dtype-discipline" not in _devlint_rules(target)
+
+
+def test_devlint_rules_are_documented():
+    """Every devlint rule the fixture corpus pins has a RULE_DOCS entry, so
+    `tools/check.py --rules` stays the catalog of record."""
+    emitted = {rule for _, rule in DEVLINT_FIXTURES}
+    assert emitted <= set(check.RULE_DOCS)
+
+
+# ---------------------------------------------------------------------------
+# 5. runtime jitwatch
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp  # noqa: E402
+
+from rapid_tpu.runtime import jitwatch  # noqa: E402
+
+
+def test_jitwatch_enabled_by_conftest():
+    # the whole tier-1 suite runs instrumented (conftest sets
+    # RAPID_JITWATCH=1 before any rapid_tpu import)
+    assert jitwatch.enabled()
+
+
+def test_jitwatch_records_compiles_and_signatures():
+    f = jitwatch.make_jit("t_jw.sigs", lambda x: x + 1)
+    before = jitwatch.compile_count("t_jw.sigs")
+    f(jnp.zeros((2,), jnp.int32))
+    f(jnp.zeros((2,), jnp.int32))  # warm: same signature, no new compile
+    f(jnp.zeros((3,), jnp.int32))  # fresh shape: one more compile
+    assert jitwatch.compile_count("t_jw.sigs") - before == 2
+    sigs = jitwatch.signatures("t_jw.sigs")
+    assert len(sigs) == 2 and sigs[0] != sigs[1]
+    # the signature classes calls by abstract leaf shape/dtype
+    assert "int32" in repr(sigs[0]) and "(2,)" in repr(sigs[0])
+
+
+def test_jitwatch_static_args_class_by_value():
+    f = jitwatch.make_jit("t_jw.static", lambda x, n: x * n,
+                          static_argnums=(1,))
+    f(jnp.zeros((2,), jnp.int32), 3)
+    f(jnp.zeros((2,), jnp.int32), 4)  # same shapes, new static: recompile
+    sigs = jitwatch.signatures("t_jw.static")
+    assert len(sigs) == 2
+    assert "('static', 3)" in repr(sigs[0])
+    assert "('static', 4)" in repr(sigs[1])
+
+
+def test_jitwatch_budget_breach_records_then_raises():
+    f = jitwatch.make_jit("t_jw.budget", lambda x: x - 1, compile_budget=1)
+    f(jnp.zeros((2,), jnp.int32))  # 1 <= budget
+    with pytest.raises(jitwatch.JitwatchViolation) as exc:
+        f(jnp.zeros((3,), jnp.int32))  # 2 > budget
+    assert "over its budget" in str(exc.value)
+    recorded = jitwatch.consume_violations()
+    assert any("t_jw.budget" in v for v in recorded)
+
+
+def test_jitwatch_steady_state_recompile_is_violation():
+    f = jitwatch.make_jit("t_jw.steady", lambda x: x * 2)
+    x4 = jnp.zeros((4,), jnp.float32)
+    x5 = jnp.zeros((5,), jnp.float32)
+    f(x4)  # warmup outside the window
+    with jitwatch.timed_window("t_jw.window"):
+        f(x4)  # warm signature inside the window: fine
+        with pytest.raises(jitwatch.JitwatchViolation) as exc:
+            f(x5)  # fresh shape inside the window: violation
+    assert "steady-state recompile" in str(exc.value)
+    assert "t_jw.window" in str(exc.value)
+    recorded = jitwatch.consume_violations()
+    assert any("t_jw.steady" in v for v in recorded)
+
+
+def test_jitwatch_timed_window_arms_transfer_guard():
+    """Implicit host->device transfers (python scalar materialization) fail
+    at the offending line inside a window, and the propagating guard error
+    is ALSO recorded so an outer blanket handler cannot hide it."""
+    with pytest.raises(Exception) as exc:
+        with jitwatch.timed_window("t_jw.guard"):
+            jnp.int32(5)
+    assert "transfer" in str(exc.value).lower()
+    recorded = jitwatch.consume_violations()
+    assert any("t_jw.guard" in v and "transfer-guard" in v for v in recorded)
+
+
+def test_jitwatch_seams_allowed_inside_window():
+    """The three audited seams work under an armed guard: fetch (explicit
+    device->host), host_transfer (labeled re-allow), and warm watched
+    dispatch -- and each seam use is counted."""
+    f = jitwatch.make_jit("t_jw.seams", lambda x: x + 3)
+    x = jnp.zeros((6,), jnp.int32)
+    f(x)  # warm
+    base_syncs = jitwatch.sync_counts()
+    with jitwatch.timed_window("t_jw.seamwin"):
+        out = f(x)
+        host = jitwatch.fetch("t_jw.fetch", out)
+        with jitwatch.host_transfer("t_jw.upload"):
+            dev = jnp.int32(9)
+    assert int(host[0]) == 3 and int(dev) == 9
+    syncs = jitwatch.sync_counts()
+    assert syncs.get("t_jw.fetch", 0) == base_syncs.get("t_jw.fetch", 0) + 1
+    assert syncs.get("t_jw.upload", 0) == base_syncs.get("t_jw.upload", 0) + 1
+    assert jitwatch.violations() == []
+
+
+def test_jitwatch_drain_counts_barrier():
+    x = jnp.ones((3,), jnp.float32)
+    before = jitwatch.sync_counts().get("t_jw.drain", 0)
+    jitwatch.drain("t_jw.drain", x)
+    assert jitwatch.sync_counts().get("t_jw.drain", 0) == before + 1
+
+
+def test_jitwatch_stats_snapshot_diffs():
+    s0 = jitwatch.stats()
+    f = jitwatch.make_jit("t_jw.stats", lambda x: x / 2)
+    f(jnp.ones((2,), jnp.float32))
+    s1 = jitwatch.stats()
+    assert s1["compiles"] == s0["compiles"] + 1
+    assert s1["compile_wall_s"] > s0["compile_wall_s"]
+
+
+def test_jitwatch_off_returns_plain_jit(monkeypatch):
+    monkeypatch.setenv("RAPID_JITWATCH", "0")
+    assert not jitwatch.enabled()
+    f = jitwatch.make_jit("t_jw.off", lambda x: x + 1)
+    assert not isinstance(f, jitwatch._WatchedJit)
+    assert int(f(jnp.int32(1))) == 2
+    # seams are pass-through: no counting, no guard
+    with jitwatch.timed_window("t_jw.offwin"):
+        jnp.int32(5)  # would trip an armed guard
+    jitwatch.fetch("t_jw.offfetch", jnp.int32(3))
+    assert "t_jw.offfetch" not in jitwatch.sync_counts()
+
+
+def test_jitwatch_wrapper_silenced_per_call(monkeypatch):
+    """A wrapper created enabled can be silenced per call for A/B overhead
+    runs -- no events recorded while the env var is 0."""
+    f = jitwatch.make_jit("t_jw.silence", lambda x: x * 5)
+    assert isinstance(f, jitwatch._WatchedJit)
+    monkeypatch.setenv("RAPID_JITWATCH", "0")
+    f(jnp.zeros((2,), jnp.int32))  # compiles, but unrecorded
+    assert jitwatch.compile_count("t_jw.silence") == 0
+
+
+def test_jitwatch_decorator_form():
+    @jitwatch.make_jit("t_jw.deco")
+    def bump(x):
+        return x + 10
+
+    assert isinstance(bump, jitwatch._WatchedJit)
+    assert int(bump(jnp.int32(1))) == 11
+    assert jitwatch.compile_count("t_jw.deco") == 1

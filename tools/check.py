@@ -14,10 +14,13 @@ build-common/). This repo's equivalents:
 
 Concurrency hygiene rules that belong with general code health live here too
 (thread-daemon, callback-under-lock); the deep concurrency analysis (lock
-graphs, write contexts, jit purity) is tools/concur.py. `--all` runs both
-with one merged exit code. Rule names and one-line rationales: RULE_DOCS
-below (printed by `--rules`), with the full convention write-up in
-ARCHITECTURE.md "Concurrency discipline & static analysis".
+graphs, write contexts, jit purity) is tools/concur.py, and the device-plane
+performance analysis (recompile hazards, host syncs, dtype discipline,
+donation hygiene) is tools/devlint.py. `--all` runs all three with one
+merged exit code. Rule names and one-line rationales: RULE_DOCS below
+(printed by `--rules`), with the full convention write-ups in
+ARCHITECTURE.md "Concurrency discipline & static analysis" and
+"Device-plane performance discipline".
 
 Suppress a single line with `# noqa` or `# noqa: RULE` (rule names are
 case-insensitive; shared with tools/concur.py via tools/lintlib.py).
@@ -75,6 +78,16 @@ RULE_DOCS = {
                           "leaks the lock on any exception; use 'with'",
     "jit-purity": "side effects in jit/pallas/shard_map functions run once "
                   "at trace time, then never again -- silent wrong results",
+    # tools/devlint.py -- device-plane performance
+    "recompile-hazard": "per-call-varying statics, raw jax.jit off the "
+                        "make_jit seam, or per-call jit creation recompile "
+                        "in steady state",
+    "host-sync": "int()/np.asarray/.item()/device_get on device state is a "
+                 "blocking round trip; route through jitwatch.fetch/drain",
+    "dtype-discipline": "dtype-less jnp constructions and silent widening "
+                        "of narrow state fields split the compile cache",
+    "donation-hygiene": "carried state through a jit without donate_argnums "
+                        "doubles peak memory every dispatch",
 }
 
 # modules where `print` is the intended UI (CLIs, benchmarks, experiments)
@@ -627,12 +640,14 @@ def main(argv: list[str]) -> int:
     if run_all:
         if __package__ in (None, ""):
             import concur
+            import devlint
         else:  # pragma: no cover - imported as a package module
-            from . import concur
+            from . import concur, devlint
         findings.extend(concur.run())  # concur's own default: rapid_tpu
+        findings.extend(devlint.run())  # devlint's own default: device plane
     for finding in findings:
         print(finding)
-    label = "check+concur" if run_all else "check"
+    label = "check+concur+devlint" if run_all else "check"
     print(f"{label}: {'OK' if not findings else f'{len(findings)} findings'}")
     return 1 if findings else 0
 

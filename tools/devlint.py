@@ -1,0 +1,646 @@
+"""Device-plane performance analyzer (stdlib-only, AST-based).
+
+The static half of the device-plane performance suite (the runtime half is
+rapid_tpu/runtime/jitwatch.py). It scans the modules that own jit dispatch
+and device-resident state -- the sim engine/driver/classic/pallas/bridge,
+the placement and handoff device kernels, and the sharded engine -- and
+reports the patterns that silently destroy steady-state throughput:
+
+- ``recompile-hazard``: raw ``jax.jit`` that bypasses the ``make_jit`` seam
+  (compiles invisible to jitwatch); jit wrappers created inside a function
+  body (a fresh executable per call unless the caller caches); static
+  parameters with shape/count-like names (``n``, ``rounds``, ``batch``...)
+  whose per-call-varying values mint one executable per distinct value;
+  unhashable (list/dict/set) values reaching a static slot; and loop bodies
+  that feed the loop variable into a static slot of a jitted callee.
+- ``host-sync``: undeclared host<->device round trips -- ``.item()`` /
+  ``.tolist()``, ``int()``/``float()``/``bool()`` or ``np.asarray`` on
+  device-resident state, raw ``jax.device_get`` / ``block_until_ready``
+  outside the jitwatch ``fetch``/``drain`` helpers, and python control flow
+  (``int()``/``if``) on traced parameters inside jitted bodies.
+- ``dtype-discipline``: ``jnp`` array constructions with no explicit dtype
+  (x64-flag-dependent, weak-type cache splits); arithmetic that silently
+  widens the pinned narrow state fields (``fd_fail``/``fd_hist``/``fd_seen``:
+  float constants, true division).
+- ``donation-hygiene``: ``X = f(..., X, ...)`` state-update calls where
+  ``f`` is a jitted entry with no ``donate_argnums`` -- the carried state
+  doubles its peak memory every dispatch.
+
+Conventions the analyzer understands (see ARCHITECTURE.md "Device-plane
+performance discipline"); a tag on line L covers findings on L..L+3:
+
+- ``# devlint: sync-point`` -- this host sync is deliberate and accounted
+  (cold path, cached, or billed to setup); suppresses ``host-sync``.
+- ``# devlint: no-donate`` -- the input state is deliberately kept alive
+  (shared with other readers); suppresses ``donation-hygiene``.
+- ``# devlint: jit-cached`` -- the jit wrapper created here is cached by
+  the caller (one per key, not per call); suppresses ``recompile-hazard``.
+- ``# devlint: static-shape`` -- the static value is drawn from a bounded
+  set (compile classes are flat); suppresses ``recompile-hazard``.
+
+Suppress single findings with ``# noqa: RULE`` (shared with tools/check.py).
+
+Usage: python tools/devlint.py [paths...]   (default: the device plane)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+if __package__ in (None, ""):
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from lintlib import Finding, iter_py_files, noqa_lines, parse, suppressed
+else:  # pragma: no cover - imported as a package module
+    from .lintlib import Finding, iter_py_files, noqa_lines, parse, suppressed
+
+DEVICE_PLANE = [
+    "rapid_tpu/sim/engine.py",
+    "rapid_tpu/sim/driver.py",
+    "rapid_tpu/sim/classic.py",
+    "rapid_tpu/sim/pallas_kernels.py",
+    "rapid_tpu/sim/bridge.py",
+    "rapid_tpu/placement/device.py",
+    "rapid_tpu/handoff/device.py",
+    "rapid_tpu/shard/engine.py",
+]
+
+# ``# devlint: <tag>`` -> the rule it suppresses
+TAG_RULES = {
+    "sync-point": "host-sync",
+    "no-donate": "donation-hygiene",
+    "jit-cached": "recompile-hazard",
+    "static-shape": "recompile-hazard",
+}
+TAG_WINDOW = 3  # a tag on line L covers findings on L..L+TAG_WINDOW
+
+# static parameter name tokens that smell like per-call-varying shapes/counts
+SHAPEY_TOKENS = {"n", "rounds", "rows", "batch", "size", "length", "steps",
+                 "count"}
+
+# the pinned narrow state fields (engine state catalog: uint8/int32)
+NARROW_FIELDS = {"fd_fail", "fd_hist", "fd_seen"}
+
+# jnp constructors -> positional index of their dtype slot
+DTYPE_SLOT = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "arange": 3,
+              "array": 1}
+
+HOST_CASTS = {"int", "float", "bool"}
+
+
+def _name_of(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _base_name(expr: ast.expr) -> Optional[str]:
+    """Root name of an attribute chain ('jnp.zeros' -> 'jnp')."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _unparse(expr: ast.expr) -> str:
+    try:
+        return ast.unparse(expr)
+    except Exception:  # noqa: BLE001 - best-effort label only
+        return "<expr>"
+
+
+def devlint_tags(source: str) -> Dict[int, Set[str]]:
+    """line -> declared ``# devlint:`` tags on that line."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        if "# devlint:" not in line:
+            continue
+        _, _, tail = line.partition("# devlint:")
+        tags = {t.strip().lower() for t in tail.split("#")[0].split(",")}
+        tags = {t for t in tags if t in TAG_RULES}
+        if tags:
+            out[i] = tags
+    return out
+
+
+def _tagged(tags: Dict[int, Set[str]], line: int, rule: str) -> bool:
+    for tag_line in range(max(1, line - TAG_WINDOW), line + 1):
+        for tag in tags.get(tag_line, ()):
+            if TAG_RULES[tag] == rule:
+                return True
+    return False
+
+
+def _is_jit_name(expr: ast.expr) -> bool:
+    """jax.jit / jit (the raw, seam-bypassing form)."""
+    return _name_of(expr) == "jit"
+
+
+def _int_tuple(node: Optional[ast.expr]) -> Tuple[int, ...]:
+    """Literal int / tuple-of-int value of a static_argnums-style operand."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node: Optional[ast.expr]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(elt.value for elt in node.elts
+                     if isinstance(elt, ast.Constant)
+                     and isinstance(elt.value, str))
+    return ()
+
+
+class JitEntry:
+    """One jitted callable: where it was created and what the analyzer could
+    resolve about its static/donated slots."""
+
+    def __init__(self, name: str, call: ast.Call,
+                 fn: Optional[ast.AST]) -> None:
+        self.name = name                  # bare python name it is bound to
+        self.call = call                  # the make_jit/jax.jit call node
+        self.fn = fn                      # wrapped FunctionDef, if resolved
+        kw = {k.arg: k.value for k in call.keywords}
+        self.static_nums = _int_tuple(kw.get("static_argnums"))
+        self.static_names: Tuple[str, ...] = _str_tuple(
+            kw.get("static_argnames"))
+        self.donates = bool(_int_tuple(kw.get("donate_argnums")))
+        self.params: List[str] = []
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.params = [a.arg for a in fn.args.args]
+
+    def static_params(self) -> List[Tuple[object, str]]:
+        """(slot, param-name) for every resolvable static slot."""
+        out: List[Tuple[object, str]] = []
+        for i in self.static_nums:
+            if i < len(self.params):
+                out.append((i, self.params[i]))
+        for name in self.static_names:
+            out.append((name, name))
+        return out
+
+    def traced_params(self) -> Set[str]:
+        statics = {p for _, p in self.static_params()}
+        return set(self.params) - statics
+
+
+def _shapey(param: str) -> bool:
+    return bool(SHAPEY_TOKENS & set(param.lower().split("_")))
+
+
+def _contains(expr: ast.expr, pred) -> bool:
+    return any(pred(sub) for sub in ast.walk(expr))
+
+
+def _device_rooted(expr: ast.expr) -> bool:
+    """Heuristic: the expression reads device-resident state (the engine
+    state pytree or a ``*_dev`` cached array)."""
+    def devy(sub: ast.AST) -> bool:
+        if isinstance(sub, ast.Attribute):
+            return sub.attr == "state" or sub.attr.endswith("_dev")
+        if isinstance(sub, ast.Name):
+            return sub.id == "state" or sub.id.endswith("_dev")
+        return False
+    return _contains(expr, devy)
+
+
+def _goes_through_seam(expr: ast.expr) -> bool:
+    """True if the expression routes through jitwatch's audited helpers."""
+    def seam(sub: ast.AST) -> bool:
+        return (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and _base_name(sub.func) == "jitwatch"
+                and sub.func.attr in ("fetch", "drain", "host_transfer"))
+    return _contains(expr, seam)
+
+
+class Module:
+    def __init__(self, path: Path, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.noqa = noqa_lines(source)
+        self.tags = devlint_tags(source)
+        self.defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, node)
+
+
+class Analyzer:
+    def __init__(self, files: List[Path]) -> None:
+        self.modules: List[Module] = []
+        self.findings: List[Finding] = []
+        # bare name -> JitEntry, across every scanned file (the driver calls
+        # entries the engine defines; one registry covers the import)
+        self.registry: Dict[str, JitEntry] = {}
+        for f in files:
+            try:
+                source, tree = parse(f)
+            except SyntaxError:
+                continue  # tools/check.py owns syntax reporting
+            self.modules.append(Module(f, source, tree))
+
+    def report(self, mod: Module, line: int, rule: str, msg: str) -> None:
+        if suppressed(mod.noqa, line, rule) or _tagged(mod.tags, line, rule):
+            return
+        self.findings.append(Finding(mod.path, line, rule, msg))
+
+    # -- phase 1: jit inventory --------------------------------------------
+
+    def inventory(self) -> None:
+        for mod in self.modules:
+            # NAME = make_jit("class", fn, ...) at any nesting depth
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                value = node.value
+                if not (isinstance(value, ast.Call)
+                        and _name_of(value.func) in ("make_jit", "jit")):
+                    continue
+                fn_ref = None
+                # make_jit("class", fn, ...): fn is the 2nd positional;
+                # raw jit(fn, ...): fn is the 1st
+                pos = 1 if _name_of(value.func) == "make_jit" else 0
+                if len(value.args) > pos and isinstance(value.args[pos],
+                                                        ast.Name):
+                    fn_ref = mod.defs.get(value.args[pos].id)
+                for t in node.targets:
+                    name = _name_of(t)
+                    if name:
+                        self.registry[name] = JitEntry(name, value, fn_ref)
+            # decorator form: @functools.partial(make_jit, "class", ...)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for dec in node.decorator_list:
+                    if (isinstance(dec, ast.Call)
+                            and _name_of(dec.func) == "partial"
+                            and dec.args
+                            and _name_of(dec.args[0]) in ("make_jit", "jit")):
+                        self.registry[node.name] = JitEntry(
+                            node.name, dec, node)
+
+    # -- rule: recompile-hazard --------------------------------------------
+
+    def rule_recompile(self) -> None:
+        for mod in self.modules:
+            self._raw_jit_uses(mod)
+            self._nested_jit_creation(mod)
+            self._loop_varying_statics(mod)
+        for entry in self.registry.values():
+            self._shapey_statics(entry)
+            self._unhashable_static_defaults(entry)
+
+    def _raw_jit_uses(self, mod: Module) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec
+                    if (isinstance(dec, ast.Call)
+                            and _name_of(dec.func) == "partial" and dec.args):
+                        target = dec.args[0]
+                    elif isinstance(dec, ast.Call):
+                        target = dec.func
+                    if _is_jit_name(target):
+                        self.report(
+                            mod, node.lineno, "recompile-hazard",
+                            f"raw jax.jit on {node.name}() bypasses the "
+                            f"make_jit seam (rapid_tpu/runtime/jitwatch.py): "
+                            f"its compiles are invisible to the recompile "
+                            f"budget",
+                        )
+            elif (isinstance(node, ast.Call) and _is_jit_name(node.func)
+                  and isinstance(node.func, ast.Attribute)):
+                self.report(
+                    mod, node.lineno, "recompile-hazard",
+                    "raw jax.jit call bypasses the make_jit seam "
+                    "(rapid_tpu/runtime/jitwatch.py)",
+                )
+
+    def _nested_jit_creation(self, mod: Module) -> None:
+        for outer in ast.walk(mod.tree):
+            if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(outer):
+                if (isinstance(node, ast.Call)
+                        and _name_of(node.func) in ("make_jit", "jit")
+                        and node is not outer):
+                    self.report(
+                        mod, node.lineno, "recompile-hazard",
+                        f"jit wrapper created inside {outer.name}(): a fresh "
+                        f"executable per call unless the caller caches it "
+                        f"(tag '# devlint: jit-cached' if it does)",
+                    )
+                    break  # one finding per enclosing function is enough
+
+    def _shapey_statics(self, entry: JitEntry) -> None:
+        mod = self._module_of(entry.call)
+        if mod is None:
+            return
+        for slot, param in entry.static_params():
+            if _shapey(param):
+                self.report(
+                    mod, entry.call.lineno, "recompile-hazard",
+                    f"static parameter {param!r} of {entry.name} looks "
+                    f"shape/count-like: per-call-varying values mint one "
+                    f"executable each (tag '# devlint: static-shape' if the "
+                    f"value set is bounded)",
+                )
+
+    def _unhashable_static_defaults(self, entry: JitEntry) -> None:
+        mod = self._module_of(entry.call)
+        if mod is None or not isinstance(
+                entry.fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        args = entry.fn.args
+        defaults = args.defaults
+        offset = len(args.args) - len(defaults)
+        statics = {p for _, p in entry.static_params()}
+        for i, default in enumerate(defaults):
+            param = args.args[offset + i].arg
+            if param in statics and isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)):
+                self.report(
+                    mod, default.lineno, "recompile-hazard",
+                    f"static parameter {param!r} of {entry.name} defaults to "
+                    f"an unhashable {type(default).__name__.lower()}: every "
+                    f"call raises or re-traces; use a tuple",
+                )
+
+    def _loop_varying_statics(self, mod: Module) -> None:
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            loop_vars: Set[str] = set()
+            if isinstance(loop, ast.For):
+                for sub in ast.walk(loop.target):
+                    if isinstance(sub, ast.Name):
+                        loop_vars.add(sub.id)
+            if not loop_vars:
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                entry = self.registry.get(_name_of(node.func) or "")
+                if entry is None:
+                    continue
+                static_idx = {s for s, _ in entry.static_params()
+                              if isinstance(s, int)} | set(entry.static_nums)
+                for i, arg in enumerate(node.args):
+                    if i in static_idx and _contains(
+                            arg, lambda s: isinstance(s, ast.Name)
+                            and s.id in loop_vars):
+                        self.report(
+                            mod, node.lineno, "recompile-hazard",
+                            f"static argument {i} of {entry.name} varies "
+                            f"with the loop variable: one executable per "
+                            f"distinct value (tag '# devlint: static-shape' "
+                            f"if the value set is bounded)",
+                        )
+                # unhashable literals reaching a static slot
+                for i, arg in enumerate(node.args):
+                    if i in static_idx and isinstance(
+                            arg, (ast.List, ast.Dict, ast.Set)):
+                        self.report(
+                            mod, node.lineno, "recompile-hazard",
+                            f"unhashable literal at static argument {i} of "
+                            f"{entry.name}: jit statics must be hashable",
+                        )
+
+    # -- rule: host-sync ----------------------------------------------------
+
+    def rule_host_sync(self) -> None:
+        for mod in self.modules:
+            jitted_defs = {id(e.fn) for e in self.registry.values()
+                           if e.fn is not None}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    self._sync_call(mod, node)
+            for entry in self.registry.values():
+                if entry.fn is not None and id(entry.fn) in jitted_defs:
+                    if self._module_of(entry.call) is mod:
+                        self._traced_misuse(mod, entry)
+
+    def _sync_call(self, mod: Module, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in ("item", "tolist"):
+                if _device_rooted(func.value):
+                    self.report(
+                        mod, node.lineno, "host-sync",
+                        f".{func.attr}() on device state blocks on the "
+                        f"device queue; route it through jitwatch.fetch() "
+                        f"or tag '# devlint: sync-point'",
+                    )
+                return
+            if (func.attr in ("asarray", "array")
+                    and _base_name(func) in ("np", "numpy", "onp")):
+                if node.args and _device_rooted(node.args[0]) \
+                        and not _goes_through_seam(node.args[0]):
+                    self.report(
+                        mod, node.lineno, "host-sync",
+                        f"np.{func.attr}() on device state is an implicit "
+                        f"device->host copy; route it through "
+                        f"jitwatch.fetch() or tag '# devlint: sync-point'",
+                    )
+                return
+            if func.attr == "device_get" or func.attr == "block_until_ready":
+                if _base_name(func) == "jitwatch":
+                    return
+                self.report(
+                    mod, node.lineno, "host-sync",
+                    f"raw {func.attr}(): un-annotated sync point; use "
+                    f"jitwatch.fetch()/drain() or tag "
+                    f"'# devlint: sync-point'",
+                )
+                return
+        if (isinstance(func, ast.Name) and func.id in HOST_CASTS
+                and node.args):
+            arg = node.args[0]
+            if _device_rooted(arg) and not _goes_through_seam(arg):
+                self.report(
+                    mod, node.lineno, "host-sync",
+                    f"{func.id}() on device state forces a blocking "
+                    f"device->host transfer; route it through "
+                    f"jitwatch.fetch() or tag '# devlint: sync-point'",
+                )
+
+    def _traced_misuse(self, mod: Module, entry: JitEntry) -> None:
+        traced = entry.traced_params()
+        if not traced:
+            return
+        for node in ast.walk(entry.fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in HOST_CASTS and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in traced):
+                self.report(
+                    mod, node.lineno, "host-sync",
+                    f"{node.func.id}() on traced parameter "
+                    f"{node.args[0].id!r} inside jitted {entry.name}: "
+                    f"fails under jit (or silently bakes a constant); use "
+                    f"lax ops on the traced value",
+                )
+            if (isinstance(node, ast.If)
+                    and isinstance(node.test, ast.Name)
+                    and node.test.id in traced):
+                self.report(
+                    mod, node.lineno, "host-sync",
+                    f"python branch on traced parameter {node.test.id!r} "
+                    f"inside jitted {entry.name}: use lax.cond / jnp.where",
+                )
+
+    # -- rule: dtype-discipline --------------------------------------------
+
+    def rule_dtype(self) -> None:
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    self._dtype_construction(mod, node)
+                elif isinstance(node, ast.BinOp):
+                    self._narrow_widening(mod, node)
+
+    def _dtype_construction(self, mod: Module, node: ast.Call) -> None:
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and _base_name(func) == "jnp"
+                and func.attr in DTYPE_SLOT):
+            return
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return
+        if len(node.args) > DTYPE_SLOT[func.attr]:
+            return  # dtype passed positionally
+        self.report(
+            mod, node.lineno, "dtype-discipline",
+            f"jnp.{func.attr}() without an explicit dtype: the result "
+            f"depends on the x64 flag and weak-type promotion (a silent "
+            f"cache split); pin it",
+        )
+
+    def _narrow_widening(self, mod: Module, node: ast.BinOp) -> None:
+        def narrow(sub: ast.AST) -> bool:
+            return (isinstance(sub, (ast.Attribute, ast.Name))
+                    and _name_of(sub) in NARROW_FIELDS)
+
+        sides = [node.left, node.right]
+        if not any(_contains(s, narrow) for s in sides):
+            return
+        # .astype on either side is an explicit, audited widen
+        if any(_contains(s, lambda n: isinstance(n, ast.Call)
+                         and isinstance(n.func, ast.Attribute)
+                         and n.func.attr == "astype") for s in sides):
+            return
+        field = next(_name_of(sub) for s in sides for sub in ast.walk(s)
+                     if narrow(sub))
+        if isinstance(node.op, ast.Div):
+            self.report(
+                mod, node.lineno, "dtype-discipline",
+                f"true division on narrow state field {field!r} silently "
+                f"widens the pinned dtype to float; use // or an explicit "
+                f".astype()",
+            )
+            return
+        for s in sides:
+            if _contains(s, lambda n: isinstance(n, ast.Constant)
+                         and isinstance(n.value, float)):
+                self.report(
+                    mod, node.lineno, "dtype-discipline",
+                    f"float constant in arithmetic on narrow state field "
+                    f"{field!r} silently widens the pinned dtype; use an "
+                    f"explicit .astype()",
+                )
+                return
+
+    # -- rule: donation-hygiene --------------------------------------------
+
+    def rule_donation(self) -> None:
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                entry = self.registry.get(_name_of(node.value.func) or "")
+                if entry is None or entry.donates:
+                    continue
+                arg_reprs = {_unparse(a) for a in node.value.args}
+                targets: List[ast.expr] = []
+                for t in node.targets:
+                    targets.extend(t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t])
+                for t in targets:
+                    if _unparse(t) in arg_reprs:
+                        self.report(
+                            mod, node.lineno, "donation-hygiene",
+                            f"{_unparse(t)} is carried through jitted "
+                            f"{entry.name} with no donate_argnums: the old "
+                            f"buffers stay live across the call (peak memory "
+                            f"doubles); donate, or tag "
+                            f"'# devlint: no-donate' if the input is shared",
+                        )
+                        break
+
+    # -- driver -------------------------------------------------------------
+
+    def _module_of(self, node: ast.AST) -> Optional[Module]:
+        if not hasattr(self, "_node_mod"):
+            self._node_mod: Dict[int, Module] = {}
+            for mod in self.modules:
+                for sub in ast.walk(mod.tree):
+                    self._node_mod[id(sub)] = mod
+        return self._node_mod.get(id(node))
+
+    def run(self) -> List[Finding]:
+        self.inventory()
+        self.rule_recompile()
+        self.rule_host_sync()
+        self.rule_dtype()
+        self.rule_donation()
+        # dedup (a node can be reached by more than one walk) + stable order
+        seen: Set[str] = set()
+        unique: List[Finding] = []
+        for f in sorted(self.findings,
+                        key=lambda f: (str(f.path), f.line, f.rule, f.msg)):
+            if str(f) not in seen:
+                seen.add(str(f))
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+
+# --------------------------------------------------------------------------- #
+# entry points
+# --------------------------------------------------------------------------- #
+
+def run(paths: Optional[List[str]] = None) -> List[Finding]:
+    files = iter_py_files([Path(p) for p in (paths or DEVICE_PLANE)])
+    return Analyzer(files).run()
+
+
+def main(argv: List[str]) -> int:
+    findings = run(argv or None)
+    for finding in findings:
+        print(finding)
+    print(f"devlint: {'OK' if not findings else f'{len(findings)} findings'}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
