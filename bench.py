@@ -76,12 +76,27 @@ WATCHDOG_S = 20 * 60
 # Progress shared with the watchdog: once the headline measurement exists it
 # is the round's artifact, and a later hang (e.g. the 1M sweep point jitting
 # against a dying tunnel) must emit it rather than destroy it.
-_PROGRESS: dict = {"headline": None, "backend": None, "sweep": [], "wan": None}
+_PROGRESS: dict = {
+    "headline": None, "backend": None, "sweep": [], "wan": None,
+    "serving": None,
+}
 
 # jitwatch compile accounting of the most recent warmed_run (warmup vs
 # steady split); run_sweep copies it into each sweep entry and main() into
 # the headline, so every JSON data point carries its own compile story.
 _LAST_JIT_STATS: dict = {}
+
+# Serving dimension: closed-loop Get/Put load against the serving-plane
+# mirror (replicated KV over placement + handoff), measured through a view
+# change. Three windows -- steady state, the churn window between the crash
+# and the decided view (dead leaders cost redirect hops + quorum reads),
+# and post-view -- each reporting throughput + p50/p99 + the full latency
+# histogram on virtual time, so the numbers are deterministic per seed.
+SERVING_N_NODES = 64
+SERVING_PARTITIONS = 256
+SERVING_KEYS = 64
+SERVING_OPS = {"steady": 300, "view_change_window": 150, "post_view": 150}
+SERVING_PUT_FRACTION = 0.2
 
 # WAN dimension: stable-view latency vs inter-region round-trip time. Two
 # regions, 2k nodes, a 1% crash in the mix; the topology compiles to
@@ -201,6 +216,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "backend": backend,
                 "sweep": merged,
                 "wan_stable_view": _PROGRESS["wan"],
+                "serving_qps": _PROGRESS["serving"],
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "placement_partitions_moved": _placement_hist(),
                 "handoff_session_bytes": _handoff_hist(),
@@ -456,6 +472,16 @@ def run_sweep(backend: str, seed: int) -> list:
         _PROGRESS["wan"] = [{"error": f"{type(exc).__name__}: {exc}"}]
         print(f"bench.py: WAN dimension failed: {exc}", file=sys.stderr,
               flush=True)
+    # serving dimension: same ride-along policy as WAN -- a lost-acked-write
+    # is a correctness bug and crashes; anything else keeps the artifact
+    try:
+        run_serving_dimension(seed)
+    except AssertionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 -- keep the artifact
+        _PROGRESS["serving"] = {"error": f"{type(exc).__name__}: {exc}"}
+        print(f"bench.py: serving dimension failed: {exc}", file=sys.stderr,
+              flush=True)
     return out
 
 
@@ -499,6 +525,109 @@ def run_wan_dimension(seed: int) -> list:
             "wall_ms": round(wall_ms, 1),
         })
     return out
+
+
+def _latency_window(latencies: list) -> dict:
+    """Quantiles + full histogram for one measurement window, bucketed on
+    the same SERVING_LATENCY_BUCKETS_MS ladder the engines observe into."""
+    from rapid_tpu.observability import SERVING_LATENCY_BUCKETS_MS
+
+    ordered = sorted(latencies)
+
+    def pct(p: float) -> "float | None":
+        if not ordered:
+            return None
+        return float(ordered[min(len(ordered) - 1, int(p * len(ordered)))])
+
+    buckets = {
+        str(b): sum(1 for x in ordered if x <= b)
+        for b in SERVING_LATENCY_BUCKETS_MS
+    }
+    buckets["inf"] = len(ordered)
+    return {
+        "count": len(ordered),
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "latency_hist_ms": buckets,
+    }
+
+
+def run_serving_dimension(seed: int) -> dict:
+    """The serving curve: a closed-loop client (next op issued only after
+    the previous acks) drives Get/Put traffic against the simulator's
+    serving plane across three windows -- steady state, the churn window
+    between a crash and the decided view, and post-view. Latency is the
+    virtual-ms span of each logical op including client retries, so the
+    entire dimension is deterministic per seed. Zero-lost-acked-writes is
+    asserted after the view change: every write the oracle recorded as
+    acknowledged must read back at >= its acked version."""
+    from rapid_tpu.sim.driver import Simulator
+
+    rng = np.random.default_rng(seed)
+    sim = Simulator(SERVING_N_NODES, seed=seed)
+    sim.enable_placement(partitions=SERVING_PARTITIONS)
+    sim.enable_handoff()
+    sim.enable_serving()
+    keys = [b"bench-key-%04d" % i for i in range(SERVING_KEYS)]
+    for i, key in enumerate(keys):  # preload, unmeasured
+        ack = sim.serving_put(key, b"seed-%d" % i)
+        assert ack.status == ack.STATUS_OK, "preload write failed to ack"
+
+    def drive(n_ops: int) -> list:
+        latencies = []
+        for _ in range(n_ops):
+            key = keys[int(rng.integers(len(keys)))]
+            is_put = rng.random() < SERVING_PUT_FRACTION
+            t0 = sim.virtual_ms
+            for _attempt in range(8):  # closed loop: retry until acked
+                if is_put:
+                    ack = sim.serving_put(key, b"v-%d" % sim.virtual_ms)
+                else:
+                    ack = sim.serving_get(key)
+                if ack.status != ack.STATUS_RETRY:
+                    break
+            latencies.append(float(sim.virtual_ms - t0))
+        return latencies
+
+    windows = {}
+    windows["steady"] = drive(SERVING_OPS["steady"])
+    victim = int(rng.integers(1, SERVING_N_NODES))
+    sim.crash(np.array([victim]))
+    windows["view_change_window"] = drive(SERVING_OPS["view_change_window"])
+    record = sim.run_until_decision(max_rounds=64, batch=16)
+    assert record is not None, "serving dimension: no view decision"
+    assert set(record.cut) == {victim}, "serving dimension: cut parity"
+    windows["post_view"] = drive(SERVING_OPS["post_view"])
+
+    lost = 0
+    for key, (version, value) in sim.serving_acked.items():
+        back = sim.serving_get(key)
+        if back.status != back.STATUS_OK or back.version < version:
+            lost += 1
+    assert lost == 0, f"serving dimension: {lost} acked writes lost"
+
+    entry = {
+        "n": SERVING_N_NODES,
+        "partitions": SERVING_PARTITIONS,
+        "put_fraction": SERVING_PUT_FRACTION,
+        "lost_acked_writes": 0,
+        "virtual_ms": sim.virtual_ms,
+    }
+    total_ops, total_ms = 0, 0.0
+    for name, latencies in windows.items():
+        stats = _latency_window(latencies)
+        stats["qps"] = (
+            round(1000.0 * len(latencies) / sum(latencies), 1)
+            if sum(latencies) else None
+        )
+        entry[name] = stats
+        total_ops += len(latencies)
+        total_ms += sum(latencies)
+    entry["throughput_qps"] = (
+        round(1000.0 * total_ops / total_ms, 1) if total_ms else None
+    )
+    _PROGRESS["serving"] = entry
+    return entry
 
 
 def main() -> None:

@@ -11,8 +11,11 @@ reconfigurations. This example is that scenario on the TPU-hosted plane:
   through the gateway, its backend pool maintained ONLY by VIEW_CHANGE
   subscriptions (ClusterEvents.java:19-24 -- no health checks of its own,
   membership IS the health signal),
-- requests are routed by rendezvous (highest-random-weight) hashing over
-  the live pool, so a view change moves only the failed backends' keys,
+- requests are routed through the serving plane's client-side router
+  (rapid_tpu.serving.RendezvousRouter -- rendezvous hashing over the live
+  pool, byte-identical to the routing this example originally hand-rolled;
+  the parity is asserted below), so a view change moves only the failed
+  backends' keys,
 - a correlated burst kills 10 backends; the membership protocol cuts all
   of them in one view change and the router's very next routes are clean.
 
@@ -23,80 +26,28 @@ from __future__ import annotations
 
 import argparse
 import sys
-import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict
 
 sys.path.insert(0, ".")
 
 import numpy as np  # noqa: E402
 
-from rapid_tpu import ClusterBuilder, Cluster, Endpoint, Settings  # noqa: E402
-from rapid_tpu.events import ClusterEvents, NodeStatusChange  # noqa: E402
+from rapid_tpu import ClusterBuilder, Endpoint, Settings  # noqa: E402
 from rapid_tpu.placement import rendezvous_route, weight_seed  # noqa: E402
+from rapid_tpu.serving import RendezvousRouter  # noqa: E402
 from rapid_tpu.messaging.gateway import (  # noqa: E402
     GatewayRoutedClient,
     GatewaySwarmBroadcaster,
     SwarmGateway,
 )
 from rapid_tpu.messaging.tcp import TcpClientServer  # noqa: E402
-from rapid_tpu.types import EdgeStatus  # noqa: E402
 
-
-class ViewChangeRouter:
-    """Routes request keys over the live membership, rebalancing exactly at
-    VIEW_CHANGE events (the reference app surface: Cluster.java:98-140's
-    getters plus registerSubscription).
-
-    Rendezvous hashing via the placement plane's helpers
-    (rapid_tpu.placement.rendezvous_route): key k goes to the backend with
-    the highest seeded hash of k. Removing a backend only remaps the keys
-    that were on it -- the property that makes a single multi-node cut a
-    single rebalance."""
-
-    def __init__(self, cluster: Cluster, self_address: Endpoint) -> None:
-        self._self = self_address
-        self._lock = threading.Lock()
-        self._backends: List[Endpoint] = []
-        self._weight_seed: Dict[Endpoint, int] = {}
-        self.view_changes = 0
-        self.last_down: List[NodeStatusChange] = []
-        cluster.register_subscription(
-            ClusterEvents.VIEW_CHANGE, self._on_view_change
-        )
-        # the initial pool comes from the join response's configuration
-        self._set_backends(cluster.get_memberlist())
-
-    def _set_backends(self, members: List[Endpoint]) -> None:
-        backends = [m for m in members if m != self._self]
-        with self._lock:
-            self._backends = backends
-            self._weight_seed = {b: weight_seed(b) for b in backends}
-
-    def _on_view_change(self, config_id: int, changes) -> None:
-        with self._lock:
-            pool = {b for b in self._backends}
-        for change in changes:
-            if change.status == EdgeStatus.UP:
-                pool.add(change.endpoint)
-            else:
-                pool.discard(change.endpoint)
-        self.view_changes += 1
-        self.last_down = [
-            c for c in changes if c.status == EdgeStatus.DOWN
-        ]
-        self._set_backends(sorted(pool, key=lambda e: (e.hostname, e.port)))
-
-    def backends(self) -> List[Endpoint]:
-        with self._lock:
-            return list(self._backends)
-
-    def route(self, key: bytes) -> Optional[Endpoint]:
-        """The backend owning this key under rendezvous hashing."""
-        with self._lock:
-            if not self._backends:
-                return None
-            return rendezvous_route(key, self._backends, self._weight_seed)
+# The router implementation this example originally hand-rolled now lives
+# in the serving plane (rapid_tpu/serving/router.py) as its client-side
+# routing surface; the alias keeps this example's historical name working
+# for anything that imported it.
+ViewChangeRouter = RendezvousRouter
 
 
 def run_scenario(
@@ -151,6 +102,15 @@ def run_scenario(
         keys = [b"req-%d" % i for i in range(requests_per_check)]
         before = {k: router.route(k) for k in keys}
         assert all(v is not None for v in before.values())
+
+        # routing parity: the serving plane's router must route every key
+        # byte-identically to the rendezvous hashing this example
+        # originally computed inline
+        pool = router.backends()
+        seeds = {b: weight_seed(b) for b in pool}
+        assert all(
+            before[k] == rendezvous_route(k, pool, seeds) for k in keys
+        ), "serving-plane router diverged from direct rendezvous routing"
 
         # the correlated burst: fail `fail` backends at once
         victims = np.arange(2, 2 + fail)

@@ -150,6 +150,17 @@ def main() -> None:
         "(JSON lines, newest last): the last N membership-relevant events "
         "this node saw",
     )
+    parser.add_argument(
+        "--serving", action="store_true",
+        help="demo mode: enable the serving plane (replicated Get/Put KV "
+        "over placement + handoff) on this agent; every status tick writes "
+        "a per-agent demo key through the quorum path, reads it back, and "
+        "logs the serving counters",
+    )
+    parser.add_argument(
+        "--serving-partitions", type=int, default=64,
+        help="placement partition count for --serving mode",
+    )
     parser.add_argument("--status-timeout", type=float, default=5.0,
                         help="seconds to wait in --status mode")
     parser.add_argument("--verbose", action="store_true")
@@ -221,6 +232,11 @@ def main() -> None:
         .add_subscription(ClusterEvents.VIEW_CHANGE, on_event("VIEW_CHANGE"))
         .add_subscription(ClusterEvents.KICKED, on_event("KICKED"))
     )
+    if args.serving:
+        from rapid_tpu.handoff.store import InMemoryPartitionStore
+
+        builder.use_placement(partitions=args.serving_partitions)
+        builder.use_serving(InMemoryPartitionStore())
     if args.broadcaster == "gossip":
         if args.gossip_fanout < 1:
             parser.error("--gossip-fanout must be >= 1")
@@ -247,6 +263,7 @@ def main() -> None:
         cluster = builder.start()
     log.info("agent started at %s", listen)
 
+    demo_key = b"agent-demo:" + args.listen_address.encode()
     try:
         while True:
             time.sleep(1)
@@ -257,6 +274,21 @@ def main() -> None:
                 cluster.get_current_configuration_id(),
                 [str(m) for m in members] if len(members) <= 32 else "...",
             )
+            if args.serving:
+                # the demo loop: one quorum write + one routed read per
+                # tick, so a multi-agent deployment visibly replicates
+                try:
+                    value = b"tick-%d" % int(time.time())
+                    cluster.serving_put(demo_key, value).result(5.0)
+                    back = cluster.serving_get(demo_key).result(5.0)
+                    gets, puts, put_acks = cluster.get_serving_status()
+                    log.info(
+                        "serving key=%s value=%s gets=%d puts=%d acks=%d",
+                        demo_key.decode(), back.value.decode(),
+                        gets, puts, put_acks,
+                    )
+                except Exception as exc:  # noqa: BLE001 -- demo, keep ticking
+                    log.warning("serving demo op failed: %s", exc)
             if args.metrics_out:
                 _write_prometheus_atomic(args.metrics_out)
     except KeyboardInterrupt:

@@ -144,6 +144,24 @@ class Cluster:
         engine = self._membership_service.handoff_engine()
         return engine.store if engine is not None else None
 
+    def serving_put(self, key: bytes, value: bytes) -> Promise:
+        """Write ``key`` through the serving plane (use_serving); resolves
+        with the final PutAck after routing redirects and quorum ack."""
+        self._check_running()
+        return self._membership_service.serving_put(key, value)
+
+    def serving_get(self, key: bytes) -> Promise:
+        """Read ``key`` through the serving plane; resolves with a PutAck."""
+        self._check_running()
+        return self._membership_service.serving_get(key)
+
+    def get_serving_status(self) -> Tuple[int, int, int]:
+        """(gets, puts, replication acks) served by this member, all zero
+        when the node was built without ``use_serving``."""
+        self._check_running()
+        engine = self._membership_service.serving_engine()
+        return engine.status() if engine is not None else (0, 0, 0)
+
     def leave_gracefully_async(self) -> Promise:
         """Inform observers of the intent to leave, then shut down
         (Cluster.java:145-149)."""
@@ -197,6 +215,7 @@ class ClusterBuilder:
         self._tracer: Optional[Tracer] = None
         self._placement: Optional[PlacementConfig] = None
         self._handoff_store: Optional[PartitionStore] = None
+        self._serving = False
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -273,6 +292,21 @@ class ClusterBuilder:
         and released from it once a verified new owner acks (handoff/).
         Requires ``use_placement`` with identical parameters cluster-wide."""
         self._handoff_store = store
+        return self
+
+    def use_serving(
+        self, store: Optional[PartitionStore] = None
+    ) -> "ClusterBuilder":
+        """Enable the serving plane: a replicated Get/Put KV store routed by
+        the placement map, with quorum-ack writes and leader reads
+        (serving/). The serving engine persists into the handoff plane's
+        PartitionStore so view-change state transfer moves serving data
+        through verified handoff sessions; ``store`` configures the handoff
+        plane when it is not configured yet. Requires ``use_placement`` and
+        ``use_handoff`` (directly or via ``store``)."""
+        if store is not None and self._handoff_store is None:
+            self.use_handoff(store)
+        self._serving = True
         return self
 
     def set_broadcaster_factory(self, factory) -> "ClusterBuilder":
@@ -371,6 +405,7 @@ class ClusterBuilder:
             ),
             placement=self._placement,
             handoff_store=self._handoff_store,
+            serving=self._serving,
         )
         server.set_membership_service(service)
         server.start()
@@ -510,6 +545,7 @@ class ClusterBuilder:
                 recorder=recorder,
                 placement=self._placement,
                 handoff_store=self._handoff_store,
+                serving=self._serving,
             )
             server.set_membership_service(service)
             result.set_result(

@@ -62,6 +62,9 @@ _TYPES: Tuple[Type, ...] = (
     T.HandoffRequest,  # 19
     T.HandoffChunk,  # 20
     T.HandoffAck,  # 21
+    T.Get,  # 22
+    T.Put,  # 23
+    T.PutAck,  # 24
 )
 _TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
 

@@ -163,6 +163,21 @@ def to_wire_request(msg: T.RapidMessage):
         h.partition = msg.partition
         h.fingerprint = msg.fingerprint
         h.mapVersion = msg.map_version
+    elif isinstance(msg, T.Get):
+        g = req.get
+        g.sender.CopyFrom(_ep(msg.sender))
+        g.key = msg.key
+        g.quorum = msg.quorum
+        g.mapVersion = msg.map_version
+    elif isinstance(msg, T.Put):
+        p = req.put
+        p.sender.CopyFrom(_ep(msg.sender))
+        p.key = msg.key
+        p.value = msg.value
+        p.requestId = msg.request_id
+        p.replicate = msg.replicate
+        p.version = msg.version
+        p.mapVersion = msg.map_version
     else:
         raise TypeError(f"not a request type: {type(msg).__name__}")
     ctx = trace_context_of(msg)
@@ -274,6 +289,25 @@ def _from_wire_request_content(req) -> T.RapidMessage:
             fingerprint=int(m.fingerprint),
             map_version=int(m.mapVersion),
         )
+    if which == "get":
+        m = req.get
+        return T.Get(
+            sender=_ep_back(m.sender),
+            key=bytes(m.key),
+            quorum=int(m.quorum),
+            map_version=int(m.mapVersion),
+        )
+    if which == "put":
+        m = req.put
+        return T.Put(
+            sender=_ep_back(m.sender),
+            key=bytes(m.key),
+            value=bytes(m.value),
+            request_id=int(m.requestId),
+            replicate=int(m.replicate),
+            version=int(m.version),
+            map_version=int(m.mapVersion),
+        )
     raise ValueError(f"empty RapidRequest envelope: {which}")
 
 
@@ -315,6 +349,22 @@ def to_wire_response(msg) :
         s.handoffFailed = msg.handoff_failed
         s.handoffPartitions.extend(msg.handoff_partitions)
         s.handoffFingerprints.extend(msg.handoff_fingerprints)
+        s.servingGets = msg.serving_gets
+        s.servingPuts = msg.serving_puts
+        s.servingPutAcks = msg.serving_put_acks
+        s.servingPartitions.extend(msg.serving_partitions)
+        s.servingLeaders.extend(msg.serving_leaders)
+    elif isinstance(msg, T.PutAck):
+        a = resp.putAck
+        a.sender.CopyFrom(_ep(msg.sender))
+        a.status = msg.status
+        a.key = msg.key
+        a.value = msg.value
+        a.version = msg.version
+        a.requestId = msg.request_id
+        if msg.leader is not None:
+            a.leader.CopyFrom(_ep(msg.leader))
+        a.mapVersion = msg.map_version
     elif isinstance(msg, T.HandoffChunk):
         h = resp.handoffChunk
         h.sender.CopyFrom(_ep(msg.sender))
@@ -372,6 +422,23 @@ def from_wire_response(resp):
             handoff_failed=int(m.handoffFailed),
             handoff_partitions=tuple(int(p) for p in m.handoffPartitions),
             handoff_fingerprints=tuple(int(f) for f in m.handoffFingerprints),
+            serving_gets=int(m.servingGets),
+            serving_puts=int(m.servingPuts),
+            serving_put_acks=int(m.servingPutAcks),
+            serving_partitions=tuple(int(p) for p in m.servingPartitions),
+            serving_leaders=tuple(str(s) for s in m.servingLeaders),
+        )
+    if which == "putAck":
+        m = resp.putAck
+        return T.PutAck(
+            sender=_ep_back(m.sender),
+            status=int(m.status),
+            key=bytes(m.key),
+            value=bytes(m.value),
+            version=int(m.version),
+            request_id=int(m.requestId),
+            leader=_ep_back(m.leader) if m.HasField("leader") else None,
+            map_version=int(m.mapVersion),
         )
     if which == "handoffChunk":
         m = resp.handoffChunk

@@ -133,6 +133,13 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("handoffFailed", "int32", 18, False),
         ("handoffPartitions", "int64", 19, True),
         ("handoffFingerprints", "int64", 20, True),
+        # serving plane exposure: request counters plus the local replica's
+        # (partition id, leader "host:port") digest as parallel arrays
+        ("servingGets", "int64", 21, False),
+        ("servingPuts", "int64", 22, False),
+        ("servingPutAcks", "int64", 23, False),
+        ("servingPartitions", "int64", 24, True),
+        ("servingLeaders", "string", 25, True),
     ],
     "HandoffRequest": [
         ("sender", "M:Endpoint", 1, False),
@@ -159,6 +166,31 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("fingerprint", "int64", 4, False),
         ("mapVersion", "int64", 5, False),
     ],
+    "Get": [
+        ("sender", "M:Endpoint", 1, False),
+        ("key", "bytes", 2, False),
+        ("quorum", "int32", 3, False),
+        ("mapVersion", "int64", 4, False),
+    ],
+    "Put": [
+        ("sender", "M:Endpoint", 1, False),
+        ("key", "bytes", 2, False),
+        ("value", "bytes", 3, False),
+        ("requestId", "int64", 4, False),
+        ("replicate", "int32", 5, False),
+        ("version", "int64", 6, False),
+        ("mapVersion", "int64", 7, False),
+    ],
+    "PutAck": [
+        ("sender", "M:Endpoint", 1, False),
+        ("status", "int32", 2, False),
+        ("key", "bytes", 3, False),
+        ("value", "bytes", 4, False),
+        ("version", "int64", 5, False),
+        ("requestId", "int64", 6, False),
+        ("leader", "M:Endpoint", 7, False),
+        ("mapVersion", "int64", 8, False),
+    ],
 }
 
 # Trace context rides OUTSIDE the request oneof (a sibling of `content`):
@@ -179,10 +211,14 @@ _REQUEST_ONEOF = [
     ("phase2bMessage", "Phase2bMessage", 9),
     ("leaveMessage", "LeaveMessage", 10),
     ("clusterStatusRequest", "ClusterStatusRequest", 11),
-    # 12/13 are handoff-plane extensions; 15 is reserved for traceCtx
-    # (TRACE_CTX_FIELD_NUMBER), which rides outside the oneof
+    # 12/13 are handoff-plane extensions, 14/16 serving-plane extensions;
+    # 15 is reserved for traceCtx (TRACE_CTX_FIELD_NUMBER), which rides
+    # outside the oneof -- the serving messages skip it, so the oneof is
+    # contiguous from 1 except for that one documented gap
     ("handoffRequest", "HandoffRequest", 12),
     ("handoffAck", "HandoffAck", 13),
+    ("get", "Get", 14),
+    ("put", "Put", 16),
 ]
 _RESPONSE_ONEOF = [
     ("joinResponse", "JoinResponse", 1),
@@ -191,6 +227,7 @@ _RESPONSE_ONEOF = [
     ("probeResponse", "ProbeResponse", 4),
     ("clusterStatusResponse", "ClusterStatusResponse", 5),
     ("handoffChunk", "HandoffChunk", 6),
+    ("putAck", "PutAck", 7),
 ]
 
 _ENUMS = {

@@ -129,6 +129,18 @@ METRIC_CATALOG = frozenset({
     "handoff.session_bytes",
     "handoff.session_chunks",
     "handoff.releases",
+    # serving plane (serving/, service.py, sim/driver.py)
+    "serving.gets",
+    "serving.puts",
+    "serving.put_acks",
+    "serving.put_retries",
+    "serving.replication_writes",
+    "serving.leader_reads",
+    "serving.quorum_reads",
+    "serving.not_leader_redirects",
+    "serving.leader_changes",
+    "serving.reconciled_replicas",
+    "serving.request_ms",
 })
 
 # Dynamic name families: an f-string call site is legal iff its literal head
@@ -144,6 +156,7 @@ SPAN_CATALOG = frozenset({
     "device_rounds",     # sim/driver.py: a batch of device-dispatched rounds
     "placement_rebalance",  # placement map rebuilt after a view change
     "handoff_session",   # one partition's state transfer (handoff/engine.py)
+    "serving_request",   # one client Get/Put through the serving engine
 })
 
 # Instant-event and flight-recorder kinds: every Tracer.event and
@@ -170,6 +183,8 @@ EVENT_CATALOG = frozenset({
     "handoff_complete",  # a session finished with a verified fingerprint
     "handoff_failed",    # a session exhausted sources/retries
     "handoff_release",   # source released a partition after a verified ack
+    "serving_leader_change",  # a partition's leader moved with the view
+    "serving_sync",      # churned partition re-synced from replica snapshots
 })
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).
@@ -203,6 +218,14 @@ HANDOFF_BYTES_BUCKETS: Tuple[float, ...] = (
 # Chunks per handoff session (handoff.session_chunks): powers of two.
 HANDOFF_CHUNKS_BUCKETS: Tuple[float, ...] = (
     0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+)
+
+# Per-request serving latency (serving.request_ms): sub-millisecond through
+# view-change-window tails. Finer low end than DEFAULT_LATENCY_BUCKETS_MS
+# because a leader read inside one process is typically < 1 ms, while a
+# quorum write during churn can stretch to seconds.
+SERVING_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    0.25, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
 )
 
 

@@ -50,6 +50,7 @@ from .placement.engine import (
 from .runtime.futures import Promise, successful_as_list
 from .runtime.resources import SharedResources
 from .runtime.scheduler import ScheduledTask
+from .serving.engine import ServingEngine
 from .settings import Settings
 from .types import (
     AlertMessage,
@@ -62,6 +63,7 @@ from .types import (
     Endpoint,
     FastRoundPhase2bMessage,
     FastRoundVoteBatch,
+    Get,
     GossipEnvelope,
     HandoffAck,
     HandoffRequest,
@@ -73,6 +75,8 @@ from .types import (
     PreJoinMessage,
     ProbeMessage,
     ProbeResponse,
+    Put,
+    PutAck,
     RapidMessage,
     Response,
 )
@@ -86,6 +90,16 @@ def address_comparator_key(endpoint: Endpoint) -> int:
     """Seed-0 ring order, used to canonicalize proposals before consensus
     (MembershipService.java:340-342)."""
     return to_signed(endpoint_hash(endpoint.hostname, endpoint.port, 0))
+
+
+def _chain_promise(inner: Promise, outer: Promise) -> None:
+    """Propagate a completed inner promise (result or exception) onto the
+    outer one the transport is watching."""
+    exc = inner.exception()
+    if exc is not None:
+        outer.try_set_exception(exc)
+    else:
+        outer.try_set_result(inner._result)  # noqa: SLF001
 
 
 class MembershipService:
@@ -107,6 +121,7 @@ class MembershipService:
         recorder: Optional[FlightRecorder] = None,
         placement: Optional[PlacementConfig] = None,
         handoff_store: Optional[PartitionStore] = None,
+        serving: bool = False,
     ) -> None:
         self._my_addr = my_addr
         self._cut_detection = cut_detector
@@ -205,6 +220,22 @@ class MembershipService:
                 recorder=self.recorder,
             )
 
+        # Serving plane: a replicated Get/Put KV store routed by the
+        # placement map, persisting into the handoff plane's store so
+        # view-change state transfer moves serving data through verified
+        # handoff sessions (serving/engine.py).
+        self._serving: Optional[ServingEngine] = None
+        if serving:
+            if self._placement is None or self._handoff is None:
+                raise ValueError(
+                    "serving requires placement and handoff to be configured"
+                )
+            self._serving = ServingEngine(
+                handoff_store, my_addr, client, self._scheduler,
+                metrics=self.metrics, tracer=self.tracer,
+                recorder=self.recorder,
+            )
+
         # Initial VIEW_CHANGE callbacks: start/join completed
         # (MembershipService.java:162-165)
         configuration_id = self._view.get_current_configuration_id()
@@ -252,7 +283,34 @@ class MembershipService:
             return self._handle_handoff_request(msg)
         if isinstance(msg, HandoffAck):
             return self._handle_handoff_ack(msg)
+        if isinstance(msg, (Get, Put)):
+            return self._handle_serving(msg)
         raise TypeError(f"unidentified request type {type(msg).__name__}")
+
+    def _handle_serving(self, msg: RapidMessage) -> Promise:
+        """Serving-plane Get/Put: hop onto the protocol executor (leader
+        checks read placement state the view-change path mutates) and chain
+        the engine's -- possibly asynchronous, e.g. a quorum read or a
+        replication fan-out -- answer onto the transport's promise."""
+        if self._serving is None:
+            # a member without the serving plane tells the client to retry
+            # elsewhere rather than hanging its request
+            key = getattr(msg, "key", b"")
+            return Promise.completed(PutAck(
+                sender=self._my_addr, status=PutAck.STATUS_RETRY, key=key,
+                request_id=getattr(msg, "request_id", 0),
+            ))
+        future: Promise = Promise()
+
+        def task() -> None:
+            if isinstance(msg, Get):
+                inner = self._serving.handle_get(msg)
+            else:
+                inner = self._serving.handle_put(msg)
+            inner.add_callback(lambda p: _chain_promise(p, future))
+
+        self._resources.protocol_executor.execute(task)
+        return future
 
     def _handle_handoff_request(self, msg: HandoffRequest) -> Promise:
         """Serve one chunk of a partition to a pulling new owner. The slice
@@ -327,6 +385,16 @@ class MembershipService:
                         self._handoff.store.fingerprint, handoff_partitions
                     )
                 )
+        serving_gets = serving_puts = serving_put_acks = 0
+        serving_partitions: Tuple[int, ...] = ()
+        serving_leaders: Tuple[str, ...] = ()
+        if self._serving is not None:
+            serving_gets, serving_puts, serving_put_acks = (
+                self._serving.status()
+            )
+            serving_partitions, serving_leaders = (
+                self._serving.leader_digest()
+            )
         return ClusterStatusResponse(
             sender=self._my_addr,
             configuration_id=self._view.get_current_configuration_id(),
@@ -352,6 +420,11 @@ class MembershipService:
             handoff_failed=handoff_failed,
             handoff_partitions=handoff_partitions,
             handoff_fingerprints=handoff_fingerprints,
+            serving_gets=serving_gets,
+            serving_puts=serving_puts,
+            serving_put_acks=serving_put_acks,
+            serving_partitions=serving_partitions,
+            serving_leaders=serving_leaders,
         )
 
     # ------------------------------------------------------------------ #
@@ -370,6 +443,24 @@ class MembershipService:
     def handoff_engine(self) -> Optional[HandoffEngine]:
         """The live handoff engine (None unless use_handoff configured)."""
         return self._handoff
+
+    def serving_engine(self) -> Optional[ServingEngine]:
+        """The live serving engine (None unless use_serving configured)."""
+        return self._serving
+
+    def serving_put(self, key: bytes, value: bytes) -> Promise:
+        """Write through the serving plane (routing, replication and
+        retries happen inside the engine); completes with the final
+        PutAck."""
+        if self._serving is None:
+            raise RuntimeError("serving is not enabled on this member")
+        return self._serving.client_put(key, value)
+
+    def serving_get(self, key: bytes) -> Promise:
+        """Read through the serving plane; completes with a PutAck."""
+        if self._serving is None:
+            raise RuntimeError("serving is not enabled on this member")
+        return self._serving.client_get(key)
 
     def _update_placement(self, configuration_id: int) -> None:
         """Recompute the shard map for the just-installed configuration.
@@ -419,6 +510,12 @@ class MembershipService:
                         configuration_id=configuration_id,
                         sessions=launched, version=pmap.version,
                     )
+            if self._serving is not None:
+                # after the handoff sessions launch, so the cache
+                # invalidation in update_map sees the same acquisition set
+                # the sessions will fill; promote-time snapshot syncs join
+                # this churn's trace
+                self._serving.update_map(pmap)
         self.metrics.incr("placement.rebuilds")
         self.metrics.set_gauge("placement.imbalance", pmap.imbalance())
         self.metrics.set_gauge(

@@ -1,0 +1,14 @@
+"""Serving plane: a replicated Get/Put KV store over placement + handoff."""
+
+from .engine import ServingEngine
+from .kv import SERVING_SEED, decode_kv, encode_kv, partition_of
+from .router import RendezvousRouter
+
+__all__ = [
+    "SERVING_SEED",
+    "RendezvousRouter",
+    "ServingEngine",
+    "decode_kv",
+    "encode_kv",
+    "partition_of",
+]

@@ -1,0 +1,627 @@
+"""The serving plane: a replicated Get/Put KV store over placement + handoff.
+
+One :class:`ServingEngine` per member, wired by service.py next to the
+handoff engine and fed the same placement maps. The engine is every role of
+the protocol at once:
+
+- *router* (``client_get``/``client_put``): hashes the key to a partition
+  (kv.py), sends to that partition's leader -- the first replica in
+  placement order, which is live by construction since placement rows only
+  contain current members -- and follows NOT_LEADER hints / retries RETRY
+  answers with a bounded budget, so requests issued mid-churn converge on
+  the post-view leader instead of failing.
+- *leader*: assigns each key's next monotonic version, applies locally,
+  fans replication Puts to the other replicas and acks the client once a
+  majority of the replica row (itself included) applied. Reads are served
+  from local state (leader reads) except while the partition is *churned*
+  (this member was just promoted and has not finished its snapshot sync),
+  when they fall back to quorum reads: fan a quorum Get to the other
+  replicas and take the max-version answer among a majority -- which must
+  intersect any acked write's majority, preserving read-your-writes
+  through leader failover.
+- *replica*: applies replication Puts idempotently (only if the version is
+  newer than what it holds -- duplicated/reordered replication is a no-op)
+  and answers quorum Gets and partition-snapshot Gets from local state.
+
+Promotion protocol: when a new map makes this member leader of a partition
+it did not lead before, the partition is flagged churned and the engine
+pulls whole-partition snapshots (``Get.quorum == 2``) from the other
+replicas, merging per-key max-versions into its own state. Once a majority
+of the row (self included) contributed, every write acked under the old
+leader -- which lives on a majority that intersects the merged set -- is
+present, and the flag clears. Writes during the window answer RETRY (the
+sync is one round trip); reads take the quorum-read fallback.
+
+Durability rides the handoff plane: every mutation re-serializes the
+partition's KV map into the shared :class:`~..handoff.store.PartitionStore`
+via the canonical encoding in kv.py, so view-change state transfer moves
+serving data through the existing verified handoff sessions and replica
+fingerprints stay comparable across members.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..observability import (
+    SERVING_LATENCY_BUCKETS_MS,
+    Metrics,
+    NullMetrics,
+)
+from ..runtime.futures import Promise
+from ..runtime.lockdep import make_rlock
+from ..types import Endpoint, Get, Put, PutAck
+from .kv import decode_kv, encode_kv, partition_of
+
+DEFAULT_RETRY_LIMIT = 8
+DEFAULT_RETRY_DELAY_MS = 10
+
+
+class ServingEngine:
+    """Router, leader and replica halves of the serving protocol.
+    Thread-safe: handlers run on the protocol executor while replication
+    and routing promises complete on transport threads."""
+
+    def __init__(
+        self,
+        store,
+        address: Endpoint,
+        client,
+        scheduler,
+        *,
+        metrics: Optional[Metrics] = None,
+        tracer=None,
+        recorder=None,
+        retry_limit: int = DEFAULT_RETRY_LIMIT,
+        retry_delay_ms: int = DEFAULT_RETRY_DELAY_MS,
+    ) -> None:
+        if retry_limit <= 0:
+            raise ValueError(f"retry_limit must be positive: {retry_limit}")
+        self.store = store
+        self.address = address
+        self._client = client
+        self._scheduler = scheduler
+        self.metrics = metrics if metrics is not None else NullMetrics()
+        self._tracer = tracer
+        self._recorder = recorder
+        self.retry_limit = retry_limit
+        self.retry_delay_ms = retry_delay_ms
+        # reentrant: in-process transports complete send promises on the
+        # calling thread, so a reply callback can land while the issuing
+        # frame still holds the lock
+        self._lock = make_rlock("ServingEngine._lock")
+        self._map = None  # latest PlacementMap (None until first install)
+        # guarded-by: _lock -- decoded per-partition KV caches; the store
+        # blob stays authoritative (rewritten on every mutation)
+        self._kv: Dict[int, Dict[bytes, Tuple[int, bytes]]] = {}
+        # guarded-by: _lock -- partitions this member leads but has not
+        # finished promote-time snapshot sync for
+        self._churned: Set[int] = set()
+        self._next_request_id = 1
+        self._gets = 0
+        self._puts = 0
+        self._put_acks = 0
+
+    # -- introspection ---------------------------------------------------- #
+
+    def status(self) -> Tuple[int, int, int]:
+        """(gets served, puts served, replication acks received)."""
+        with self._lock:
+            return self._gets, self._puts, self._put_acks
+
+    def leader_digest(self) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        """Parallel (partition id, leader "host:port") arrays over the
+        partitions this member replicates -- the statusz cross-check input:
+        every member must name the same leader for a shared partition."""
+        with self._lock:
+            pmap = self._map
+            if pmap is None:
+                return (), ()
+            partitions: List[int] = []
+            leaders: List[str] = []
+            for p, row in enumerate(pmap.assignments):
+                if row and self.address in row:
+                    partitions.append(p)
+                    leaders.append(str(row[0]))
+            return tuple(partitions), tuple(leaders)
+
+    def churned_partitions(self) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._churned))
+
+    def _now(self) -> Optional[int]:
+        if self._scheduler is None:
+            return None
+        return self._scheduler.now_ms()
+
+    # -- placement tracking ----------------------------------------------- #
+
+    def update_map(self, pmap) -> None:
+        """Adopt a just-installed placement map: recompute leadership,
+        invalidate KV caches for partitions the handoff plane is about to
+        (re)deliver, and launch promote-time snapshot syncs for partitions
+        this member now leads. Runs on the protocol executor inside the
+        view-change path, after the handoff sessions launch."""
+        to_sync: List[Tuple[int, Tuple[Endpoint, ...], int, int]] = []
+        changes = 0
+        with self._lock:
+            old = self._map
+            self._map = pmap
+            for p, row in enumerate(pmap.assignments):
+                old_row: Tuple[Endpoint, ...] = ()
+                if old is not None and p < len(old.assignments):
+                    old_row = old.assignments[p]
+                old_leader = old_row[0] if old_row else None
+                if not row or self.address not in row:
+                    # no longer (or never) a replica: the handoff ack path
+                    # releases the store blob; drop the decoded cache too
+                    self._kv.pop(p, None)
+                    self._churned.discard(p)
+                    continue
+                if old is not None and self.address not in old_row:
+                    # newly acquired replica: the bytes arrive via a
+                    # verified handoff session into the store -- a stale
+                    # decoded cache would shadow them
+                    self._kv.pop(p, None)
+                leader = row[0]
+                if old is not None and old_leader != leader:
+                    changes += 1
+                if leader == self.address and old_leader != self.address:
+                    others = tuple(n for n in row if n != self.address)
+                    need = (len(row) // 2 + 1) - 1  # majority minus self
+                    if need <= 0 or not others:
+                        continue  # sole replica holds every acked write
+                    self._churned.add(p)
+                    to_sync.append((p, others, need, pmap.version))
+                elif leader != self.address:
+                    self._churned.discard(p)
+        if changes:
+            self.metrics.incr("serving.leader_changes", changes)
+            if self._tracer is not None:
+                self._tracer.event(
+                    "serving_leader_change", virtual_ms=self._now(),
+                    partitions=changes, version=pmap.version,
+                )
+            if self._recorder is not None:
+                self._recorder.record(
+                    "serving_leader_change", partitions=changes,
+                    version=pmap.version, churned=len(to_sync),
+                )
+        # sends outside the lock: in-process transports complete inline
+        for p, others, need, version in to_sync:
+            self._start_sync(p, others, need, version)
+
+    def _start_sync(self, p: int, others: Tuple[Endpoint, ...], need: int,
+                    version: int) -> None:
+        """Pull whole-partition snapshots from the other replicas and merge
+        per-key max-versions; the churn flag clears once a majority of the
+        row (self included) contributed."""
+        with self._lock:
+            if (
+                self._map is None or self._map.version != version
+                or p not in self._churned
+            ):
+                return  # superseded by a newer map (its own sync runs)
+        probe = Get(
+            sender=self.address, key=p.to_bytes(8, "little"), quorum=2,
+            map_version=version,
+        )
+        state = {"snaps": [], "replies": 0, "done": False}
+        for node in others:
+            promise = self._client.send_message(node, probe)
+            promise.add_callback(
+                lambda reply: self._on_snapshot(
+                    p, others, need, version, state, reply
+                )
+            )
+
+    def _on_snapshot(self, p: int, others: Tuple[Endpoint, ...], need: int,
+                     version: int, state: dict, promise) -> None:
+        exc = promise.exception()
+        reply = None if exc is not None else promise._result  # noqa: SLF001
+        retry = False
+        with self._lock:
+            if state["done"]:
+                return
+            if (
+                self._map is None or self._map.version != version
+                or p not in self._churned
+            ):
+                state["done"] = True
+                return
+            state["replies"] += 1
+            if (
+                exc is None and isinstance(reply, PutAck)
+                and reply.status == PutAck.STATUS_OK
+            ):
+                state["snaps"].append(decode_kv(reply.value))
+            if len(state["snaps"]) >= need:
+                state["done"] = True
+                kv = self._load_locked(p)
+                for snap in state["snaps"]:
+                    for key, (ver, val) in snap.items():
+                        if ver > kv.get(key, (0, b""))[0]:
+                            kv[key] = (ver, val)
+                self._persist_locked(p)
+                self._churned.discard(p)
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "serving_sync", partition=p, version=version,
+                        snapshots=len(state["snaps"]),
+                    )
+            elif state["replies"] >= len(others):
+                # not enough live snapshot answers this round; re-pull
+                # until a newer map supersedes this promotion
+                state["done"] = True
+                retry = True
+        if retry and self._scheduler is not None:
+            self._scheduler.schedule(
+                self.retry_delay_ms,
+                lambda: self._start_sync(p, others, need, version),
+            )
+
+    # -- local state ------------------------------------------------------ #
+
+    def _load_locked(self, p: int) -> Dict[bytes, Tuple[int, bytes]]:
+        kv = self._kv.get(p)
+        if kv is None:
+            kv = decode_kv(self.store.get(p))
+            self._kv[p] = kv
+        return kv
+
+    def _persist_locked(self, p: int) -> None:
+        # every mutation re-serializes canonically so replica fingerprints
+        # stay comparable and handoff always moves current bytes
+        self.store.put(p, encode_kv(self._kv[p]))
+
+    # -- server half: Get ------------------------------------------------- #
+
+    def handle_get(self, msg: Get) -> Promise:
+        quorum_read: Optional[Tuple[int, Tuple[Endpoint, ...], int]] = None
+        with self._lock:
+            self._gets += 1
+            self.metrics.incr("serving.gets")
+            pmap = self._map
+            if pmap is None:
+                return Promise.completed(self._retry_ack(msg.key, 0))
+            if msg.quorum == 2:
+                # whole-partition snapshot (promote-time sync source half):
+                # the key carries the partition id as 8 LE bytes
+                p = int.from_bytes(msg.key[:8], "little")
+                return Promise.completed(PutAck(
+                    sender=self.address, status=PutAck.STATUS_OK,
+                    key=msg.key, value=encode_kv(self._load_locked(p)),
+                    map_version=pmap.version,
+                ))
+            p = partition_of(msg.key, pmap.config.partitions)
+            kv = self._load_locked(p)
+            version, value = kv.get(msg.key, (0, b""))
+            found = msg.key in kv
+            if msg.quorum == 1:
+                # quorum-read member half: answer from local state
+                # regardless of leadership
+                return Promise.completed(PutAck(
+                    sender=self.address,
+                    status=(PutAck.STATUS_OK if found
+                            else PutAck.STATUS_NOT_FOUND),
+                    key=msg.key, value=value, version=version,
+                    map_version=pmap.version,
+                ))
+            row = pmap.assignments[p] if p < len(pmap.assignments) else ()
+            if not row or row[0] != self.address:
+                self.metrics.incr("serving.not_leader_redirects")
+                return Promise.completed(PutAck(
+                    sender=self.address, status=PutAck.STATUS_NOT_LEADER,
+                    key=msg.key, leader=row[0] if row else None,
+                    map_version=pmap.version,
+                ))
+            if p in self._churned:
+                # just promoted, snapshot sync still in flight: a local
+                # answer could miss writes acked by the previous leader --
+                # fall back to a quorum read
+                others = tuple(n for n in row if n != self.address)
+                need = (len(row) // 2 + 1) - 1
+                quorum_read = (p, others, need)
+            else:
+                self.metrics.incr("serving.leader_reads")
+                return Promise.completed(PutAck(
+                    sender=self.address,
+                    status=(PutAck.STATUS_OK if found
+                            else PutAck.STATUS_NOT_FOUND),
+                    key=msg.key, value=value, version=version,
+                    map_version=pmap.version,
+                ))
+        p, others, need = quorum_read
+        return self._quorum_read(msg.key, others, need, version, value, found)
+
+    def _quorum_read(self, key: bytes, others: Tuple[Endpoint, ...],
+                     need: int, version: int, value: bytes,
+                     found: bool) -> Promise:
+        """Fan a quorum Get to the other replicas; answer with the
+        max-version value once a majority of the row (local answer
+        included) responded. Any acked write's majority intersects ours,
+        so the max-version answer observes it."""
+        self.metrics.incr("serving.quorum_reads")
+        done: Promise = Promise()
+        if need <= 0 or not others:
+            done.set_result(self._read_ack(key, version, value, found))
+            return done
+        state = {
+            "version": version, "value": value, "found": found,
+            "answers": 0, "replies": 0, "done": False,
+        }
+        probe = Get(sender=self.address, key=key, quorum=1)
+        for node in others:
+            promise = self._client.send_message(node, probe)
+            promise.add_callback(
+                lambda reply: self._on_quorum_answer(
+                    key, need, len(others), state, done, reply
+                )
+            )
+        return done
+
+    def _on_quorum_answer(self, key: bytes, need: int, total: int,
+                          state: dict, done: Promise, promise) -> None:
+        exc = promise.exception()
+        reply = None if exc is not None else promise._result  # noqa: SLF001
+        ack: Optional[PutAck] = None
+        with self._lock:
+            if state["done"]:
+                return
+            state["replies"] += 1
+            if exc is None and isinstance(reply, PutAck) and reply.status in (
+                PutAck.STATUS_OK, PutAck.STATUS_NOT_FOUND,
+            ):
+                state["answers"] += 1
+                if (
+                    reply.status == PutAck.STATUS_OK
+                    and reply.version > state["version"]
+                ):
+                    state["version"] = reply.version
+                    state["value"] = reply.value
+                    state["found"] = True
+            if state["answers"] >= need:
+                state["done"] = True
+                ack = self._read_ack(
+                    key, state["version"], state["value"], state["found"]
+                )
+            elif state["replies"] >= total:
+                # not enough replica answers for a majority: the client
+                # retries against the (soon-synced) leader
+                state["done"] = True
+                ack = self._retry_ack(key, 0)
+        if ack is not None:
+            done.try_set_result(ack)
+
+    def _read_ack(self, key: bytes, version: int, value: bytes,
+                  found: bool) -> PutAck:
+        return PutAck(
+            sender=self.address,
+            status=PutAck.STATUS_OK if found else PutAck.STATUS_NOT_FOUND,
+            key=key, value=value, version=version,
+            map_version=self._map.version if self._map is not None else 0,
+        )
+
+    def _retry_ack(self, key: bytes, request_id: int) -> PutAck:
+        return PutAck(
+            sender=self.address, status=PutAck.STATUS_RETRY, key=key,
+            request_id=request_id,
+            map_version=self._map.version if self._map is not None else 0,
+        )
+
+    # -- server half: Put ------------------------------------------------- #
+
+    def handle_put(self, msg: Put) -> Promise:
+        with self._lock:
+            self._puts += 1
+            self.metrics.incr("serving.puts")
+            if msg.replicate:
+                return Promise.completed(self._apply_replica_locked(msg))
+            pmap = self._map
+            if pmap is None:
+                return Promise.completed(
+                    self._retry_ack(msg.key, msg.request_id)
+                )
+            p = partition_of(msg.key, pmap.config.partitions)
+            row = pmap.assignments[p] if p < len(pmap.assignments) else ()
+            if not row or row[0] != self.address:
+                self.metrics.incr("serving.not_leader_redirects")
+                return Promise.completed(PutAck(
+                    sender=self.address, status=PutAck.STATUS_NOT_LEADER,
+                    key=msg.key, request_id=msg.request_id,
+                    leader=row[0] if row else None,
+                    map_version=pmap.version,
+                ))
+            if p in self._churned:
+                # promote sync in flight: accepting the write now could
+                # assign a version the previous leader already used
+                return Promise.completed(
+                    self._retry_ack(msg.key, msg.request_id)
+                )
+            kv = self._load_locked(p)
+            version = kv.get(msg.key, (0, b""))[0] + 1
+            kv[msg.key] = (version, msg.value)
+            self._persist_locked(p)
+            others = tuple(n for n in row if n != self.address)
+            need = (len(row) // 2 + 1) - 1  # majority minus self-ack
+            ack = PutAck(
+                sender=self.address, status=PutAck.STATUS_OK, key=msg.key,
+                version=version, request_id=msg.request_id,
+                map_version=pmap.version,
+            )
+        if need <= 0:
+            return Promise.completed(ack)
+        done: Promise = Promise()
+        state = {"acks": 0, "replies": 0, "done": False}
+        replica_put = Put(
+            sender=self.address, key=msg.key, value=msg.value,
+            request_id=msg.request_id, replicate=1, version=ack.version,
+            map_version=ack.map_version,
+        )
+        # sends outside the lock; replies can complete inline
+        for node in others:
+            self.metrics.incr("serving.replication_writes")
+            promise = self._client.send_message(node, replica_put)
+            promise.add_callback(
+                lambda reply: self._on_replica_ack(
+                    need, len(others), state, done, ack, reply
+                )
+            )
+        return done
+
+    def _apply_replica_locked(self, msg: Put) -> PutAck:
+        """Replica half: apply iff the replicated version is newer than
+        what we hold -- duplicated, reordered or nemesis-replayed
+        replication converges to the same state."""
+        pmap = self._map
+        if pmap is None:
+            return self._retry_ack(msg.key, msg.request_id)
+        p = partition_of(msg.key, pmap.config.partitions)
+        kv = self._load_locked(p)
+        if msg.version > kv.get(msg.key, (0, b""))[0]:
+            kv[msg.key] = (msg.version, msg.value)
+            self._persist_locked(p)
+        return PutAck(
+            sender=self.address, status=PutAck.STATUS_OK, key=msg.key,
+            version=msg.version, request_id=msg.request_id,
+            map_version=pmap.version,
+        )
+
+    def _on_replica_ack(self, need: int, total: int, state: dict,
+                        done: Promise, ack: PutAck, promise) -> None:
+        exc = promise.exception()
+        reply = None if exc is not None else promise._result  # noqa: SLF001
+        final: Optional[PutAck] = None
+        with self._lock:
+            if state["done"]:
+                return
+            state["replies"] += 1
+            if (
+                exc is None and isinstance(reply, PutAck)
+                and reply.status == PutAck.STATUS_OK
+            ):
+                state["acks"] += 1
+                self._put_acks += 1
+                self.metrics.incr("serving.put_acks")
+            if state["acks"] >= need:
+                state["done"] = True
+                final = ack
+            elif state["replies"] >= total:
+                # quorum unreachable: the local apply stands but is not
+                # acknowledged -- the client must re-issue (PutAck docs)
+                state["done"] = True
+                self.metrics.incr("serving.put_retries")
+                final = replace(ack, status=PutAck.STATUS_RETRY)
+        if final is not None:
+            done.try_set_result(final)
+
+    # -- router half ------------------------------------------------------ #
+
+    def client_put(self, key: bytes, value: bytes) -> Promise:
+        """Write ``key`` through the partition leader; completes with the
+        final PutAck after routing redirects and bounded retries."""
+        return self._routed("put", key, value)
+
+    def client_get(self, key: bytes) -> Promise:
+        """Read ``key`` from the partition leader (quorum-read fallback is
+        the leader's, not the client's, decision)."""
+        return self._routed("get", key, b"")
+
+    def _routed(self, op: str, key: bytes, value: bytes) -> Promise:
+        with self._lock:
+            request_id = self._next_request_id
+            self._next_request_id += 1
+        done: Promise = Promise()
+        t0 = self._now()
+        span = None
+        if self._tracer is not None:
+            span = self._tracer.begin(
+                "serving_request", virtual_ms=t0, op=op,
+            )
+        self._attempt(op, key, value, request_id, 0, None, done, span, t0)
+        return done
+
+    def _attempt(self, op: str, key: bytes, value: bytes, request_id: int,
+                 attempt: int, hint: Optional[Endpoint], done: Promise,
+                 span, t0: Optional[int]) -> None:
+        with self._lock:
+            pmap = self._map
+            leader = hint
+            map_version = pmap.version if pmap is not None else 0
+            if leader is None and pmap is not None:
+                p = partition_of(key, pmap.config.partitions)
+                row = pmap.assignments[p] if p < len(pmap.assignments) else ()
+                leader = row[0] if row else None
+        if leader is None:
+            self._finish(done, span, t0, self._retry_ack(key, request_id))
+            return
+        if op == "put":
+            msg = Put(
+                sender=self.address, key=key, value=value,
+                request_id=request_id, map_version=map_version,
+            )
+        else:
+            msg = Get(
+                sender=self.address, key=key, quorum=0,
+                map_version=map_version,
+            )
+        if leader == self.address:
+            promise = (
+                self.handle_put(msg) if op == "put" else self.handle_get(msg)
+            )
+        else:
+            promise = self._client.send_message(leader, msg)
+        promise.add_callback(
+            lambda reply: self._on_routed_reply(
+                op, key, value, request_id, attempt, done, span, t0, reply
+            )
+        )
+
+    def _on_routed_reply(self, op: str, key: bytes, value: bytes,
+                         request_id: int, attempt: int, done: Promise,
+                         span, t0: Optional[int], promise) -> None:
+        exc = promise.exception()
+        reply = None if exc is not None else promise._result  # noqa: SLF001
+        hint: Optional[Endpoint] = None
+        retryable = (
+            exc is not None
+            or not isinstance(reply, PutAck)
+            or reply.status in (
+                PutAck.STATUS_NOT_LEADER, PutAck.STATUS_RETRY,
+            )
+        )
+        if retryable and attempt + 1 < self.retry_limit:
+            if (
+                isinstance(reply, PutAck)
+                and reply.status == PutAck.STATUS_NOT_LEADER
+            ):
+                hint = reply.leader  # follow once; next retry recomputes
+            if op == "put":
+                self.metrics.incr("serving.put_retries")
+            retry = lambda: self._attempt(  # noqa: E731
+                op, key, value, request_id, attempt + 1, hint, done, span, t0
+            )
+            if self._scheduler is not None:
+                self._scheduler.schedule(self.retry_delay_ms, retry)
+            else:
+                retry()
+            return
+        final = (
+            reply if isinstance(reply, PutAck)
+            else self._retry_ack(key, request_id)
+        )
+        self._finish(done, span, t0, final)
+
+    def _finish(self, done: Promise, span, t0: Optional[int],
+                ack: PutAck) -> None:
+        now = self._now()
+        if t0 is not None and now is not None:
+            self.metrics.observe(
+                "serving.request_ms", max(0, now - t0),
+                buckets=SERVING_LATENCY_BUCKETS_MS,
+            )
+        if self._tracer is not None and span is not None:
+            span.attrs["status"] = ack.status
+            self._tracer.end(span, virtual_ms=now)
+        done.try_set_result(ack)

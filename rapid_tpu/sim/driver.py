@@ -31,6 +31,7 @@ from ..observability import (
     HANDOFF_BYTES_BUCKETS,
     HANDOFF_CHUNKS_BUCKETS,
     PARTITIONS_MOVED_BUCKETS,
+    SERVING_LATENCY_BUCKETS_MS,
     FlightRecorder,
     Metrics,
     StableViewTimer,
@@ -247,6 +248,14 @@ class Simulator:  # guarded-by: sim-loop
         self._handoff_max_chunk_retries = 8
         self._handoff_nemesis = None
         self._handoff_transfers: List = []
+        # serving plane (opt-in via enable_serving; requires handoff -- the
+        # KV blobs live inside the handoff stores so view changes move them)
+        self._serving_enabled = False
+        self._serving_request_ms = 1
+        self._serving_nemesis = None
+        self._serving_cache: dict = {}  # (slot, partition) -> decoded KV map
+        self._serving_acked: dict = {}  # key -> (version, value) at ack time
+        self._serving_eps: dict = {}
         # membership-invariant element hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
@@ -748,6 +757,233 @@ class Simulator:  # guarded-by: sim-loop
         # billed strictly after view_installed: the stable-view timer has
         # already stamped this churn, so the bench pin cannot move
         self.virtual_ms += billed_ms
+
+    # ------------------------------------------------------------------ #
+    # Serving plane (serving/engine.py mirror)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def serving_enabled(self) -> bool:
+        return self._serving_enabled
+
+    @property
+    def serving_acked(self) -> dict:
+        """Oracle: every acknowledged write, key -> (version, value) as of
+        the ack. Zero-lost-writes checks read each key back and require a
+        version >= the oracle's."""
+        return dict(self._serving_acked)
+
+    def enable_serving(self, request_ms: int = 1, fault_plan=None) -> None:
+        """Attach the serving plane mirror: replicated Get/Put over the
+        handoff stores. KV state persists as the same deterministic
+        ``encode_kv`` blobs the live engine writes, INSIDE the handoff
+        stores -- so every view change moves serving data through the
+        verified handoff sessions for free, exactly like the live plane.
+
+        Each client op bills ``request_ms`` of virtual time (one leader
+        round trip); a dead leader costs one extra hop (redirect) and
+        reads fall back to quorum reads until the next view installs.
+        ``fault_plan`` makes replication writes suffer deterministic
+        drops/duplicates/delays; a write only acks with a majority."""
+        from ..serving.kv import encode_kv
+
+        if self._handoff_stores is None:
+            raise RuntimeError("enable_handoff must run before enable_serving")
+        if fault_plan is not None:
+            from ..faults import Nemesis
+
+            class _VirtualClock:
+                def __init__(self, sim: "Simulator") -> None:
+                    self._sim = sim
+
+                def now_ms(self) -> int:
+                    return self._sim.virtual_ms
+
+            self._serving_nemesis = Nemesis(
+                fault_plan, _VirtualClock(self), metrics=self.metrics
+            ).arm()
+        else:
+            self._serving_nemesis = None
+        self._serving_request_ms = int(request_ms)
+        # replace the synthetic handoff payloads with empty KV blobs: from
+        # here on the stores hold serving data, and fingerprints still
+        # agree across replicas because encode_kv is deterministic
+        empty = encode_kv({})
+        for store in self._handoff_stores.values():
+            for p in store.partitions():
+                store.put(p, empty)
+        self._serving_cache = {}
+        self._serving_acked = {}
+        self._serving_eps = {}
+        self._serving_enabled = True
+
+    def _serving_ep(self, slot: int):
+        from ..types import Endpoint
+
+        cached = self._serving_eps.get(slot)
+        if cached is None:
+            host, port = self.endpoint_of(slot)
+            cached = self._serving_eps[slot] = Endpoint(
+                hostname=host, port=port
+            )
+        return cached
+
+    def _serving_kv(self, slot: int, p: int) -> dict:
+        from ..serving.kv import decode_kv
+
+        kv = self._serving_cache.get((slot, p))
+        if kv is None:
+            kv = decode_kv(self._handoff_stores[slot].get(p))
+            self._serving_cache[(slot, p)] = kv
+        return kv
+
+    def _serving_persist(self, slot: int, p: int, kv: dict) -> None:
+        from ..serving.kv import encode_kv
+
+        self._handoff_stores[slot].put(p, encode_kv(kv))
+
+    def _serving_reconcile(self, old_assign) -> None:
+        """Anti-entropy at the view-change boundary, BEFORE handoff runs:
+        merge each partition's KV map (max version per key) across its live
+        old-row replicas and persist the merged blob back to each of them.
+
+        Any acked write reached a majority of the old row, so as long as
+        only a minority crashed at least one live replica still holds it;
+        after the merge EVERY live replica holds it, and handoff then
+        propagates complete blobs to the new owners no matter which source
+        replica it happens to copy from. Without this step a new leader
+        whose replication Put was dropped would serve a stale local copy
+        -- an acked write silently lost."""
+        for p in range(old_assign.shape[0]):
+            live = [
+                int(s) for s in old_assign[p] if s >= 0 and self.alive[int(s)]
+            ]
+            if len(live) < 2:
+                continue
+            merged: dict = {}
+            for s in live:
+                for key, (version, value) in self._serving_kv(s, p).items():
+                    cur = merged.get(key)
+                    if cur is None or version > cur[0]:
+                        merged[key] = (version, value)
+            for s in live:
+                if self._serving_kv(s, p) != merged:
+                    self.metrics.incr("serving.reconciled_replicas")
+                    self._serving_cache[(s, p)] = dict(merged)
+                    self._serving_persist(s, p, merged)
+
+    def _serving_row(self, key: bytes):
+        from ..serving.kv import partition_of
+
+        p = partition_of(key, self._placement.config.partitions)
+        row = [int(s) for s in self._placement.assign[p] if s >= 0]
+        live = [s for s in row if self.alive[s]]
+        return p, row, live
+
+    def serving_put(self, key: bytes, value: bytes):
+        """One closed-loop client write: route to the first live replica in
+        placement order, replicate to the row, ack on majority. Returns a
+        PutAck (STATUS_OK or STATUS_RETRY)."""
+        from ..types import Put, PutAck
+
+        if not self._serving_enabled:
+            raise RuntimeError("serving is not enabled on this simulator")
+        self.metrics.incr("serving.puts")
+        t0 = self.virtual_ms
+        self.virtual_ms += self._serving_request_ms
+        p, row, live = self._serving_row(key)
+        majority = len(row) // 2 + 1
+        status = PutAck.STATUS_RETRY
+        version = 0
+        if live:
+            leader = live[0]
+            if row[0] != leader:
+                # the map still names a dead leader: one redirect hop
+                self.metrics.incr("serving.not_leader_redirects")
+                self.virtual_ms += self._serving_request_ms
+            kv = self._serving_kv(leader, p)
+            version = kv.get(key, (0, b""))[0] + 1
+            msg = Put(
+                sender=self._serving_ep(leader), key=key, value=value,
+                request_id=0, replicate=1, version=version,
+            )
+            acks = 0
+            for slot in row:
+                if not self.alive[slot]:
+                    continue
+                if slot != leader and self._serving_nemesis is not None:
+                    decision = self._serving_nemesis.decide(
+                        self._serving_ep(slot), self._serving_ep(leader),
+                        msg, "egress",
+                    )
+                    self.virtual_ms += decision.delay_ms
+                    if decision.drop:
+                        continue
+                skv = kv if slot == leader else self._serving_kv(slot, p)
+                if version > skv.get(key, (0, b""))[0]:
+                    skv[key] = (version, value)
+                    self._serving_persist(slot, p, skv)
+                acks += 1
+                if slot != leader:
+                    self.metrics.incr("serving.replication_writes")
+                    self.metrics.incr("serving.put_acks")
+            if acks >= majority:
+                status = PutAck.STATUS_OK
+                self._serving_acked[key] = (version, value)
+            else:
+                self.metrics.incr("serving.put_retries")
+        else:
+            self.metrics.incr("serving.put_retries")
+        self.metrics.observe(
+            "serving.request_ms", float(self.virtual_ms - t0),
+            buckets=SERVING_LATENCY_BUCKETS_MS,
+        )
+        return PutAck(
+            sender=self._serving_ep(row[0]) if row else None,
+            status=status, key=key, version=version,
+        )
+
+    def serving_get(self, key: bytes):
+        """One closed-loop client read: leader read while the placement
+        leader is alive, quorum read (max version across a live majority)
+        during the churn window. Returns a PutAck."""
+        from ..types import PutAck
+
+        if not self._serving_enabled:
+            raise RuntimeError("serving is not enabled on this simulator")
+        self.metrics.incr("serving.gets")
+        t0 = self.virtual_ms
+        self.virtual_ms += self._serving_request_ms
+        p, row, live = self._serving_row(key)
+        majority = len(row) // 2 + 1
+        status = PutAck.STATUS_RETRY
+        version = 0
+        value = b""
+        if live and self.alive[row[0]]:
+            self.metrics.incr("serving.leader_reads")
+            version, value = self._serving_kv(row[0], p).get(key, (0, b""))
+            status = PutAck.STATUS_OK if version else PutAck.STATUS_NOT_FOUND
+        elif live:
+            # leader churn: redirect hop + quorum read across live replicas
+            self.metrics.incr("serving.not_leader_redirects")
+            self.metrics.incr("serving.quorum_reads")
+            self.virtual_ms += self._serving_request_ms
+            if len(live) >= majority:
+                for slot in live:
+                    v, blob = self._serving_kv(slot, p).get(key, (0, b""))
+                    if v > version:
+                        version, value = v, blob
+                status = (
+                    PutAck.STATUS_OK if version else PutAck.STATUS_NOT_FOUND
+                )
+        self.metrics.observe(
+            "serving.request_ms", float(self.virtual_ms - t0),
+            buckets=SERVING_LATENCY_BUCKETS_MS,
+        )
+        return PutAck(
+            sender=self._serving_ep(row[0]) if row else None,
+            status=status, key=key, value=value, version=version,
+        )
 
     def one_way_ingress_partition(self, node_ids: np.ndarray) -> None:
         """Asymmetric failure: probes TO these nodes are lost, their own
@@ -1490,12 +1726,28 @@ class Simulator:  # guarded-by: sim-loop
                 moved=diff.moved, version=self._placement.version,
             )
             if old_assign is not None:
+                if self._serving_enabled:
+                    # before blobs move: make every live old-row replica
+                    # hold the union of acked writes, so handoff ships
+                    # complete content whichever source it copies from
+                    self._serving_reconcile(old_assign)
                 self.recorder.record(
                     "handoff_started",
                     configuration_id=record.configuration_id,
                     version=self._placement.version,
                 )
                 self._run_handoff(old_assign, p_span)
+                if self._serving_enabled:
+                    # handoff just copied/released blobs between stores:
+                    # every cached decode may be stale, and new leaders per
+                    # partition come straight from the fresh assign rows
+                    self._serving_cache = {}
+                    self.metrics.incr(
+                        "serving.leader_changes",
+                        int(np.count_nonzero(
+                            old_assign[:, 0] != self._placement.assign[:, 0]
+                        )),
+                    )
         vc_span.attrs.update(
             cut=len(record.cut), added=len(record.added),
             removed=len(record.removed),

@@ -337,6 +337,15 @@ class ClusterStatusResponse:
     handoff_failed: int = 0
     handoff_partitions: Tuple[int, ...] = ()
     handoff_fingerprints: Tuple[int, ...] = ()
+    # serving plane (0/absent when serving is not enabled): request counters
+    # plus a parallel (partition id, leader "host:port") digest over the
+    # partitions this member holds a replica of, so an operator tool can
+    # cross-check that every replica of a partition agrees on its leader
+    serving_gets: int = 0
+    serving_puts: int = 0
+    serving_put_acks: int = 0
+    serving_partitions: Tuple[int, ...] = ()
+    serving_leaders: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -392,6 +401,74 @@ class HandoffAck:
     session_id: int
     partition: int
     fingerprint: int = 0
+    map_version: int = 0
+
+
+@dataclass(frozen=True)
+class Get:
+    """Serving-plane read for one key, answered with a PutAck.
+
+    Routed by the client to the partition leader (first live replica in
+    placement order). ``quorum`` != 0 asks a replica to answer from its
+    local store regardless of leadership -- the read-your-writes fallback
+    fans a quorum Get to every replica and takes the max-version answer
+    among a majority, which must intersect any acked write's quorum.
+    ``map_version`` is the placement version the client routed against, so
+    a stale-map request can be redirected. Not in rapid.proto's reference
+    surface; a rapid-tpu extension (msgpack tag 22, request oneof 14)."""
+
+    sender: Endpoint
+    key: bytes
+    quorum: int = 0
+    map_version: int = 0
+
+
+@dataclass(frozen=True)
+class Put:
+    """Serving-plane write for one key, answered with a PutAck.
+
+    A client Put (``replicate`` == 0) goes to the partition leader, which
+    assigns the key's next monotonic version, applies locally, and fans
+    replication Puts (``replicate`` != 0, ``version`` set) to the other
+    replicas; it acks the client once a majority of the replica row
+    (itself included) has applied. Replicas apply a replicated Put only if
+    its version is newer than what they hold, so duplicated or reordered
+    replication is idempotent. ``request_id`` echoes back in the ack for
+    client-side correlation. Msgpack tag 23, request oneof 16 (15 stays
+    reserved for the traceCtx envelope field)."""
+
+    sender: Endpoint
+    key: bytes
+    value: bytes = b""
+    request_id: int = 0
+    replicate: int = 0
+    version: int = 0
+    map_version: int = 0
+
+
+@dataclass(frozen=True)
+class PutAck:
+    """The serving plane's unified reply to both Get and Put.
+
+    ``status`` OK carries the value+version for Gets and the assigned
+    version for Puts; NOT_LEADER carries a ``leader`` hint so the client
+    can re-route after churn; NOT_FOUND is a miss on an OK read path;
+    RETRY means the leader could not assemble a write quorum before its
+    deadline (the write may or may not survive -- the client must re-issue
+    with the same key to learn which). Msgpack tag 24, response oneof 7."""
+
+    STATUS_OK = 0
+    STATUS_NOT_LEADER = 1
+    STATUS_NOT_FOUND = 2
+    STATUS_RETRY = 3
+
+    sender: Endpoint
+    status: int = 0
+    key: bytes = b""
+    value: bytes = b""
+    version: int = 0
+    request_id: int = 0
+    leader: Optional[Endpoint] = None
     map_version: int = 0
 
 

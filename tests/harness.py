@@ -66,7 +66,8 @@ class ClusterHarness:
                  metadata: Optional[Dict[str, bytes]] = None,
                  subscriptions=None,
                  placement: Optional[Dict[str, int]] = None,
-                 handoff=None) -> ClusterBuilder:
+                 handoff=None,
+                 serving: bool = False) -> ClusterBuilder:
         server = InProcessServer(addr, self.network)
         self.servers[addr] = server
         client = InProcessClient(addr, self.network, self.settings)
@@ -101,6 +102,8 @@ class ClusterHarness:
             # a PartitionStore instance, or a factory called per node
             store = handoff() if callable(handoff) else handoff
             builder.use_handoff(store)
+        if serving:
+            builder.use_serving()
         for event, cb in subscriptions or []:
             builder.add_subscription(event, cb)
         return builder

@@ -306,6 +306,121 @@ def test_bench_sweep_entries_carry_jit_stats(monkeypatch):
         assert entry["jit_compiles_steady"] == 0
 
 
+def test_serving_dimension_json_contract(monkeypatch, capsys):
+    """The serving_qps entry of the one JSON line carries, for every
+    measurement window (steady / view_change_window / post_view), the p99
+    and the full latency histogram on the declared bucket ladder -- the
+    harness plots the view-change latency spike straight from the
+    artifact. Run at a reduced scale so the contract check stays cheap."""
+    from rapid_tpu.observability import SERVING_LATENCY_BUCKETS_MS
+
+    monkeypatch.setattr(bench, "SERVING_N_NODES", 16)
+    monkeypatch.setattr(bench, "SERVING_PARTITIONS", 32)
+    monkeypatch.setattr(bench, "SERVING_KEYS", 12)
+    monkeypatch.setattr(
+        bench, "SERVING_OPS",
+        {"steady": 40, "view_change_window": 20, "post_view": 20},
+    )
+    entry = bench.run_serving_dimension(seed=3)
+    assert entry["lost_acked_writes"] == 0
+    assert entry["throughput_qps"] > 0
+    ladder = [str(b) for b in SERVING_LATENCY_BUCKETS_MS] + ["inf"]
+    for window, ops in (("steady", 40), ("view_change_window", 20),
+                        ("post_view", 20)):
+        stats = entry[window]
+        assert stats["count"] == ops
+        assert stats["p99_ms"] is not None and stats["p99_ms"] >= stats["p50_ms"]
+        hist = stats["latency_hist_ms"]
+        assert list(hist) == ladder
+        counts = list(hist.values())
+        assert counts == sorted(counts)  # cumulative buckets
+        assert hist["inf"] == ops
+    # and the emitter folds the entry into the artifact line verbatim
+    bench._emit_json(
+        {"value": 120.0, "virtual_ms": 11_100}, "cpu", []
+    )
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["serving_qps"] == entry
+
+
+def test_serving_sim_steady_state_compiles_zero(monkeypatch):
+    """With the serving plane enabled, a warmed crash->decision loop plus
+    client traffic must not compile anything new: serving ops are host-side
+    bookkeeping over the handoff stores and must not perturb the device
+    program (no new jit cache keys in steady state)."""
+    import numpy as np
+
+    from rapid_tpu.runtime import jitwatch
+    from rapid_tpu.sim.driver import Simulator
+
+    monkeypatch.setenv("RAPID_JITWATCH", "1")
+
+    def run():
+        sim = Simulator(64, seed=5)
+        sim.ready()
+        sim.enable_placement(partitions=64)
+        sim.enable_handoff()
+        sim.enable_serving()
+        for i in range(8):
+            ack = sim.serving_put(b"jw-%02d" % i, b"x")
+            assert ack.status == ack.STATUS_OK
+        sim.crash(np.array([3]))
+        record = sim.run_until_decision(max_rounds=40)
+        assert record is not None
+        for i in range(8):
+            sim.serving_get(b"jw-%02d" % i)
+
+    run()  # warmup: every compile belongs here
+    before = jitwatch.compile_count()
+    run()
+    assert jitwatch.compile_count() == before, (
+        f"serving steady state recompiled: "
+        f"{jitwatch.compile_events()[before:]}"
+    )
+
+
+def test_serving_overhead_within_budget():
+    """enable_serving must not tax the membership protocol itself: the
+    warmed crash->decision loop with the serving plane attached (stores
+    preloaded, reconcile + cache invalidation running at the view change)
+    stays within the same envelope as placement+handoff alone."""
+    import sys
+    import time
+
+    import numpy as np
+
+    from rapid_tpu.sim.driver import Simulator
+
+    traced = sys.gettrace() is not None
+
+    def best_of(serving, runs=5):
+        best = float("inf")
+        for _ in range(runs):
+            sim = Simulator(64, seed=5)
+            sim.ready()
+            sim.enable_placement(partitions=64)
+            sim.enable_handoff()
+            if serving:
+                sim.enable_serving()
+                for i in range(16):
+                    sim.serving_put(b"ovh-%02d" % i, b"x")
+            sim.crash(np.array([3]))
+            t0 = time.perf_counter()
+            record = sim.run_until_decision(max_rounds=40)
+            best = min(best, time.perf_counter() - t0)
+            assert record is not None
+        return best
+
+    best_of(True, runs=1)  # jit warmup, shapes shared by both sides
+    plain = best_of(False)
+    with_serving = best_of(True)
+    slack = 0.25 if traced else 0.05
+    assert with_serving <= plain * 1.10 + slack, (
+        f"serving overhead: with={with_serving * 1e3:.1f}ms "
+        f"without={plain * 1e3:.1f}ms"
+    )
+
+
 def test_jitwatch_overhead_within_budget(monkeypatch):
     """RAPID_JITWATCH=1 is on for the whole tier-1 battery (conftest), so the
     make_jit wrapper must be cheap enough to leave the bench contract intact:

@@ -356,14 +356,21 @@ def check_wire_tags() -> list[Finding]:
     message uses each field number and name once; each oneof's numbers are
     unique AND contiguous from 1 (so a new message -- e.g. the handoff
     messages after ClusterStatus -- must take the next number, never a gap
-    or a reuse); no oneof number collides with TRACE_CTX_FIELD_NUMBER,
-    which rides outside the oneof on the same envelopes."""
+    or a reuse), EXCEPT that the request oneof may skip
+    TRACE_CTX_FIELD_NUMBER, which rides outside the oneof on the same
+    envelope and whose number is therefore reserved; no oneof number
+    collides with it outright. Msgpack-side: no dataclass field of any
+    codec-carried message may start with ``__`` -- decode strips every
+    ``__``-prefixed top-level key as an envelope extension, so such a
+    field would silently vanish on the wire."""
     findings: list[Finding] = []
     msg_dir = REPO / "rapid_tpu" / "messaging"
     codec_path = msg_dir / "codec.py"
     schema_path = msg_dir / "wire_schema.py"
+    types_path = REPO / "rapid_tpu" / "types.py"
 
     tree = ast.parse(codec_path.read_text(), filename=str(codec_path))
+    codec_type_names: set = set()
     for node in tree.body:
         if isinstance(node, ast.Assign):
             targets = node.targets
@@ -393,11 +400,35 @@ def check_wire_tags() -> list[Finding]:
                         f"and {i}; duplicates make encoding ambiguous",
                     ))
                 seen[name] = i
+            codec_type_names = set(seen)
             break
     else:
         findings.append(Finding(
             codec_path, 0, "wire-tags", "codec._TYPES not found"
         ))
+
+    # msgpack reserved-key collision: the codec encodes each message as a
+    # dict keyed by dataclass field names and decode() strips every
+    # "__"-prefixed top-level key (envelope extensions like "__tc"), so a
+    # codec-carried dataclass field named "__anything" would be silently
+    # dropped by every decoder
+    types_tree = ast.parse(types_path.read_text(), filename=str(types_path))
+    for node in types_tree.body:
+        if not (isinstance(node, ast.ClassDef)
+                and node.name in codec_type_names):
+            continue
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+                and stmt.target.id.startswith("__")
+            ):
+                findings.append(Finding(
+                    types_path, stmt.lineno, "wire-tags",
+                    f"{node.name}.{stmt.target.id} collides with the "
+                    "codec's reserved '__' envelope-key namespace: decoders "
+                    "strip it, so the field never survives the wire",
+                ))
 
     wanted = {"_MESSAGES", "_REQUEST_ONEOF", "_RESPONSE_ONEOF",
               "TRACE_CTX_FIELD_NUMBER"}
@@ -442,11 +473,24 @@ def check_wire_tags() -> list[Finding]:
                 schema_path, line, "wire-tags",
                 f"{oneof_name} reuses a field number: {sorted(numbers)}",
             ))
-        if sorted(numbers) != list(range(1, len(numbers) + 1)):
+        # contiguity from 1, with one documented exception: the request
+        # oneof skips TRACE_CTX_FIELD_NUMBER (it rides outside the oneof on
+        # the same envelope, so its number is reserved, not free)
+        expected = list(range(1, len(numbers) + 1))
+        if (
+            oneof_name == "_REQUEST_ONEOF"
+            and trace_number is not None
+            and trace_number <= len(numbers)
+        ):
+            expected = [
+                n for n in range(1, len(numbers) + 2) if n != trace_number
+            ]
+        if sorted(numbers) != expected:
             findings.append(Finding(
                 schema_path, line, "wire-tags",
                 f"{oneof_name} numbers {sorted(numbers)} are not contiguous "
-                "from 1; new messages must take the next free number",
+                "from 1 (modulo the reserved traceCtx number); new messages "
+                "must take the next free number",
             ))
         if trace_number is not None and trace_number in numbers:
             findings.append(Finding(

@@ -71,6 +71,14 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
             f" failed={status.handoff_failed}"
             f" stored={len(status.handoff_partitions)}"
         )
+    if status.serving_gets or status.serving_puts or status.serving_partitions:
+        lines.append(
+            f"  serving: gets={status.serving_gets}"
+            f" puts={status.serving_puts}"
+            f" acks={status.serving_put_acks}"
+            f" leads={sum(1 for lead in status.serving_leaders if lead == str(status.sender))}"
+            f"/{len(status.serving_partitions)}"
+        )
     for name, value in zip(status.metric_names, status.metric_values):
         lines.append(f"  metric {name} = {value}")
     tail = status.journal[-journal_lines:] if journal_lines else ()
@@ -112,6 +120,15 @@ def to_json(status: ClusterStatusResponse) -> dict:
                 status.handoff_partitions, status.handoff_fingerprints
             )
         },
+        "serving_gets": status.serving_gets,
+        "serving_puts": status.serving_puts,
+        "serving_put_acks": status.serving_put_acks,
+        "serving_leaders": {
+            str(p): leader
+            for p, leader in zip(
+                status.serving_partitions, status.serving_leaders
+            )
+        },
         "metrics": dict(zip(status.metric_names, status.metric_values)),
         "journal": [json.loads(line) for line in status.journal],
     }
@@ -135,6 +152,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     placements = set()
     # partition id -> set of content fingerprints reported by its holders
     fingerprints: dict = {}
+    # partition id -> set of serving leaders reported by its replicas
+    leaders: dict = {}
     try:
         for raw in args.targets:
             target = Endpoint.from_string(raw)
@@ -151,6 +170,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 status.handoff_partitions, status.handoff_fingerprints
             ):
                 fingerprints.setdefault(part, set()).add(fp)
+            for part, leader in zip(
+                status.serving_partitions, status.serving_leaders
+            ):
+                leaders.setdefault(part, set()).add(leader)
             if args.as_json:
                 print(json.dumps(to_json(status), sort_keys=True))
             else:
@@ -182,6 +205,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             "WARNING: replicas disagree on partition content fingerprints: "
             f"partitions {torn}",
+            file=sys.stderr,
+        )
+        rc = max(rc, 2)
+    # the serving leader is a pure function of the placement row (first
+    # live replica in placement order), so two replicas of one partition
+    # naming different leaders is a split-brain write path: both would
+    # accept quorum writes for the same keys
+    split = sorted(p for p, who in leaders.items() if len(who) > 1)
+    if split:
+        print(
+            "WARNING: replicas disagree on the serving leader: "
+            f"partitions {split}",
             file=sys.stderr,
         )
         rc = max(rc, 2)
