@@ -15,22 +15,47 @@ the protocol at once:
   majority of the replica row (itself included) applied. Reads are served
   from local state (leader reads) except while the partition is *churned*
   (this member was just promoted and has not finished its snapshot sync),
-  when they fall back to quorum reads: fan a quorum Get to the other
-  replicas and take the max-version answer among a majority -- which must
-  intersect any acked write's majority, preserving read-your-writes
-  through leader failover.
+  when they fall back to quorum reads: fan a quorum Get over the
+  partition's PREVIOUS row and take the max-version answer among a
+  majority of it -- which must intersect any acked write's majority,
+  preserving read-your-writes through leader failover.
 - *replica*: applies replication Puts idempotently (only if the version is
   newer than what it holds -- duplicated/reordered replication is a no-op)
   and answers quorum Gets and partition-snapshot Gets from local state.
 
 Promotion protocol: when a new map makes this member leader of a partition
 it did not lead before, the partition is flagged churned and the engine
-pulls whole-partition snapshots (``Get.quorum == 2``) from the other
-replicas, merging per-key max-versions into its own state. Once a majority
-of the row (self included) contributed, every write acked under the old
-leader -- which lives on a majority that intersects the merged set -- is
-present, and the flag clears. Writes during the window answer RETRY (the
-sync is one round trip); reads take the quorum-read fallback.
+pulls whole-partition snapshots (``Get.quorum == 2``) from the replicas of
+the partition's *previous* row -- the row whose majority acked every
+pre-view write -- merging per-key max-versions into its own state. Once a
+majority of the OLD row (self included, if it was a member) contributed,
+every write acked under the old leader is present by quorum intersection,
+and the flag clears. Pulling from the new row would be unsound: a replica
+that just acquired the partition holds nothing (or a single-source handoff
+copy) and its empty answer must not count toward the majority. Members
+that dropped the partition in the new map keep their final blob for one
+view (``_retired``) so they can still answer these probes after the
+handoff ack releases the store entry; members whose own acquisition is
+still in flight answer RETRY instead of an empty snapshot. Writes during
+the window answer RETRY (the sync is one round trip); reads take the
+quorum-read fallback, which fans over the same old row with the same
+old-majority count for the same intersection argument.
+
+Map-install skew: replicas reject replication Puts stamped with a map
+version other than their installed one, so a deposed leader that has not
+yet installed the new map cannot assemble a quorum for writes the new
+row would never inherit -- it answers RETRY to its client instead of a
+false OK. (Versions are fingerprints, so equality is the only comparison;
+a leader ahead of a lagging replica also collects RETRYs until the
+replica installs, which client retries absorb.)
+
+Known limitation: the merge makes the NEW LEADER complete, but does not
+re-replicate the merged state across the new row. A sequence of view
+changes that replaces a row's membership faster than writes refresh it
+can leave pre-merge writes on fewer than a majority of the latest row;
+the placement plane's incremental rendezvous moves make this window
+narrow, and the statusz fingerprint cross-check surfaces divergence, but
+a full reconfiguration protocol (ROADMAP) is the real fix.
 
 Durability rides the handoff plane: every mutation re-serializes the
 partition's KV map into the shared :class:`~..handoff.store.PartitionStore`
@@ -96,8 +121,18 @@ class ServingEngine:
         # blob stays authoritative (rewritten on every mutation)
         self._kv: Dict[int, Dict[bytes, Tuple[int, bytes]]] = {}
         # guarded-by: _lock -- partitions this member leads but has not
-        # finished promote-time snapshot sync for
-        self._churned: Set[int] = set()
+        # finished promote-time snapshot sync for, mapped to the sync
+        # quorum: (old-row members to pull from, answers required)
+        self._churned: Dict[int, Tuple[Tuple[Endpoint, ...], int]] = {}
+        # guarded-by: _lock -- final blobs of partitions this member
+        # dropped at the current map, kept one view so peers promoted over
+        # the old row can still pull them after the handoff ack releases
+        # the store entry: partition -> (map version at retirement, blob)
+        self._retired: Dict[int, Tuple[int, bytes]] = {}
+        # guarded-by: _lock -- partitions acquired at the current map whose
+        # handoff delivery may still be in flight; until the store holds
+        # bytes for them, this member has nothing authoritative to answer
+        self._acquired: Set[int] = set()
         self._next_request_id = 1
         self._gets = 0
         self._puts = 0
@@ -148,34 +183,75 @@ class ServingEngine:
         with self._lock:
             old = self._map
             self._map = pmap
+            # retired blobs outlive their partition by exactly one view:
+            # entries saved at the map we are now replacing may still feed
+            # peers whose promote-time sync runs against that map
+            if old is not None:
+                self._retired = {
+                    q: entry for q, entry in self._retired.items()
+                    if entry[0] == old.version
+                }
             for p, row in enumerate(pmap.assignments):
                 old_row: Tuple[Endpoint, ...] = ()
                 if old is not None and p < len(old.assignments):
                     old_row = old.assignments[p]
                 old_leader = old_row[0] if old_row else None
                 if not row or self.address not in row:
-                    # no longer (or never) a replica: the handoff ack path
-                    # releases the store blob; drop the decoded cache too
+                    if self.address in old_row:
+                        # retiring replica: the handoff ack path will
+                        # release the store blob; keep the bytes one view
+                        # so syncs against the old row can still pull them
+                        blob = self.store.get(p)
+                        self._retired[p] = (
+                            pmap.version, blob if blob is not None else b""
+                        )
                     self._kv.pop(p, None)
-                    self._churned.discard(p)
+                    self._churned.pop(p, None)
+                    self._acquired.discard(p)
                     continue
-                if old is not None and self.address not in old_row:
+                self._retired.pop(p, None)
+                if old is None or self.address not in old_row:
                     # newly acquired replica: the bytes arrive via a
                     # verified handoff session into the store -- a stale
-                    # decoded cache would shadow them
+                    # decoded cache would shadow them, and until they land
+                    # this member has nothing authoritative to answer
                     self._kv.pop(p, None)
+                    self._acquired.add(p)
+                else:
+                    self._acquired.discard(p)
                 leader = row[0]
                 if old is not None and old_leader != leader:
                     changes += 1
-                if leader == self.address and old_leader != self.address:
-                    others = tuple(n for n in row if n != self.address)
-                    need = (len(row) // 2 + 1) - 1  # majority minus self
+                if leader == self.address and (
+                    old_leader != self.address or p in self._churned
+                ):
+                    # promoted (or still mid-sync from the previous
+                    # promotion, whose pull this map just superseded):
+                    # sync against the OLD row, whose majority acked every
+                    # pre-view write. Pulling from the new row would count
+                    # empty just-acquired replicas toward the quorum.
+                    if old_row:
+                        others = tuple(
+                            n for n in old_row if n != self.address
+                        )
+                        need = (len(old_row) // 2 + 1) - (
+                            1 if self.address in old_row else 0
+                        )
+                    else:
+                        # first map this member sees: the old row is
+                        # unknowable, so best-effort sync against the new
+                        # row -- responders still answer RETRY until their
+                        # own acquisition lands, so empty co-acquirers
+                        # cannot satisfy the count
+                        others = tuple(n for n in row if n != self.address)
+                        need = (len(row) // 2 + 1) - 1
                     if need <= 0 or not others:
+                        self._churned.pop(p, None)
                         continue  # sole replica holds every acked write
-                    self._churned.add(p)
+                    self._churned[p] = (others, need)
                     to_sync.append((p, others, need, pmap.version))
                 elif leader != self.address:
-                    self._churned.discard(p)
+                    self._churned.pop(p, None)
         if changes:
             self.metrics.incr("serving.leader_changes", changes)
             if self._tracer is not None:
@@ -244,7 +320,7 @@ class ServingEngine:
                         if ver > kv.get(key, (0, b""))[0]:
                             kv[key] = (ver, val)
                 self._persist_locked(p)
-                self._churned.discard(p)
+                self._churned.pop(p, None)
                 if self._recorder is not None:
                     self._recorder.record(
                         "serving_sync", partition=p, version=version,
@@ -255,11 +331,17 @@ class ServingEngine:
                 # until a newer map supersedes this promotion
                 state["done"] = True
                 retry = True
-        if retry and self._scheduler is not None:
-            self._scheduler.schedule(
-                self.retry_delay_ms,
-                lambda: self._start_sync(p, others, need, version),
-            )
+        if retry:
+            if self._scheduler is not None:
+                self._scheduler.schedule(
+                    self.retry_delay_ms,
+                    lambda: self._start_sync(p, others, need, version),
+                )
+            else:
+                # no scheduler to defer to: retry inline (mirroring
+                # _on_routed_reply), otherwise the partition would stay
+                # churned forever and every Put would answer RETRY
+                self._start_sync(p, others, need, version)
 
     # -- local state ------------------------------------------------------ #
 
@@ -275,6 +357,39 @@ class ServingEngine:
         # stay comparable and handoff always moves current bytes
         self.store.put(p, encode_kv(self._kv[p]))
 
+    def _snapshot_blob_locked(self, p: int) -> Optional[bytes]:
+        """Bytes this member may contribute to a peer's promote-time sync,
+        or None when it has nothing authoritative: it never replicated the
+        partition, or its own handoff acquisition is still in flight (an
+        empty answer must not count toward the peer's old-row majority)."""
+        pmap = self._map
+        if pmap is None or not 0 <= p < len(pmap.assignments):
+            return None
+        row = pmap.assignments[p]
+        if row and self.address in row:
+            if p in self._acquired and self.store.get(p) is None:
+                return None
+            return encode_kv(self._load_locked(p))
+        entry = self._retired.get(p)
+        return entry[1] if entry is not None else None
+
+    def _authoritative_kv_locked(
+        self, p: int
+    ) -> Optional[Dict[bytes, Tuple[int, bytes]]]:
+        """Decoded state for quorum-read answers, under the same rules as
+        _snapshot_blob_locked; retired state is decoded without caching
+        (this member no longer owns the partition)."""
+        pmap = self._map
+        if pmap is None or not 0 <= p < len(pmap.assignments):
+            return None
+        row = pmap.assignments[p]
+        if row and self.address in row:
+            if p in self._acquired and self.store.get(p) is None:
+                return None
+            return self._load_locked(p)
+        entry = self._retired.get(p)
+        return decode_kv(entry[1]) if entry is not None else None
+
     # -- server half: Get ------------------------------------------------- #
 
     def handle_get(self, msg: Get) -> Promise:
@@ -287,23 +402,35 @@ class ServingEngine:
                 return Promise.completed(self._retry_ack(msg.key, 0))
             if msg.quorum == 2:
                 # whole-partition snapshot (promote-time sync source half):
-                # the key carries the partition id as 8 LE bytes
+                # the key carries the partition id as 8 LE bytes. Answer
+                # only what we are authoritative for -- bounds-checked,
+                # replicated here (or just retired here), and not awaiting
+                # our own handoff delivery -- so a stale or malformed probe
+                # neither pollutes the KV cache nor contributes an empty
+                # snapshot to a peer's old-row majority.
+                if len(msg.key) < 8:
+                    return Promise.completed(self._retry_ack(msg.key, 0))
                 p = int.from_bytes(msg.key[:8], "little")
+                blob = self._snapshot_blob_locked(p)
+                if blob is None:
+                    return Promise.completed(self._retry_ack(msg.key, 0))
                 return Promise.completed(PutAck(
                     sender=self.address, status=PutAck.STATUS_OK,
-                    key=msg.key, value=encode_kv(self._load_locked(p)),
-                    map_version=pmap.version,
+                    key=msg.key, value=blob, map_version=pmap.version,
                 ))
             p = partition_of(msg.key, pmap.config.partitions)
-            kv = self._load_locked(p)
-            version, value = kv.get(msg.key, (0, b""))
-            found = msg.key in kv
             if msg.quorum == 1:
-                # quorum-read member half: answer from local state
-                # regardless of leadership
+                # quorum-read member half: answer from local state, but
+                # only when authoritative (same gate as the snapshot path;
+                # a churned leader's read quorum runs over the OLD row, so
+                # retired state answers and in-flight acquirers abstain)
+                akv = self._authoritative_kv_locked(p)
+                if akv is None:
+                    return Promise.completed(self._retry_ack(msg.key, 0))
+                version, value = akv.get(msg.key, (0, b""))
                 return Promise.completed(PutAck(
                     sender=self.address,
-                    status=(PutAck.STATUS_OK if found
+                    status=(PutAck.STATUS_OK if msg.key in akv
                             else PutAck.STATUS_NOT_FOUND),
                     key=msg.key, value=value, version=version,
                     map_version=pmap.version,
@@ -316,12 +443,15 @@ class ServingEngine:
                     key=msg.key, leader=row[0] if row else None,
                     map_version=pmap.version,
                 ))
+            kv = self._load_locked(p)
+            version, value = kv.get(msg.key, (0, b""))
+            found = msg.key in kv
             if p in self._churned:
                 # just promoted, snapshot sync still in flight: a local
                 # answer could miss writes acked by the previous leader --
-                # fall back to a quorum read
-                others = tuple(n for n in row if n != self.address)
-                need = (len(row) // 2 + 1) - 1
+                # fall back to a quorum read over the same old row the
+                # sync pulls from (the row whose majority acked them)
+                others, need = self._churned[p]
                 quorum_read = (p, others, need)
             else:
                 self.metrics.incr("serving.leader_reads")
@@ -338,10 +468,13 @@ class ServingEngine:
     def _quorum_read(self, key: bytes, others: Tuple[Endpoint, ...],
                      need: int, version: int, value: bytes,
                      found: bool) -> Promise:
-        """Fan a quorum Get to the other replicas; answer with the
-        max-version value once a majority of the row (local answer
-        included) responded. Any acked write's majority intersects ours,
-        so the max-version answer observes it."""
+        """Fan a quorum Get over the churned partition's old row; answer
+        with the max-version value once a majority of that row (local
+        answer included when this member was in it) responded. Any acked
+        write's majority lives in the old row and intersects ours, so the
+        max-version answer observes it. Responders answer only when
+        authoritative (retired state counts; in-flight acquirers abstain
+        with RETRY, which is not counted)."""
         self.metrics.incr("serving.quorum_reads")
         done: Promise = Promise()
         if need <= 0 or not others:
@@ -473,11 +606,23 @@ class ServingEngine:
     def _apply_replica_locked(self, msg: Put) -> PutAck:
         """Replica half: apply iff the replicated version is newer than
         what we hold -- duplicated, reordered or nemesis-replayed
-        replication converges to the same state."""
+        replication converges to the same state.
+
+        Applies only under the sender's exact installed map (versions are
+        fingerprints; equality is the only comparison) and only for
+        partitions this member replicates. A deposed leader racing a map
+        install therefore cannot assemble a quorum here -- it collects
+        RETRYs and reports RETRY to its client instead of acking a write
+        the new row would never inherit -- and a delayed or duplicated
+        replication Put cannot re-create a blob for a partition this
+        member already dropped."""
         pmap = self._map
-        if pmap is None:
+        if pmap is None or msg.map_version != pmap.version:
             return self._retry_ack(msg.key, msg.request_id)
         p = partition_of(msg.key, pmap.config.partitions)
+        row = pmap.assignments[p] if p < len(pmap.assignments) else ()
+        if not row or self.address not in row:
+            return self._retry_ack(msg.key, msg.request_id)
         kv = self._load_locked(p)
         if msg.version > kv.get(msg.key, (0, b""))[0]:
             kv[msg.key] = (msg.version, msg.value)

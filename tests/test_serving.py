@@ -294,6 +294,243 @@ def test_leader_failover_mid_write_under_nemesis():
 
 
 # ---------------------------------------------------------------------- #
+# Promotion-sync quorum + map-skew gates (engine unit level)
+#
+# The promote-time snapshot sync must pull from the partition's PREVIOUS
+# row: that row's majority is what acked every pre-view write, so only
+# old-row answers intersect it. A replica that just acquired the partition
+# (handoff still in flight) must abstain rather than contribute an empty
+# snapshot, and replicas must reject replication Puts stamped with a map
+# other than their installed one so a deposed leader cannot assemble a
+# quorum during the install skew window.
+# ---------------------------------------------------------------------- #
+
+from rapid_tpu.placement import PlacementConfig, PlacementMap
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.serving.engine import ServingEngine
+
+
+class _StubClient:
+    """Records every send; the test completes the promises by hand."""
+
+    def __init__(self):
+        self.sent = []  # (destination, message, promise)
+
+    def send_message(self, node, msg):
+        promise = Promise()
+        self.sent.append((node, msg, promise))
+        return promise
+
+    def probes(self, quorum):
+        return [
+            (node, msg, pr) for node, msg, pr in self.sent
+            if isinstance(msg, Get) and msg.quorum == quorum
+        ]
+
+
+def _pmap(version: int, *rows):
+    rows = tuple(tuple(r) for r in rows)
+    members = []
+    for row in rows:
+        for node in row:
+            if node not in members:
+                members.append(node)
+    return PlacementMap(
+        config=PlacementConfig(
+            partitions=len(rows),
+            replicas=max((len(r) for r in rows), default=1),
+        ),
+        configuration_id=1, version=version,
+        members=tuple(members), assignments=rows,
+    )
+
+
+def _eps(n):
+    return tuple(Endpoint.from_parts("node", 7000 + i) for i in range(n))
+
+
+def _snap_probe(sender, p: int, map_version: int) -> Get:
+    return Get(sender=sender, key=p.to_bytes(8, "little"), quorum=2,
+               map_version=map_version)
+
+
+def test_promote_sync_pulls_from_old_row_only():
+    """The review scenario: old row {A,B,C} with a write acked on a
+    majority, A crashes, new row {B,C,D}. B's sync must pull from the OLD
+    row (A, C) -- never from the freshly added D, whose empty pre-handoff
+    snapshot must not count toward the majority -- and the churn-window
+    quorum read must fan over the same old row."""
+    A, B, C, D = _eps(4)
+    store = InMemoryPartitionStore()
+    client = _StubClient()
+    eng = ServingEngine(store, B, client, None)
+    eng.update_map(_pmap(101, (A, B, C)))
+    # a write acked under the old map reaches B via replication
+    ack = eng.handle_put(Put(
+        sender=A, key=b"k", value=b"local", request_id=1, replicate=1,
+        version=7, map_version=101,
+    )).peek()
+    assert ack.status == PutAck.STATUS_OK
+
+    eng.update_map(_pmap(202, (B, C, D)))
+    assert eng.churned_partitions() == (0,)
+    sync_targets = {node for node, _, _ in client.probes(quorum=2)}
+    assert sync_targets == {A, C}, "sync must pull the old row, not D"
+    # majority of the old 3-row is 2; B contributes itself, so one
+    # old-row snapshot suffices
+    assert eng._churned[0] == ((A, C), 1)  # noqa: SLF001
+
+    # a read during the window takes the quorum-read path over the old row
+    read = eng.handle_get(Get(sender=B, key=b"k", quorum=0))
+    assert not read.done()
+    read_targets = {node for node, _, _ in client.probes(quorum=1)}
+    assert read_targets == {A, C}, "churned reads must quorum the old row"
+    for node, msg, pr in client.probes(quorum=1):
+        if node == C:
+            pr.set_result(PutAck(
+                sender=C, status=PutAck.STATUS_OK, key=msg.key,
+                value=b"acked", version=9, map_version=202,
+            ))
+    assert read.peek().version == 9 and read.peek().value == b"acked"
+
+    # one old-row snapshot completes the sync and clears the churn flag
+    for node, msg, pr in client.probes(quorum=2):
+        if node == C:
+            pr.set_result(PutAck(
+                sender=C, status=PutAck.STATUS_OK, key=msg.key,
+                value=encode_kv({b"k": (9, b"acked")}), map_version=202,
+            ))
+    assert eng.churned_partitions() == ()
+    got = eng.handle_get(Get(sender=B, key=b"k", quorum=0)).peek()
+    assert got.status == PutAck.STATUS_OK
+    assert got.version == 9 and got.value == b"acked"
+
+
+def test_promote_sync_first_map_falls_back_to_new_row():
+    """A member promoted on the very first map it sees cannot know the old
+    row; it best-effort syncs against the new row (responders gate empty
+    answers via the acquisition check, exercised separately)."""
+    B, C, D = _eps(3)
+    client = _StubClient()
+    eng = ServingEngine(InMemoryPartitionStore(), B, client, None)
+    eng.update_map(_pmap(101, (B, C, D)))
+    assert eng.churned_partitions() == (0,)
+    assert {node for node, _, _ in client.probes(quorum=2)} == {C, D}
+
+
+def test_snapshot_probe_abstains_until_acquisition_lands():
+    """A replica whose handoff delivery for a just-acquired partition has
+    not landed answers RETRY to snapshot and quorum-read probes -- an
+    empty answer must never satisfy a peer's sync majority."""
+    B, C, D = _eps(3)
+    store = InMemoryPartitionStore()
+    eng = ServingEngine(store, D, _StubClient(), None)
+    eng.update_map(_pmap(202, (B, C, D)))  # D's first map: all acquired
+    probe = _snap_probe(B, 0, 202)
+    assert eng.handle_get(probe).peek().status == PutAck.STATUS_RETRY
+    q1 = eng.handle_get(Get(sender=B, key=b"k", quorum=1)).peek()
+    assert q1.status == PutAck.STATUS_RETRY
+    store.put(0, encode_kv({b"k": (3, b"v")}))  # handoff delivers
+    ans = eng.handle_get(probe).peek()
+    assert ans.status == PutAck.STATUS_OK
+    assert decode_kv(ans.value) == {b"k": (3, b"v")}
+    q1 = eng.handle_get(Get(sender=B, key=b"k", quorum=1)).peek()
+    assert q1.status == PutAck.STATUS_OK and q1.version == 3
+
+
+def test_snapshot_probe_validates_partition_id():
+    """Malformed or foreign partition ids answer RETRY and do not insert
+    cache entries (unbounded growth from stale/hostile probes)."""
+    A, B, C = _eps(3)
+    eng = ServingEngine(InMemoryPartitionStore(), B, _StubClient(), None)
+    eng.update_map(_pmap(101, (A, B), (A, C)))  # B replicates p0 only
+    assert eng.handle_get(_snap_probe(A, 1, 101)).peek().status == \
+        PutAck.STATUS_RETRY
+    assert eng.handle_get(_snap_probe(A, 999, 101)).peek().status == \
+        PutAck.STATUS_RETRY
+    short = Get(sender=A, key=b"\x01", quorum=2, map_version=101)
+    assert eng.handle_get(short).peek().status == PutAck.STATUS_RETRY
+    assert set(eng._kv) <= {0}  # noqa: SLF001 -- no foreign cache entries
+
+
+def test_retired_replica_answers_sync_probes_for_one_view():
+    """A member dropped from a partition's row keeps its final blob so
+    old-row syncs can still pull it after the handoff ack releases the
+    store entry; the retired blob survives exactly one further view."""
+    A, B, C = _eps(3)
+    store = InMemoryPartitionStore()
+    eng = ServingEngine(store, C, _StubClient(), None)
+    eng.update_map(_pmap(101, (A, C)))
+    eng.handle_put(Put(
+        sender=A, key=b"k", value=b"v", request_id=1, replicate=1,
+        version=5, map_version=101,
+    )).peek()
+    eng.update_map(_pmap(202, (A, B)))  # C dropped from the row
+    store.delete(0)  # the handoff ack path releases the blob
+    ans = eng.handle_get(_snap_probe(B, 0, 202)).peek()
+    assert ans.status == PutAck.STATUS_OK
+    assert decode_kv(ans.value)[b"k"] == (5, b"v")
+    q1 = eng.handle_get(Get(sender=B, key=b"k", quorum=1)).peek()
+    assert q1.status == PutAck.STATUS_OK and q1.version == 5
+    # still answerable one view later (peers may sync against the old map)
+    eng.update_map(_pmap(303, (A, B)))
+    assert eng.handle_get(_snap_probe(B, 0, 303)).peek().status == \
+        PutAck.STATUS_OK
+    # two views later the retired blob is released
+    eng.update_map(_pmap(404, (A, B)))
+    assert eng.handle_get(_snap_probe(B, 0, 404)).peek().status == \
+        PutAck.STATUS_RETRY
+
+
+def test_replica_rejects_skewed_map_and_foreign_partition():
+    """Replication Puts apply only under the sender's exact installed map
+    and only for partitions this member replicates: a deposed leader
+    racing a map install collects RETRYs (no quorum, no false ack), and a
+    delayed replication Put cannot re-create a blob for a partition this
+    member already dropped."""
+    A, B, C = _eps(3)
+    store = InMemoryPartitionStore()
+    eng = ServingEngine(store, B, _StubClient(), None)
+    eng.update_map(_pmap(202, (A, B), (A, C)))  # B replicates p0 only
+    k0 = next(k for k in (b"pk-%d" % i for i in range(64))
+              if partition_of(k, 2) == 0)
+    k1 = next(k for k in (b"pk-%d" % i for i in range(64))
+              if partition_of(k, 2) == 1)
+    stale = Put(sender=A, key=k0, value=b"v", request_id=1, replicate=1,
+                version=3, map_version=101)
+    assert eng.handle_put(stale).peek().status == PutAck.STATUS_RETRY
+    assert store.partitions() == ()
+    foreign = Put(sender=A, key=k1, value=b"v", request_id=2, replicate=1,
+                  version=3, map_version=202)
+    assert eng.handle_put(foreign).peek().status == PutAck.STATUS_RETRY
+    assert store.partitions() == ()
+    good = Put(sender=A, key=k0, value=b"v", request_id=3, replicate=1,
+               version=3, map_version=202)
+    assert eng.handle_put(good).peek().status == PutAck.STATUS_OK
+    assert store.partitions() == (0,)
+
+
+def test_promote_sync_retries_inline_without_scheduler():
+    """With scheduler=None a failed sync round must retry inline (like the
+    routed-reply path) instead of silently parking the partition in the
+    churned state forever."""
+    A, B = _eps(2)
+    client = _StubClient()
+    eng = ServingEngine(InMemoryPartitionStore(), B, client, None)
+    eng.update_map(_pmap(101, (A, B)))
+    eng.update_map(_pmap(202, (B, A)))  # B promoted; old row (A, B)
+    probes = client.probes(quorum=2)
+    assert len(probes) == 1 and probes[0][0] == A
+    probes[0][2].set_exception(RuntimeError("peer down"))
+    probes = client.probes(quorum=2)
+    assert len(probes) == 2, "failed round must re-pull inline"
+    node, msg, pr = probes[1]
+    pr.set_result(PutAck(sender=A, status=PutAck.STATUS_OK, key=msg.key,
+                         value=encode_kv({}), map_version=202))
+    assert eng.churned_partitions() == ()
+
+
+# ---------------------------------------------------------------------- #
 # Simulator mirror
 # ---------------------------------------------------------------------- #
 

@@ -730,6 +730,14 @@ class Analyzer:
             changed = False
             for fn in self.all_funcs:
                 base = inh.get(id(fn))
+                if id(fn) in inh and base is None:
+                    # a _locked helper whose own call sites have not been
+                    # observed yet: crediting its outgoing calls now would
+                    # poison callees with a premature empty intersection
+                    # (the intersection only ever shrinks), making results
+                    # depend on method definition order -- defer until a
+                    # later round resolves its base
+                    continue
                 base_set = base if base is not None else frozenset()
                 for desc, _line, lockids in fn.calls:
                     for callee in self._resolve_call(fn, desc):
