@@ -423,6 +423,176 @@ class FaultPlan:
             version=version,
         ))
 
+    def to_json(self) -> dict:
+        """JSON-able dict of the whole plan: rules (with windows and link
+        matches), seed, topology + endpoint slots. ``from_json`` is the
+        inverse; the pair is what lets the nemesis search pin shrunk plans
+        as corpus files (scenarios/corpus/)."""
+        data: dict = {
+            "seed": self.seed,
+            "rules": [_rule_to_json(rule) for rule in self.rules],
+        }
+        if self.topology is not None:
+            data["topology"] = {
+                name: int(getattr(self.topology, name))
+                for name in _TOPOLOGY_FIELDS
+            }
+        if self.topology_slots:
+            data["topology_slots"] = {
+                str(ep): int(slot)
+                for ep, slot in sorted(self.topology_slots.items())
+            }
+        return data
+
+    @staticmethod
+    def from_json(data: dict) -> "FaultPlan":
+        """Rebuild a plan from ``to_json`` output by re-invoking the builder
+        methods, so every construction-time check (window sanity, partition
+        conflicts, parameter ranges) re-runs on load -- a corpus file cannot
+        smuggle in a plan the builders would have rejected. Raises
+        ValueError on unknown rule/message/topology fields and whatever the
+        builders raise on invalid parameters."""
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"fault plan must be a JSON object, got {type(data).__name__}"
+            )
+        plan = FaultPlan(seed=int(data.get("seed", 0)))
+        for spec in data.get("rules", ()):
+            _build_rule(plan, spec)
+        topo = data.get("topology")
+        slots_raw = data.get("topology_slots") or {}
+        if topo is not None:
+            from .sim.topology import LatencyTopology
+
+            unknown = set(topo) - set(_TOPOLOGY_FIELDS)
+            if unknown:
+                raise ValueError(f"unknown topology fields {sorted(unknown)}")
+            slots = {
+                Endpoint.from_string(ep): int(slot)
+                for ep, slot in slots_raw.items()
+            }
+            plan.with_topology(
+                LatencyTopology(**{k: int(v) for k, v in topo.items()}),
+                slots or None,
+            )
+        elif slots_raw:
+            raise ValueError("topology_slots without a topology")
+        return plan
+
+
+# LatencyTopology's full constructor surface, in declaration order
+_TOPOLOGY_FIELDS = (
+    "racks", "zones", "regions", "rack_rtt_ms", "zone_rtt_ms",
+    "region_rtt_ms", "inter_region_rtt_ms",
+)
+
+
+def _msg_type(name: str) -> type:
+    from . import types as _types
+
+    cls = getattr(_types, name, None)
+    if not isinstance(cls, type):
+        raise ValueError(f"unknown message type {name!r} in rapid_tpu.types")
+    return cls
+
+
+def _rule_to_json(rule: Rule) -> dict:
+    msg_types = None
+    if rule.match.msg_types is not None:
+        for cls in rule.match.msg_types:
+            if _msg_type(cls.__name__) is not cls:
+                raise ValueError(
+                    f"message type {cls!r} is not addressable by name "
+                    f"in rapid_tpu.types; the plan cannot round-trip"
+                )
+        msg_types = [cls.__name__ for cls in rule.match.msg_types]
+    spec: dict = {
+        "type": type(rule).__name__,
+        "at": rule.at,
+        "windows": [[start, end] for start, end in rule.windows],
+        "src": None if rule.match.src is None else str(rule.match.src),
+        "dst": None if rule.match.dst is None else str(rule.match.dst),
+        "msg_types": msg_types,
+    }
+    if isinstance(rule, FlipFlopRule):
+        spec["period_ms"] = rule.period_ms
+        spec["start_ms"] = rule.start_ms
+    elif isinstance(rule, DropRule):  # includes LossyLinkRule
+        spec["probability"] = rule.probability
+    elif isinstance(rule, DelayRule):
+        spec["base_ms"] = rule.base_ms
+        spec["jitter_ms"] = rule.jitter_ms
+    elif isinstance(rule, DuplicateRule):
+        spec["probability"] = rule.probability
+    elif isinstance(rule, ReorderRule):
+        spec["probability"] = rule.probability
+        spec["max_extra_ms"] = rule.max_extra_ms
+    elif isinstance(rule, SlowNodeRule):
+        spec["response_delay_ms"] = rule.response_delay_ms
+    elif isinstance(rule, ClockSkewRule):
+        spec["offset_ms"] = rule.offset_ms
+        spec["rate"] = rule.rate
+    elif isinstance(rule, WireVersionRule):
+        spec["version"] = rule.version
+    return spec
+
+
+def _build_rule(plan: FaultPlan, spec: dict) -> None:
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"rule spec must be a JSON object, got {type(spec).__name__}"
+        )
+    kind = spec.get("type")
+    windows = tuple(
+        (int(start), None if end is None else int(end))
+        for start, end in (spec.get("windows") or _ALWAYS)
+    )
+    src = spec.get("src")
+    src = None if src is None else Endpoint.from_string(src)
+    dst = spec.get("dst")
+    dst = None if dst is None else Endpoint.from_string(dst)
+    raw_types = spec.get("msg_types")
+    msg_types = (
+        None if raw_types is None
+        else tuple(_msg_type(name) for name in raw_types)
+    )
+    at = spec.get("at", EGRESS)
+    common = dict(src=src, dst=dst, msg_types=msg_types, windows=windows,
+                  at=at)
+    if kind == "DropRule":
+        plan.drop(float(spec["probability"]), **common)
+    elif kind == "PartitionRule":
+        plan.partition_one_way(src=src, dst=dst, windows=windows, at=at)
+    elif kind == "FlipFlopRule":
+        plan.flip_flop(int(spec["period_ms"]), src=src, dst=dst,
+                       start_ms=int(spec.get("start_ms", 0)),
+                       windows=windows, at=at)
+    elif kind == "DelayRule":
+        plan.delay(int(spec["base_ms"]), int(spec.get("jitter_ms", 0)),
+                   **common)
+    elif kind == "DuplicateRule":
+        plan.duplicate(float(spec["probability"]), **common)
+    elif kind == "ReorderRule":
+        plan.reorder(float(spec["probability"]),
+                     int(spec.get("max_extra_ms", 100)), **common)
+    elif kind == "LossyLinkRule":
+        plan.lossy_link(float(spec["probability"]), **common)
+    elif kind == "SlowNodeRule":
+        if dst is None:
+            raise ValueError("SlowNodeRule needs a dst node")
+        plan.slow_node(dst, int(spec["response_delay_ms"]), windows=windows)
+    elif kind == "ClockSkewRule":
+        if src is None:
+            raise ValueError("ClockSkewRule needs a src node")
+        plan.clock_skew(src, offset_ms=int(spec.get("offset_ms", 0)),
+                        rate=float(spec.get("rate", 1.0)))
+    elif kind == "WireVersionRule":
+        if src is None:
+            raise ValueError("WireVersionRule needs a src node")
+        plan.wire_version(src, int(spec["version"]), windows=windows)
+    else:
+        raise ValueError(f"unknown rule type {kind!r}")
+
 
 @dataclass
 class Decision:

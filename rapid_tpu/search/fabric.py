@@ -1,0 +1,343 @@
+"""A virtual-time serving-plane fabric for nemesis-search probes.
+
+Why not the simulator: the sim plane's serving mirror reconciles by
+max-merging across every live old-row replica, so quorum-counting bugs
+in the real :class:`~..serving.engine.ServingEngine` promote path are
+structurally invisible there. This fabric runs N real engines over one
+``VirtualScheduler`` with every request routed through
+``Nemesis.decide`` (drops, delays, duplicates, gray slowness, skewed
+clocks, WAN topology latency), so a probe exercises the actual quorum
+arithmetic in milliseconds of wall time.
+
+The membership/placement plane is compiled, not simulated: long-lived
+partitions, flappy links and heavily-slowed nodes against a member are
+treated as what the failure detector would eventually conclude --
+eviction -- scheduled ``DETECT_MS`` after the fault window opens. An
+eviction rebuilds the placement map, replays the diff's handoff copies
+store-to-store (donor first, then live old-row survivors: the failover
+chain), and installs the new map on every engine *including the victim*
+(the "kicked" signal; read fencing on a deposed leader is a lease
+protocol the engine does not implement, so the fabric does not probe
+that window).
+
+Every delivery costs ``DELIVERY_MS`` so map installs, which are
+synchronous, always complete before the first promote-sync probe lands;
+a dropped or too-slow message surfaces to the sender as a TimeoutError
+at ``DROP_TIMEOUT_MS``, feeding the engine's own retry loop.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..faults import (
+    EGRESS,
+    FaultPlan,
+    FlipFlopRule,
+    Nemesis,
+    PartitionRule,
+    SlowNodeRule,
+)
+from ..handoff.store import InMemoryPartitionStore
+from ..observability import FlightRecorder, Metrics
+from ..placement.engine import PlacementConfig, build_map, diff_maps
+from ..runtime.futures import Promise
+from ..runtime.scheduler import VirtualScheduler
+from ..serving.engine import ServingEngine
+from ..serving.kv import encode_kv
+from ..types import Endpoint, Get, PutAck
+from .checkers import ClientOp
+
+DELIVERY_MS = 1        # per-hop latency: installs land before sync probes
+DROP_TIMEOUT_MS = 60   # sender-side deadline for dropped/slow messages
+DETECT_MS = 400        # fault window opens -> eviction decision
+SETTLE_MS = 1500       # post-horizon drain for retries and syncs
+
+
+def fabric_endpoints(n: int) -> List[Endpoint]:
+    return [Endpoint.from_parts("node", 7000 + i) for i in range(n)]
+
+
+class _FabricClient:
+    """The engine-facing transport half: requests go through the fabric's
+    nemesis-routed send."""
+
+    def __init__(self, fabric: "ServingFabric", address: Endpoint) -> None:
+        self._fabric = fabric
+        self.address = address
+
+    def send_message(self, remote: Endpoint, msg) -> Promise:
+        return self._fabric._send(self.address, remote, msg)
+
+
+class ServingFabric:
+    """One probe's worth of cluster: N engines, one plan, one clock."""
+
+    def __init__(self, plan: FaultPlan, n: int = 5, partitions: int = 16,
+                 replicas: int = 3, config_seed: int = 0) -> None:
+        self.plan = plan
+        self.scheduler = VirtualScheduler()
+        self.metrics = Metrics()
+        self.recorder = FlightRecorder(
+            capacity=4096, node="fabric", clock=self.scheduler.now_ms
+        )
+        self.nemesis = Nemesis(plan, self.scheduler, metrics=self.metrics)
+        self.nemesis.arm(epoch_ms=0)
+        self.endpoints = fabric_endpoints(n)
+        self.live: Set[Endpoint] = set(self.endpoints)
+        self.config = PlacementConfig(
+            partitions=partitions, replicas=replicas, seed=config_seed
+        )
+        self.stores: Dict[Endpoint, InMemoryPartitionStore] = {}
+        self.engines: Dict[Endpoint, ServingEngine] = {}
+        for ep in self.endpoints:
+            store = InMemoryPartitionStore()
+            self.stores[ep] = store
+            self.engines[ep] = ServingEngine(
+                store, ep, _FabricClient(self, ep),
+                self.nemesis.scheduler_for(ep),
+                metrics=self.metrics, recorder=self.recorder,
+            )
+        self.epoch = 1
+        self.map = build_map(
+            tuple(self.endpoints), {}, self.config, self.epoch
+        )
+        # seed every owned partition with an empty blob (what a real
+        # bootstrap's handoff plane leaves behind): a store holding nothing
+        # abstains from sync/quorum answers, so an unseeded fabric would
+        # churn forever on its very first map
+        for p, row in enumerate(self.map.assignments):
+            for ep in row:
+                self.stores[ep].put(p, encode_kv({}))
+        for ep in self.endpoints:
+            self.engines[ep].update_map(self.map)
+        self.history: List[ClientOp] = []
+        for when_ms, ep in self._eviction_schedule(plan):
+            self.scheduler.schedule(
+                when_ms, lambda victim=ep: self._evict(victim)
+            )
+
+    # -- compiled membership plane --------------------------------------- #
+
+    def _eviction_schedule(
+        self, plan: FaultPlan
+    ) -> List[Tuple[int, Endpoint]]:
+        """What the FD would eventually decide: a member behind a lasting
+        partition, flappy link, or timeout-scale slowness gets evicted
+        DETECT_MS after the fault window opens."""
+        out: List[Tuple[int, Endpoint]] = []
+        victims: Set[Endpoint] = set()
+        for rule in plan.rules:
+            dst = rule.match.dst
+            if dst is None or dst not in self.stores or dst in victims:
+                continue
+            if isinstance(rule, SlowNodeRule):
+                if rule.response_delay_ms < DROP_TIMEOUT_MS:
+                    continue  # slow but under timeouts: gray, not evicted
+            elif not isinstance(rule, (PartitionRule, FlipFlopRule)):
+                continue
+            for start, end in rule.windows:
+                if end is not None and end - start < DETECT_MS:
+                    continue  # heals before the detector concludes
+                out.append((start + DETECT_MS, dst))
+                victims.add(dst)
+                break
+        return sorted(out, key=lambda pair: (pair[0], str(pair[1])))
+
+    def _evict(self, victim: Endpoint) -> None:
+        if victim not in self.live or len(self.live) <= 1:
+            return
+        self.live.discard(victim)
+        # the detector's verdict, then the membership consequence: the
+        # fd_signal/kicked pair brackets each eviction in the journal, so
+        # multi-eviction plans produce edge vocabulary single-fault plans
+        # cannot (that tail is what guided search climbs toward)
+        self.recorder.record(
+            "fd_signal", node=str(victim), verdict="evict",
+        )
+        self.epoch += 1
+        self.metrics.incr("view_changes")
+        old = self.map
+        new = build_map(
+            tuple(sorted(self.live)), {}, self.config, self.epoch
+        )
+        diff = diff_maps(old, new)
+        self.recorder.record(
+            "view_install", epoch=self.epoch, evicted=str(victim),
+            members=len(self.live),
+        )
+        self.recorder.record(
+            "placement_rebalance", version=new.version, moved=diff.moved,
+        )
+        for p, donor, recipient in diff.handoffs:
+            if recipient not in self.stores:
+                continue
+            self.recorder.record(
+                "handoff_started", partition=p,
+                donor=None if donor is None else str(donor),
+                recipient=str(recipient),
+            )
+            old_row = old.assignments[p] if p < len(old.assignments) else ()
+            sources = [donor] if donor is not None else []
+            sources.extend(n for n in old_row if n not in sources)
+            blob = None
+            used: Optional[Endpoint] = None
+            for source in sources:
+                if source not in self.live or source == recipient:
+                    continue
+                held = self.stores[source].get(p)
+                if held is not None:
+                    blob, used = held, source
+                    break
+            if blob is None:
+                self.metrics.incr("handoff.sessions_failed")
+                self.recorder.record(
+                    "handoff_failed", partition=p, recipient=str(recipient),
+                )
+                continue
+            if donor is not None and used != donor:
+                self.metrics.incr("handoff.failovers")
+            self.stores[recipient].put(p, blob)
+            self.recorder.record(
+                "handoff_complete", partition=p, source=str(used),
+                recipient=str(recipient),
+            )
+        # victim included: the kicked signal (see module docstring)
+        for ep in sorted(self.engines):
+            self.engines[ep].update_map(new)
+        self.recorder.record("kicked", node=str(victim), epoch=self.epoch)
+        self.map = new
+
+    # -- nemesis-routed transport ----------------------------------------- #
+
+    def _send(self, src: Endpoint, dst: Endpoint, msg) -> Promise:
+        d = self.nemesis.decide(src, dst, msg, EGRESS)
+        kind = type(msg).__name__
+        if d.drop:
+            self.metrics.incr("nemesis_dropped", at="egress", msg=kind)
+            out: Promise = Promise()
+            self.scheduler.schedule(
+                DROP_TIMEOUT_MS,
+                lambda: out.try_set_exception(
+                    TimeoutError(f"nemesis dropped {kind} to {dst}")
+                ),
+            )
+            return out
+        for _ in range(d.duplicates):
+            self.metrics.incr("nemesis_duplicated", at="egress", msg=kind)
+            self._deliver(dst, msg, DELIVERY_MS + d.delay_ms, Promise())
+        out = Promise()
+        total = DELIVERY_MS + d.delay_ms + d.slow_ms
+        if d.slow_ms > 0:
+            self.metrics.incr("nemesis_slowed", at="egress", msg=kind)
+            if total >= DROP_TIMEOUT_MS:
+                # gray node: delivered and applied, but the sender's
+                # deadline fires first -- indistinguishable from a drop
+                self.scheduler.schedule(
+                    DROP_TIMEOUT_MS,
+                    lambda: out.try_set_exception(TimeoutError(
+                        f"{dst} answered {total} ms late"
+                    )),
+                )
+        elif d.delay_ms > 0:
+            self.metrics.incr(
+                "nemesis_reordered" if d.reordered else "nemesis_delayed",
+                at="egress", msg=kind,
+            )
+        else:
+            self.metrics.incr("nemesis_passed", at="egress", msg=kind)
+        self._deliver(dst, msg, total, out)
+        return out
+
+    def _deliver(self, dst: Endpoint, msg, after_ms: int,
+                 out: Promise) -> None:
+        def dispatch() -> None:
+            engine = self.engines.get(dst)
+            if engine is None:
+                out.try_set_exception(TimeoutError(f"no such node {dst}"))
+                return
+            reply = (
+                engine.handle_get(msg) if isinstance(msg, Get)
+                else engine.handle_put(msg)
+            )
+            reply.add_callback(
+                lambda p: self.scheduler.schedule(
+                    DELIVERY_MS, lambda: _settle(p, out)
+                )
+            )
+
+        self.scheduler.schedule(after_ms, dispatch)
+
+    # -- workload ---------------------------------------------------------- #
+
+    def run(self, horizon_ms: int, ops: int, keys: int = 6) -> List[ClientOp]:
+        """Seeded closed-ish workload: ops spread evenly over the horizon,
+        puts and gets from every node's co-located client, then a settle
+        drain. Returns the completed-op history."""
+        rnd = random.Random(self.plan.seed * 2_000_003 + 17)
+        gap = max(1, horizon_ms // (ops + 1))
+        for i in range(ops):
+            client = self.endpoints[rnd.randrange(len(self.endpoints))]
+            key = b"k%02d" % rnd.randrange(keys)
+            if rnd.random() < 0.55:
+                self._schedule_op(
+                    (i + 1) * gap, "put", client, key, b"v-%d" % i
+                )
+            else:
+                self._schedule_op((i + 1) * gap, "get", client, key)
+        self.scheduler.run_until_time(horizon_ms + SETTLE_MS)
+        return self.history
+
+    def _schedule_op(self, at_ms: int, op: str, client: Endpoint,
+                     key: bytes, value: bytes = b"") -> None:
+        self.scheduler.schedule(
+            at_ms, lambda: self._issue(op, client, key, value)
+        )
+
+    def _issue(self, op: str, client: Endpoint, key: bytes,
+               value: bytes) -> None:
+        engine = self.engines[client]
+        invoke_ms = self.scheduler.now_ms()
+        promise = (
+            engine.client_put(key, value) if op == "put"
+            else engine.client_get(key)
+        )
+
+        def finish(p: Promise) -> None:
+            ack = None if p.exception() is not None else p._result  # noqa: SLF001
+            if not isinstance(ack, PutAck):
+                return  # never completed: no linearizability obligation
+            self.history.append(ClientOp(
+                client=str(client), op=op, key=key,
+                value=value if op == "put" else ack.value,
+                version=ack.version, status=ack.status,
+                invoke_ms=invoke_ms, complete_ms=self.scheduler.now_ms(),
+            ))
+
+        promise.add_callback(finish)
+
+    # -- probe outputs ----------------------------------------------------- #
+
+    def journal(self) -> List[dict]:
+        return self.recorder.tail(4096)
+
+    def live_digests(self) -> Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]]:
+        return {
+            str(ep): self.engines[ep].leader_digest()
+            for ep in sorted(self.live)
+        }
+
+    def map_versions(self) -> Dict[str, int]:
+        return {
+            str(ep): getattr(self.engines[ep]._map, "version", None)  # noqa: SLF001
+            for ep in sorted(self.live)
+        }
+
+
+def _settle(src: Promise, dst: Promise) -> None:
+    exc = src.exception()
+    if exc is not None:
+        dst.try_set_exception(exc)
+    else:
+        dst.try_set_result(src._result)  # noqa: SLF001

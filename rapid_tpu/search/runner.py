@@ -1,0 +1,271 @@
+"""Execute one nemesis-search probe: plan spec in, coverage + verdicts out.
+
+Two harnesses share the probe-spec format (a JSON-able dict)::
+
+    {"harness": "engine", "n": 5, "partitions": 16, "horizon_ms": 4000,
+     "ops": 40, "keys": 6, "plan": {...FaultPlan.to_json...}}
+
+``engine`` runs the real ServingEngine cluster on the virtual-time
+fabric (:mod:`.fabric`) -- the harness that exercises the serving plane's
+actual quorum arithmetic. ``sim`` replays the plan's device-compilable
+rules on the Simulator via the ``apply_plan_at`` segment loop while a
+seeded Get/Put workload rides the sim's serving mirror; rules matching
+the Put wire are split out and handed to ``enable_serving`` (the sim's
+serving nemesis), mirroring how ``_device_rules`` refuses non-probe
+message matches.
+
+Both harnesses are deterministic per (spec, plan seed): same spec, same
+history, same coverage, same verdicts.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import FrozenSet, List, Tuple
+
+from ..faults import FaultPlan
+from .checkers import (
+    ClientOp,
+    InvariantViolation,
+    check_config_parity,
+    check_fingerprint_agreement,
+    check_leader_agreement,
+    check_linearizable_history,
+    check_view_agreement,
+)
+from .coverage import (
+    COVERAGE_METRICS,
+    coverage_from_fault_actions,
+    coverage_from_journal,
+    coverage_from_metrics,
+)
+
+# fixed sim identity: matches tests/test_serving.py's serving-sim shape so
+# probes reuse the same jit cache entries as the existing suite
+SIM_SEED = 11
+SIM_PLACEMENT = {"partitions": 32, "replicas": 3, "seed": 7}
+
+
+@dataclass(frozen=True)
+class ProbeResult:
+    coverage: FrozenSet[tuple]
+    violations: Tuple[dict, ...]
+    info: dict = field(default_factory=dict)
+
+    @property
+    def violated(self) -> bool:
+        return bool(self.violations)
+
+
+def run_probe(spec: dict) -> ProbeResult:
+    harness = spec.get("harness", "engine")
+    if harness == "engine":
+        return run_engine_probe(spec)
+    if harness == "sim":
+        return run_sim_probe(spec)
+    raise ValueError(f"unknown harness {harness!r}")
+
+
+def _collect(checks) -> List[dict]:
+    violations: List[dict] = []
+    for check in checks:
+        try:
+            check()
+        except InvariantViolation as violation:
+            violations.append(violation.to_json())
+    return violations
+
+
+# -- engine harness ------------------------------------------------------- #
+
+def run_engine_probe(spec: dict) -> ProbeResult:
+    from .fabric import ServingFabric
+
+    plan = FaultPlan.from_json(spec["plan"])
+    fabric = ServingFabric(
+        plan,
+        n=spec.get("n", 5),
+        partitions=spec.get("partitions", 16),
+        replicas=spec.get("replicas", 3),
+    )
+    history = fabric.run(
+        spec.get("horizon_ms", 4000), spec.get("ops", 40),
+        keys=spec.get("keys", 6),
+    )
+    violations = _collect([
+        lambda: check_linearizable_history(history),
+        lambda: check_leader_agreement(fabric.live_digests()),
+        lambda: check_view_agreement(fabric.map_versions()),
+    ])
+    snapshot = {
+        name: fabric.metrics.get(name) for name in COVERAGE_METRICS
+    }
+    coverage = (
+        coverage_from_journal(fabric.journal())
+        | coverage_from_metrics(snapshot)
+        | coverage_from_fault_actions(fabric.metrics.snapshot())
+    )
+    acked = sum(1 for o in history if o.op == "put" and o.status == 0)
+    return ProbeResult(
+        coverage=coverage,
+        violations=tuple(violations),
+        info={
+            "harness": "engine",
+            "history": len(history),
+            "acked_puts": acked,
+            "virtual_ms": fabric.scheduler.now_ms(),
+            "live": len(fabric.live),
+        },
+    )
+
+
+# -- sim harness ---------------------------------------------------------- #
+
+def _is_serving_rule(rule_spec: dict) -> bool:
+    return rule_spec.get("msg_types") == ["Put"]
+
+
+def run_sim_probe(spec: dict) -> ProbeResult:
+    from ..faults import (
+        UnsupportedDeviceFault,
+        _boundaries,
+        _device_rules,
+        apply_plan_at,
+        endpoint_slots,
+    )
+    from ..sim.driver import Simulator
+    from ..types import PutAck
+
+    plan_spec = spec["plan"]
+    rule_specs = plan_spec.get("rules", [])
+    base = {k: v for k, v in plan_spec.items() if k != "rules"}
+    serving_specs = [r for r in rule_specs if _is_serving_rule(r)]
+    device_specs = [r for r in rule_specs if not _is_serving_rule(r)]
+    serving_plan = (
+        FaultPlan.from_json({**base, "rules": serving_specs})
+        if serving_specs else None
+    )
+    device_plan = FaultPlan.from_json({**base, "rules": device_specs})
+
+    sim = Simulator(
+        spec.get("n", 4), capacity=spec.get("capacity", 5), seed=SIM_SEED
+    ).ready()
+    sim.enable_placement(**SIM_PLACEMENT)
+    sim.enable_handoff(chunk_size=1024)
+    sim.enable_serving(request_ms=1, fault_plan=serving_plan)
+
+    rnd = random.Random(int(plan_spec.get("seed", 0)) * 2_000_003 + 29)
+    keys = [b"sk-%02d" % i for i in range(spec.get("keys", 8))]
+    history: List[ClientOp] = []
+
+    def do_ops(count: int) -> None:
+        for _ in range(count):
+            key = keys[rnd.randrange(len(keys))]
+            invoke = sim.virtual_ms
+            if rnd.random() < 0.55:
+                value = b"sv-%d" % len(history)
+                ack = sim.serving_put(key, value)
+                history.append(ClientOp(
+                    "sim", "put", key, value, ack.version, ack.status,
+                    invoke, sim.virtual_ms,
+                ))
+            else:
+                ack = sim.serving_get(key)
+                history.append(ClientOp(
+                    "sim", "get", key, ack.value, ack.version, ack.status,
+                    invoke, sim.virtual_ms,
+                ))
+
+    horizon = spec.get("horizon_ms", 20_000)
+    ops = spec.get("ops", 30)
+    slots = endpoint_slots(sim)
+    round_ms = sim.config.fd_interval_ms // sim.config.rounds_per_interval
+    info: dict = {"harness": "sim"}
+    try:
+        rules = _device_rules(device_plan, round_ms)
+    except UnsupportedDeviceFault as exc:
+        # a mutated plan can drift outside the device-compilable subset;
+        # report it as an empty probe rather than crashing the hunt
+        return ProbeResult(
+            coverage=frozenset(), violations=(),
+            info={**info, "unsupported": str(exc)},
+        )
+    do_ops(max(1, ops // 4))
+    # segment loop (replay_on_simulator's shape) with workload interleaved
+    # at every fault-schedule boundary
+    epoch = sim.virtual_ms
+    times = _boundaries(rules, horizon, round_ms)
+    per_segment = max(1, ops // (2 * max(1, len(times) - 1)))
+    for seg_start, seg_end in zip(times, times[1:]):
+        apply_plan_at(sim, device_plan, seg_start, slots)
+        do_ops(per_segment)
+        target = epoch + seg_end
+        while sim.virtual_ms < target:
+            remaining = math.ceil((target - sim.virtual_ms) / round_ms)
+            if sim.run_until_decision(
+                max_rounds=remaining, batch=min(8, remaining)
+            ) is None:
+                break
+        do_ops(per_segment)
+    # heal and settle, then read back every acked key: the oracle reads
+    # become history entries the linearizability checker judges
+    sim.clear_link_faults()
+    sim.run_until_decision(max_rounds=40, batch=8)
+    do_ops(max(1, ops // 4))
+    for key in sorted(sim.serving_acked):
+        invoke = sim.virtual_ms
+        ack = sim.serving_get(key)
+        history.append(ClientOp(
+            "sim", "get", key, ack.value, ack.version, ack.status,
+            invoke, sim.virtual_ms,
+        ))
+
+    stamped = sim.configuration_id()
+    sim._config_id = None  # noqa: SLF001 -- drop the memo, force the fold
+    sim._spec = None  # noqa: SLF001
+    checks = [
+        lambda: check_linearizable_history(history),
+        lambda: check_config_parity(stamped, sim.configuration_id()),
+    ]
+    if not serving_specs:
+        # with lossy Put replication a minority replica may legitimately
+        # lag until the next reconcile; fingerprints must agree only when
+        # every replication write went through
+        checks.append(
+            lambda: check_fingerprint_agreement(_sim_fingerprints(sim))
+        )
+    violations = _collect(checks)
+    snapshot = {name: sim.metrics.get(name) for name in COVERAGE_METRICS}
+    coverage = (
+        coverage_from_journal(sim.recorder.tail(4096))
+        | coverage_from_metrics(snapshot)
+        | coverage_from_fault_actions(sim.metrics.snapshot())
+    )
+    acked = sum(
+        1 for o in history
+        if o.op == "put" and o.status == PutAck.STATUS_OK
+    )
+    return ProbeResult(
+        coverage=coverage,
+        violations=tuple(violations),
+        info={
+            **info,
+            "history": len(history),
+            "acked_puts": acked,
+            "virtual_ms": sim.virtual_ms,
+            "view_changes": len(sim.view_changes),
+        },
+    )
+
+
+def _sim_fingerprints(sim) -> List[Tuple[int, str, object]]:
+    assign = sim.placement.assign
+    out: List[Tuple[int, str, object]] = []
+    for p in range(assign.shape[0]):
+        for slot in assign[p]:
+            if slot < 0:
+                continue
+            out.append((p, f"slot{int(slot)}", sim.handoff_stores[int(slot)].get(p)))
+    return out

@@ -83,6 +83,18 @@ DEFAULT_RETRY_LIMIT = 8
 DEFAULT_RETRY_DELAY_MS = 10
 
 
+def _bug_newrow_sync() -> bool:
+    """Deliberately reintroduce the PR 11 sync-target bug when
+    RAPID_BUG_NEWROW_SYNC=1: the promote-time sync pulls its quorum from
+    the NEW row, counting just-acquired replicas whose handoff copy may
+    descend from a stale survivor. This is the known-bug target the
+    nemesis search must find, shrink, and pin (tests/test_search.py);
+    read at call time so tests can monkeypatch the environment."""
+    import os
+
+    return os.environ.get("RAPID_BUG_NEWROW_SYNC", "") == "1"
+
+
 class ServingEngine:
     """Router, leader and replica halves of the serving protocol.
     Thread-safe: handlers run on the protocol executor while replication
@@ -133,6 +145,15 @@ class ServingEngine:
         # handoff delivery may still be in flight; until the store holds
         # bytes for them, this member has nothing authoritative to answer
         self._acquired: Set[int] = set()
+        # guarded-by: _lock -- partitions acquired mid-stream (the row
+        # existed before this member joined it): the handoff copy may
+        # descend from ANY old-row survivor, stale ones included, so until
+        # a majority of the pre-join row is merged in (the join-time pull)
+        # this member abstains from snapshot and quorum answers. Counting
+        # such a copy toward a peer's sync quorum is the chained-view
+        # staleness the nemesis search pinned: partition -> (pre-join row
+        # members to pull from, answers required)
+        self._grafted: Dict[int, Tuple[Tuple[Endpoint, ...], int]] = {}
         self._next_request_id = 1
         self._gets = 0
         self._puts = 0
@@ -179,6 +200,7 @@ class ServingEngine:
         this member now leads. Runs on the protocol executor inside the
         view-change path, after the handoff sessions launch."""
         to_sync: List[Tuple[int, Tuple[Endpoint, ...], int, int]] = []
+        to_graft: List[int] = []
         changes = 0
         with self._lock:
             old = self._map
@@ -197,10 +219,13 @@ class ServingEngine:
                     old_row = old.assignments[p]
                 old_leader = old_row[0] if old_row else None
                 if not row or self.address not in row:
-                    if self.address in old_row:
+                    if self.address in old_row and p not in self._grafted:
                         # retiring replica: the handoff ack path will
                         # release the store blob; keep the bytes one view
-                        # so syncs against the old row can still pull them
+                        # so syncs against the old row can still pull them.
+                        # A still-grafted leaver retires nothing: its copy
+                        # was never reconciled, so it must not feed a
+                        # peer's old-row majority
                         blob = self.store.get(p)
                         self._retired[p] = (
                             pmap.version, blob if blob is not None else b""
@@ -208,6 +233,7 @@ class ServingEngine:
                     self._kv.pop(p, None)
                     self._churned.pop(p, None)
                     self._acquired.discard(p)
+                    self._grafted.pop(p, None)
                     continue
                 self._retired.pop(p, None)
                 if old is None or self.address not in old_row:
@@ -217,6 +243,18 @@ class ServingEngine:
                     # this member has nothing authoritative to answer
                     self._kv.pop(p, None)
                     self._acquired.add(p)
+                    if old_row and not _bug_newrow_sync():
+                        # mid-stream join: abstain until a majority of the
+                        # pre-join row is merged in. If we were also just
+                        # promoted, the promote-time sync below runs with
+                        # the same (others, need) and clears the graft on
+                        # completion; otherwise the join-time pull does.
+                        self._grafted[p] = (
+                            tuple(n for n in old_row if n != self.address),
+                            len(old_row) // 2 + 1,
+                        )
+                        if row[0] != self.address:
+                            to_graft.append(p)
                 else:
                     self._acquired.discard(p)
                 leader = row[0]
@@ -230,19 +268,29 @@ class ServingEngine:
                     # sync against the OLD row, whose majority acked every
                     # pre-view write. Pulling from the new row would count
                     # empty just-acquired replicas toward the quorum.
-                    if old_row:
+                    if old_row and not _bug_newrow_sync():
                         others = tuple(
                             n for n in old_row if n != self.address
                         )
+                        # a grafted self does not count toward the old-row
+                        # majority: its own copy is the unreconciled bytes
+                        # the graft discipline exists to quarantine
                         need = (len(old_row) // 2 + 1) - (
-                            1 if self.address in old_row else 0
+                            1 if (
+                                self.address in old_row
+                                and p not in self._grafted
+                            ) else 0
                         )
                     else:
                         # first map this member sees: the old row is
                         # unknowable, so best-effort sync against the new
                         # row -- responders still answer RETRY until their
                         # own acquisition lands, so empty co-acquirers
-                        # cannot satisfy the count
+                        # cannot satisfy the count. (_bug_newrow_sync
+                        # forces this branch even with an old row: a
+                        # just-acquired replica then answers from its
+                        # handoff copy, which may descend from a stale
+                        # survivor -- the pinned-corpus regression)
                         others = tuple(n for n in row if n != self.address)
                         need = (len(row) // 2 + 1) - 1
                     if need <= 0 or not others:
@@ -267,6 +315,8 @@ class ServingEngine:
         # sends outside the lock: in-process transports complete inline
         for p, others, need, version in to_sync:
             self._start_sync(p, others, need, version)
+        for p in to_graft:
+            self._start_graft(p)
 
     def _start_sync(self, p: int, others: Tuple[Endpoint, ...], need: int,
                     version: int) -> None:
@@ -321,6 +371,10 @@ class ServingEngine:
                             kv[key] = (ver, val)
                 self._persist_locked(p)
                 self._churned.pop(p, None)
+                # the promote-time merge covers the join-time obligation:
+                # when this member was grafted, need was a full old-row
+                # majority (self uncounted), the same quorum the pull wants
+                self._grafted.pop(p, None)
                 if self._recorder is not None:
                     self._recorder.record(
                         "serving_sync", partition=p, version=version,
@@ -342,6 +396,82 @@ class ServingEngine:
                 # _on_routed_reply), otherwise the partition would stay
                 # churned forever and every Put would answer RETRY
                 self._start_sync(p, others, need, version)
+
+    def _start_graft(self, p: int) -> None:
+        """Join-time pull for a mid-stream acquirer (follower half of the
+        graft discipline): merge a majority of the pre-join row, then
+        start answering. The pull outlives map changes -- the obligation
+        is about writes acked before this member joined, and the target
+        row is fixed at join time -- and retries until it completes, the
+        partition moves away, or a promotion's own sync subsumes it."""
+        with self._lock:
+            entry = self._grafted.get(p)
+            pmap = self._map
+            if entry is None or pmap is None:
+                return
+            others, need = entry
+            version = pmap.version
+        probe = Get(
+            sender=self.address, key=p.to_bytes(8, "little"), quorum=2,
+            map_version=version,
+        )
+        state = {"snaps": [], "replies": 0, "done": False}
+        for node in others:
+            promise = self._client.send_message(node, probe)
+            promise.add_callback(
+                lambda reply: self._on_graft_snapshot(
+                    p, others, need, state, reply
+                )
+            )
+
+    def _on_graft_snapshot(self, p: int, others: Tuple[Endpoint, ...],
+                           need: int, state: dict, promise) -> None:
+        exc = promise.exception()
+        reply = None if exc is not None else promise._result  # noqa: SLF001
+        retry = False
+        with self._lock:
+            if state["done"]:
+                return
+            if p not in self._grafted:
+                state["done"] = True
+                return
+            state["replies"] += 1
+            if (
+                exc is None and isinstance(reply, PutAck)
+                and reply.status == PutAck.STATUS_OK
+            ):
+                state["snaps"].append(decode_kv(reply.value))
+            if len(state["snaps"]) >= need:
+                state["done"] = True
+                kv = self._kv.get(p)
+                if kv is None:
+                    blob = self.store.get(p)
+                    kv = decode_kv(blob) if blob is not None else {}
+                    self._kv[p] = kv
+                for snap in state["snaps"]:
+                    for key, (ver, val) in snap.items():
+                        if ver > kv.get(key, (0, b""))[0]:
+                            kv[key] = (ver, val)
+                self._persist_locked(p)
+                self._grafted.pop(p, None)
+                self.metrics.incr("serving.reconciled_replicas")
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "serving_sync", partition=p, graft=True,
+                        snapshots=len(state["snaps"]),
+                    )
+            elif state["replies"] >= len(others):
+                state["done"] = True
+                retry = True
+        if retry and self._scheduler is not None:
+            self._scheduler.schedule(
+                self.retry_delay_ms, lambda: self._start_graft(p)
+            )
+        # without a scheduler a stuck graft just stays open: this member
+        # keeps abstaining (safe), and a later promotion's sync or a map
+        # move clears it -- unlike _on_snapshot there is no availability
+        # cliff forcing an inline retry, and an inline loop could never
+        # terminate against a RETRY-answering in-process peer
 
     # -- local state ------------------------------------------------------ #
 
@@ -369,6 +499,8 @@ class ServingEngine:
         if row and self.address in row:
             if p in self._acquired and self.store.get(p) is None:
                 return None
+            if p in self._grafted:
+                return None  # handoff copy not yet reconciled (see graft)
             return encode_kv(self._load_locked(p))
         entry = self._retired.get(p)
         return entry[1] if entry is not None else None
@@ -386,6 +518,8 @@ class ServingEngine:
         if row and self.address in row:
             if p in self._acquired and self.store.get(p) is None:
                 return None
+            if p in self._grafted:
+                return None  # handoff copy not yet reconciled (see graft)
             return self._load_locked(p)
         entry = self._retired.get(p)
         return decode_kv(entry[1]) if entry is not None else None

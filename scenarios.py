@@ -38,6 +38,7 @@ The gray-failure quartet (ISSUE 6) rides the same registry:
 """
 
 import json
+import pathlib
 import sys
 import time
 
@@ -636,6 +637,40 @@ def scenario_serving_sawtooth(seed=31, n=16, wave=4, waves=3, ops=80):
     }
 
 
+def scenario_pinned_plan(path, seed=None):
+    """Replay one pinned nemesis-search corpus file (a probe spec JSON
+    written by ``tools/hunt.py --pin``): build the FaultPlan back through
+    the validating builders, run it on its recorded harness, and demand
+    ZERO invariant violations -- each corpus file is the shrunk witness of
+    a bug the search once found, kept as a regression tripwire. ``seed``
+    overrides the plan seed (same fault shape, different interleaving)."""
+    from rapid_tpu.search.runner import run_probe
+
+    with open(path) as fh:
+        spec = json.load(fh)
+    probe = {
+        k: v for k, v in spec.items()
+        if k not in ("name", "description", "expect")
+    }
+    if seed is not None:
+        probe["plan"] = {**probe["plan"], "seed": seed}
+    t0 = time.perf_counter()
+    result = run_probe(probe)
+    return {
+        "config": (
+            f"pinned plan {spec.get('name', path)}: "
+            f"{len(probe['plan'].get('rules', []))} rule(s) on the "
+            f"{probe.get('harness', 'engine')} harness"
+        ),
+        "n": probe.get("n", 5),
+        "virtual_ms": result.info.get("virtual_ms"),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": not result.violated,
+        "violations": [v["invariant"] for v in result.violations],
+        "coverage_signals": len(result.coverage),
+    }
+
+
 # ---------------------------------------------------------------------------
 # the registry table and batteries
 # ---------------------------------------------------------------------------
@@ -672,6 +707,15 @@ BATTERY = [
     "gray-slow-node", "clock-skew", "rolling-upgrade", "serving-sawtooth",
 ]
 SCALE_1M = ["crash-1m", "one-way-loss-1m", "flip-flop-join-1m"]
+
+# every pinned corpus plan (tools/hunt.py --pin scenarios/corpus) joins the
+# registry AND the battery as a regression scenario: the shrunk witness of
+# a violation the nemesis search once found must stay green forever
+_CORPUS_DIR = pathlib.Path(__file__).parent / "scenarios" / "corpus"
+for _pin in sorted(_CORPUS_DIR.glob("*.json")):
+    _name = f"corpus-{_pin.stem}"
+    register(_name, scenario_pinned_plan, path=str(_pin))
+    BATTERY.append(_name)
 
 
 def _flag_value(flag: str) -> str:
@@ -728,6 +772,15 @@ def main() -> None:
         seed = int(arg) if arg.lstrip("-").isdigit() else 7
         print(json.dumps(run_scenario("nemesis-protocol", seed=seed)))
         print(json.dumps(run_scenario("nemesis-smoke", seed=seed)))
+        _write_telemetry()
+        return
+    plan_file = _flag_value("--plan")
+    if plan_file:
+        # replay one probe-spec JSON (pinned corpus file or hand-written):
+        #   python scenarios.py --plan scenarios/corpus/foo.json [--seed 9]
+        seed_arg = _flag_value("--seed")
+        seed = int(seed_arg) if seed_arg else None
+        print(json.dumps(scenario_pinned_plan(plan_file, seed=seed)))
         _write_telemetry()
         return
     chosen = _flag_value("--scenario")

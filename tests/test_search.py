@@ -1,0 +1,513 @@
+"""Nemesis-search suite (ROADMAP item 4, "Jepsen in a box"): plan JSON
+round-trips, invariant-checker kill-tests, generator/probe determinism,
+the guided-beats-unguided coverage contract, and the end-to-end bug demo
+(flag on -> search finds it -> shrinker minimizes it -> pinned corpus
+file reproduces it; flag off -> clean).
+
+The RAPID_BUG_NEWROW_SYNC flag re-introduces the historical serving
+promote-sync hole (new-row sync targets + no graft quarantine); the
+search must rediscover it from scratch and shrink the witness to a
+handful of rules.
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rapid_tpu.faults import FaultPlan
+from rapid_tpu.search.checkers import (
+    INVARIANTS,
+    ClientOp,
+    InvariantViolation,
+    check_config_parity,
+    check_fingerprint_agreement,
+    check_leader_agreement,
+    check_linearizable_history,
+    check_linearizable_single_client,
+    check_view_agreement,
+)
+from rapid_tpu.search.coverage import (
+    coverage_from_fault_actions,
+    coverage_from_journal,
+    transitions,
+)
+from rapid_tpu.search.fabric import ServingFabric
+from rapid_tpu.search.generator import GEN_RULES, PlanGenerator
+from rapid_tpu.search.hunt import Hunter, pin_to_file
+from rapid_tpu.search.runner import run_probe
+from rapid_tpu.types import PutAck
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = sorted((REPO / "scenarios" / "corpus").glob("*.json"))
+
+ENDPOINTS = [f"node:{7000 + i}" for i in range(5)]
+
+# the hand-minimized witness of the historical promote-sync bug: starve
+# one replica of Puts, evict a leader, and mute Get quorum traffic to the
+# fresh replica -- with the flag on, the promoted leader syncs from the
+# new row and crowns the starved copy
+BUG_PLAN = {"seed": 7, "rules": [
+    {"type": "DropRule", "at": "egress", "windows": [[0, None]],
+     "src": None, "dst": "node:7003", "msg_types": ["Put"],
+     "probability": 1.0},
+    {"type": "PartitionRule", "at": "egress", "windows": [[1200, None]],
+     "src": None, "dst": "node:7000", "msg_types": None},
+    {"type": "DropRule", "at": "egress", "windows": [[1200, None]],
+     "src": None, "dst": "node:7002", "msg_types": ["Get"],
+     "probability": 1.0},
+]}
+BUG_SPEC = {"harness": "engine", "n": 5, "partitions": 16, "replicas": 3,
+            "horizon_ms": 4000, "ops": 40, "keys": 6, "plan": BUG_PLAN}
+
+# churn + double eviction with no Get muting: the plan that exercises the
+# graft quarantine (handoff acquirers abstain from quorums until a
+# majority of the pre-join row is merged in)
+GRAFT_PLAN = {"seed": 7, "rules": [
+    {"type": "DropRule", "at": "egress", "windows": [[0, None]],
+     "src": None, "dst": "node:7003", "msg_types": ["Put"],
+     "probability": 1.0},
+    {"type": "PartitionRule", "at": "egress", "windows": [[1200, None]],
+     "src": None, "dst": "node:7000", "msg_types": None},
+    {"type": "SlowNodeRule", "at": "egress", "windows": [[2000, None]],
+     "src": None, "dst": "node:7001", "msg_types": None,
+     "response_delay_ms": 200},
+]}
+
+
+def probe_spec(plan_json, **overrides):
+    spec = dict(BUG_SPEC)
+    spec["plan"] = plan_json
+    spec.update(overrides)
+    return spec
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan JSON round-trip (the corpus file format)
+# ---------------------------------------------------------------------------
+
+
+class TestPlanJson:
+    def test_round_trip_is_identity(self):
+        from rapid_tpu.types import Endpoint, ProbeMessage
+
+        node = Endpoint.from_string("node:7003")
+        plan = (
+            FaultPlan(seed=19)
+            .drop(0.5, dst=node, windows=((100, 900),))
+            .partition_one_way(dst=node, windows=((2000, None),))
+            .slow_node(Endpoint.from_string("node:7001"), 250)
+            .clock_skew(Endpoint.from_string("node:7000"),
+                        offset_ms=200, rate=1.25)
+            .lossy_link(0.05, msg_types=(ProbeMessage,))
+        )
+        data = plan.to_json()
+        rebuilt = FaultPlan.from_json(data)
+        assert rebuilt.to_json() == data
+        assert rebuilt.seed == plan.seed
+        assert len(rebuilt.rules) == len(plan.rules)
+
+    def test_round_trip_survives_json_text(self):
+        plan = FaultPlan.from_json(BUG_PLAN)
+        assert FaultPlan.from_json(
+            json.loads(json.dumps(plan.to_json()))
+        ).to_json() == plan.to_json()
+
+    def test_load_rejects_unknown_rule_type(self):
+        with pytest.raises(ValueError, match="unknown rule type"):
+            FaultPlan.from_json({"seed": 1, "rules": [
+                {"type": "NopeRule", "at": "egress", "windows": [[0, None]],
+                 "src": None, "dst": None, "msg_types": None}]})
+
+    def test_load_reruns_builder_validation(self):
+        # construction-time checks re-run on load: a corpus file cannot
+        # smuggle in a window or probability the builders would reject
+        bad_window = {"type": "DropRule", "at": "egress",
+                      "windows": [[5, 3]], "src": None, "dst": None,
+                      "msg_types": None, "probability": 0.5}
+        with pytest.raises(ValueError, match="can never fire"):
+            FaultPlan.from_json({"seed": 1, "rules": [bad_window]})
+        bad_prob = dict(bad_window, windows=[[0, None]], probability=1.5)
+        with pytest.raises((ValueError, AssertionError)):
+            FaultPlan.from_json({"seed": 1, "rules": [bad_prob]})
+        with pytest.raises(ValueError, match="without a topology"):
+            FaultPlan.from_json({"seed": 1, "rules": [],
+                                 "topology_slots": {"node:7000": 0}})
+
+
+# ---------------------------------------------------------------------------
+# invariant-checker kill-tests: each crafted history must be rejected by
+# exactly the intended invariant (and its benign twin accepted)
+# ---------------------------------------------------------------------------
+
+
+def _op(client, op, key, value, version, status, invoke, complete):
+    return ClientOp(client, op, key, value, version, status, invoke, complete)
+
+
+class TestCheckerKills:
+    def test_lost_acked_write(self):
+        history = [
+            _op("a", "put", b"k", b"v1", 1, PutAck.STATUS_OK, 0, 100),
+            _op("b", "get", b"k", b"", 0, PutAck.STATUS_NOT_FOUND, 200, 210),
+        ]
+        with pytest.raises(InvariantViolation) as err:
+            check_linearizable_history(history)
+        assert err.value.invariant == "linearizability"
+        assert "lost acked write" in err.value.detail
+
+    def test_stale_read(self):
+        history = [
+            _op("a", "put", b"k", b"v1", 1, PutAck.STATUS_OK, 0, 50),
+            _op("a", "put", b"k", b"v2", 2, PutAck.STATUS_OK, 60, 100),
+            _op("b", "get", b"k", b"v1", 1, PutAck.STATUS_OK, 200, 210),
+        ]
+        with pytest.raises(InvariantViolation) as err:
+            check_linearizable_history(history)
+        assert err.value.invariant == "linearizability"
+        assert "stale read" in err.value.detail
+
+    def test_double_leader_write(self):
+        history = [
+            _op("a", "put", b"k", b"va", 3, PutAck.STATUS_OK, 0, 50),
+            _op("b", "put", b"k", b"vb", 3, PutAck.STATUS_OK, 10, 60),
+        ]
+        with pytest.raises(InvariantViolation) as err:
+            check_linearizable_history(history)
+        assert err.value.invariant == "linearizability"
+        assert "double-leader" in err.value.detail
+
+    def test_torn_read(self):
+        history = [
+            _op("a", "put", b"k", b"real", 1, PutAck.STATUS_OK, 0, 50),
+            _op("b", "get", b"k", b"fake", 1, PutAck.STATUS_OK, 100, 110),
+        ]
+        with pytest.raises(InvariantViolation) as err:
+            check_linearizable_history(history)
+        assert err.value.invariant == "linearizability"
+        assert "torn read" in err.value.detail
+
+    def test_non_monotonic_reads(self):
+        history = [
+            _op("a", "put", b"k", b"v2", 2, PutAck.STATUS_OK, 0, 50),
+            _op("b", "get", b"k", b"v2", 2, PutAck.STATUS_OK, 60, 70),
+            _op("c", "get", b"k", b"v2", 1, PutAck.STATUS_OK, 80, 90),
+        ]
+        with pytest.raises(InvariantViolation) as err:
+            check_linearizable_history(history)
+        assert err.value.invariant == "linearizability"
+
+    def test_benign_history_passes(self):
+        history = [
+            _op("a", "put", b"k", b"v1", 1, PutAck.STATUS_OK, 0, 50),
+            _op("b", "get", b"k", b"v1", 1, PutAck.STATUS_OK, 60, 70),
+            _op("a", "put", b"k", b"v2", 2, PutAck.STATUS_OK, 80, 120),
+            _op("b", "get", b"k", b"v2", 2, PutAck.STATUS_OK, 130, 140),
+            # a read that raced the first put may legally miss it
+            _op("c", "get", b"k", b"", 0, PutAck.STATUS_NOT_FOUND, 10, 20),
+            # retried puts carry no obligation
+            _op("c", "put", b"k", b"lost", 0, PutAck.STATUS_RETRY, 150, 160),
+        ]
+        assert check_linearizable_history(history) is None
+
+    def test_view_agreement(self):
+        with pytest.raises(InvariantViolation) as err:
+            check_view_agreement({"n0": (1, 7), "n1": (1, 8)})
+        assert err.value.invariant == "view-agreement"
+        assert check_view_agreement({"n0": (1, 7), "n1": (1, 7)}) is None
+
+    def test_leader_agreement(self):
+        with pytest.raises(InvariantViolation) as err:
+            check_leader_agreement({
+                "n0": ([4], ["node:7000"]), "n1": ([4], ["node:7001"]),
+            })
+        assert err.value.invariant == "view-agreement"
+        assert "split-brain" in err.value.detail
+        assert check_leader_agreement({
+            "n0": ([4], ["node:7000"]), "n1": ([4], ["node:7000"]),
+        }) is None
+
+    def test_config_parity(self):
+        with pytest.raises(InvariantViolation) as err:
+            check_config_parity(11, 12)
+        assert err.value.invariant == "config-parity"
+        assert check_config_parity(11, 11) is None
+
+    def test_fingerprint_agreement(self):
+        diverged = [(3, "n0", "aaaa"), (3, "n1", "bbbb"), (4, "n0", "cccc")]
+        with pytest.raises(InvariantViolation) as err:
+            check_fingerprint_agreement(diverged)
+        assert err.value.invariant == "fingerprint-agreement"
+        assert check_fingerprint_agreement(
+            [(3, "n0", "aaaa"), (3, "n1", "aaaa")]
+        ) is None
+
+    def test_violation_tags_are_closed_set(self):
+        with pytest.raises(AssertionError):
+            InvariantViolation("made-up-invariant", "nope")
+        v = InvariantViolation("linearizability", "witness")
+        assert v.to_json() == {
+            "invariant": "linearizability", "detail": "witness",
+        }
+        assert set(v.to_json()["invariant"].split()) <= set(INVARIANTS)
+
+
+class TestSingleClientPromotion:
+    """check_linearizable_single_client moved out of tests/test_serving.py
+    into the checker module; the serving suite re-imports it from there."""
+
+    def test_reexport_is_the_same_function(self):
+        from rapid_tpu.search import checkers
+
+        assert (check_linearizable_single_client
+                is checkers.check_linearizable_single_client)
+
+    def test_single_client_accepts_and_rejects(self):
+        ok = [
+            ("put", b"k", b"v1", 1, PutAck.STATUS_OK),
+            ("get", b"k", b"v1", 1, PutAck.STATUS_OK),
+        ]
+        assert check_linearizable_single_client(ok) is None
+        stale = ok + [
+            ("put", b"k", b"v2", 2, PutAck.STATUS_OK),
+            ("get", b"k", b"v1", 1, PutAck.STATUS_OK),
+        ]
+        with pytest.raises(AssertionError, match="stale read"):
+            check_linearizable_single_client(stale)
+
+
+# ---------------------------------------------------------------------------
+# generator: determinism + validity + reachability
+# ---------------------------------------------------------------------------
+
+
+class TestGenerator:
+    def test_fresh_is_deterministic(self):
+        a = PlanGenerator(3, ENDPOINTS, 4000)
+        b = PlanGenerator(3, ENDPOINTS, 4000)
+        assert [a.fresh(i) for i in range(12)] == [
+            b.fresh(i) for i in range(12)
+        ]
+
+    def test_mutate_is_deterministic(self):
+        a = PlanGenerator(3, ENDPOINTS, 4000)
+        b = PlanGenerator(3, ENDPOINTS, 4000)
+        base = a.fresh(0)
+        assert [a.mutate(base, i) for i in range(12)] == [
+            b.mutate(base, i) for i in range(12)
+        ]
+
+    def test_every_sample_passes_builder_validation(self):
+        for harness in ("engine", "sim"):
+            gen = PlanGenerator(5, ENDPOINTS, 4000, harness=harness)
+            spec = gen.fresh(0)
+            for i in range(30):
+                FaultPlan.from_json(spec)  # raises on an invalid emission
+                spec = gen.mutate(spec, i) if i % 2 else gen.fresh(i)
+
+    def test_emitted_types_stay_inside_gen_rules(self):
+        gen = PlanGenerator(9, ENDPOINTS, 4000)
+        seen = {
+            rule["type"]
+            for i in range(60) for rule in gen.fresh(i)["rules"]
+        }
+        assert seen <= set(GEN_RULES)
+        # the sampler is not degenerate: a healthy slice of the catalog
+        # appears within a small sample
+        assert len(seen) >= 5
+
+
+# ---------------------------------------------------------------------------
+# probes: determinism + the graft quarantine under churned double eviction
+# ---------------------------------------------------------------------------
+
+
+class TestProbes:
+    def test_engine_probe_is_deterministic(self):
+        first = run_probe(BUG_SPEC)
+        second = run_probe(BUG_SPEC)
+        assert first.coverage == second.coverage
+        assert first.violations == second.violations
+        assert first.info == second.info
+
+    def test_probe_coverage_has_catalog_transitions(self):
+        result = run_probe(BUG_SPEC)
+        kinds = {s[1] for s in result.coverage if s[0] == "kind"}
+        assert {"view_install", "handoff_started", "kicked"} <= kinds
+        assert transitions(result.coverage)
+
+    def test_graft_quarantine_under_double_eviction(self):
+        """Churn + a second eviction: every mid-stream acquirer must pull a
+        majority of its pre-join row before answering quorums (the fix for
+        the chained-view staleness hole this search found), and the run
+        must be linearizable with the fix in."""
+        fabric = ServingFabric(
+            FaultPlan.from_json(GRAFT_PLAN), n=5, partitions=16, replicas=3,
+        )
+        fabric.run(5000, 40, keys=6)
+        events = [e["kind"] for e in fabric.journal()]
+        assert events.count("kicked") == 2, "plan must evict twice"
+        grafts = [
+            e for e in fabric.journal()
+            if e["kind"] == "serving_sync" and e["detail"].get("graft")
+        ]
+        assert grafts, "double eviction must route copies through the graft"
+        assert fabric.metrics.get("serving.reconciled_replicas") == len(grafts)
+        result = run_probe(probe_spec(GRAFT_PLAN, horizon_ms=5000))
+        assert not result.violations, result.violations
+
+    def test_fault_action_coverage_feeds_guidance(self):
+        result = run_probe(BUG_SPEC)
+        fault_signals = {s for s in result.coverage if s[0] == "fault"}
+        assert any(name.startswith("nemesis_dropped")
+                   for _, name in fault_signals)
+        # the extractor ignores non-nemesis and zero-valued series
+        assert coverage_from_fault_actions(
+            {"nemesis_dropped{at=egress}": 2.0, "nemesis_slowed": 0.0,
+             "view_changes": 5.0}
+        ) == frozenset({("fault", "nemesis_dropped{at=egress}")})
+
+    def test_journal_coverage_bigram_extraction(self):
+        journal = [
+            {"seq": i, "kind": kind}
+            for i, kind in enumerate(
+                ("fd_signal", "view_install", "not-in-catalog", "kicked")
+            )
+        ]
+        cov = coverage_from_journal(journal)
+        assert ("edge", "fd_signal", "view_install") in cov
+        assert ("kind", "kicked") in cov
+        assert ("edge", "fd_signal", "view_install") in transitions(cov)
+        # edges through unknown kinds are not catalog transitions
+        assert all("not-in-catalog" not in t for t in transitions(cov))
+
+
+# ---------------------------------------------------------------------------
+# the hunter: budget, determinism, and the guided-coverage contract
+# ---------------------------------------------------------------------------
+
+
+class TestHunter:
+    def test_budgeted_hunt_runs_clean_without_the_bug(self):
+        report = Hunter(seed=0, budget=200, harness="engine").run()
+        assert report.probes == 200
+        assert report.violations == []
+        assert report.corpus, "a 200-probe hunt must grow a corpus"
+        assert report.transition_count() >= 10
+
+    def test_hunt_is_deterministic_per_seed(self):
+        a = Hunter(seed=5, budget=15, harness="engine", shrink=False).run()
+        b = Hunter(seed=5, budget=15, harness="engine", shrink=False).run()
+        assert a.to_json() == b.to_json()
+        assert a.coverage == b.coverage
+        assert a.corpus == b.corpus
+
+    def test_guided_visits_more_transitions_than_unguided(self):
+        """The coverage-bias contract: at the same budget and seed, mutating
+        coverage-fresh corpus members must visit strictly more distinct
+        EVENT_CATALOG transitions than blind fresh sampling."""
+        guided = Hunter(seed=12, budget=40, harness="engine",
+                        guided=True, shrink=False).run()
+        unguided = Hunter(seed=12, budget=40, harness="engine",
+                          guided=False, shrink=False).run()
+        assert guided.transition_count() > unguided.transition_count(), (
+            f"guided {guided.transition_count()} vs "
+            f"unguided {unguided.transition_count()}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# end-to-end bug demo: flag on -> found -> shrunk -> pinned -> reproduces
+# ---------------------------------------------------------------------------
+
+
+class TestBugDemo:
+    def test_flagged_bug_reproduces_and_fix_holds(self, monkeypatch):
+        monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
+        buggy = run_probe(BUG_SPEC)
+        assert {v["invariant"] for v in buggy.violations} == {
+            "linearizability"
+        }
+        monkeypatch.delenv("RAPID_BUG_NEWROW_SYNC")
+        assert not run_probe(BUG_SPEC).violations
+
+    def test_search_finds_shrinks_and_pins_the_bug(self, monkeypatch,
+                                                   tmp_path):
+        monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
+        report = Hunter(seed=11, budget=120, harness="engine",
+                        shrink_budget=150).run()
+        assert report.violations, "the search must rediscover the bug"
+        assert report.pinned
+        pin = report.pinned[0]
+        assert "linearizability" in pin["kinds"]
+        shrunk_rules = pin["spec"]["plan"]["rules"]
+        assert len(shrunk_rules) <= 3, shrunk_rules
+
+        path = tmp_path / "pin.json"
+        pin_to_file(pin, str(path), "pin", "test pin")
+        artifact = json.loads(path.read_text())
+        FaultPlan.from_json(artifact["plan"])  # validation re-runs on load
+        probe = {
+            k: v for k, v in artifact.items()
+            if k not in ("name", "description", "expect")
+        }
+        assert run_probe(probe).violated, "pinned plan must reproduce"
+        monkeypatch.delenv("RAPID_BUG_NEWROW_SYNC")
+        assert not run_probe(probe).violated, "fix must hold on the pin"
+
+
+# ---------------------------------------------------------------------------
+# the pinned corpus + scenarios.py integration
+# ---------------------------------------------------------------------------
+
+
+class TestCorpus:
+    def test_corpus_exists(self):
+        assert CORPUS, "scenarios/corpus must hold at least one pinned plan"
+
+    @pytest.mark.parametrize(
+        "path", CORPUS, ids=[p.stem for p in CORPUS]
+    )
+    def test_pin_loads_and_stays_green(self, path):
+        artifact = json.loads(path.read_text())
+        assert set(artifact["expect"]["invariants"]) <= set(INVARIANTS)
+        FaultPlan.from_json(artifact["plan"])
+        probe = {
+            k: v for k, v in artifact.items()
+            if k not in ("name", "description", "expect")
+        }
+        result = run_probe(probe)
+        assert not result.violations, (
+            f"regression: pinned plan {path.name} violates "
+            f"{[v['invariant'] for v in result.violations]} again"
+        )
+
+    @pytest.mark.parametrize(
+        "path", CORPUS, ids=[p.stem for p in CORPUS]
+    )
+    def test_pin_still_witnesses_the_flagged_bug(self, path, monkeypatch):
+        artifact = json.loads(path.read_text())
+        probe = {
+            k: v for k, v in artifact.items()
+            if k not in ("name", "description", "expect")
+        }
+        monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
+        result = run_probe(probe)
+        assert {v["invariant"] for v in result.violations} == set(
+            artifact["expect"]["invariants"]
+        )
+
+    def test_scenarios_registry_carries_the_corpus(self):
+        import scenarios
+
+        names = [f"corpus-{p.stem}" for p in CORPUS]
+        for name in names:
+            assert name in scenarios.REGISTRY
+            assert name in scenarios.BATTERY
+            fn, params = scenarios.REGISTRY[name]
+            assert fn is scenarios.scenario_pinned_plan
+            assert pathlib.Path(params["path"]).exists()

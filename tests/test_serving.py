@@ -644,40 +644,10 @@ def test_sim_serving_nemesis_replayable():
         assert back.status == PutAck.STATUS_OK and back.version >= version
 
 
-def check_linearizable_single_client(history) -> None:
-    """Per-key linearizability for a single sequential client (the seed of
-    ROADMAP item 5's checker): acked-put versions strictly increase, and
-    every successful read returns either the latest acked write or a newer
-    version whose value matches a write the client attempted (a RETRY'd put
-    that partially replicated is allowed to surface -- it is a concurrent
-    write, not a corruption)."""
-    acked: dict = {}
-    attempted: dict = {}
-    for op, key, value, version, status in history:
-        if op == "put":
-            attempted.setdefault(key, set()).add(value)
-            if status == PutAck.STATUS_OK:
-                prev = acked.get(key)
-                assert prev is None or version > prev[0], (
-                    f"acked version regressed on {key!r}"
-                )
-                acked[key] = (version, value)
-        elif op == "get" and status == PutAck.STATUS_OK:
-            prev = acked.get(key)
-            if prev is None:
-                assert value in attempted.get(key, set()), (
-                    f"read of {key!r} returned a value never written"
-                )
-                continue
-            assert version >= prev[0], (
-                f"stale read on {key!r}: {version} < acked {prev[0]}"
-            )
-            if version == prev[0]:
-                assert value == prev[1], f"torn read on {key!r}"
-            else:
-                assert value in attempted[key], (
-                    f"read of {key!r} returned a value never written"
-                )
+# promoted to the nemesis-search checker module (single source of truth);
+# re-exported here because this file is where the checker grew up and
+# other suites import it from here
+from rapid_tpu.search.checkers import check_linearizable_single_client  # noqa: E402
 
 
 def test_sim_serving_history_linearizable():

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import ast
 import importlib.util
+import json
 import sys
 from pathlib import Path
 
@@ -62,6 +63,10 @@ RULE_DOCS = {
     "unknown-span": "span/event names outside the catalog fork the trace",
     "wire-tag": "wire tags must stay unique and append-only across versions",
     "fault-catalog": "fault rules must declare a compiled/absorbed story",
+    "plan-corpus": "pinned nemesis plans must stay loadable: known rule "
+                   "types, sane windows/probabilities, a known harness",
+    "gen-reach": "every fault Rule subclass must be reachable by the search "
+                 "generator (GEN_RULES), or new faults stay untested",
     # tools/check.py -- concurrency hygiene
     "thread-daemon": "a non-daemon thread outlives shutdown and hangs exit; "
                      "mark daemon=True or provably join it",
@@ -522,17 +527,7 @@ def check_fault_rules() -> list[Finding]:
     enforced by the unknown-metric rule on the same files.)"""
     findings: list[Finding] = []
     path = REPO / "rapid_tpu" / "faults.py"
-    tree = ast.parse(path.read_text(), filename=str(path))
-
-    rule_classes: dict[str, int] = {}
-    known = {"Rule"}
-    for node in tree.body:
-        if not isinstance(node, ast.ClassDef):
-            continue
-        bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
-        if bases & known:
-            known.add(node.name)
-            rule_classes[node.name] = node.lineno
+    rule_classes = _rule_subclasses(path)
 
     lits = _module_literals(path, {"RULE_CATALOG"})
     if "RULE_CATALOG" not in lits:
@@ -563,6 +558,127 @@ def check_fault_rules() -> list[Finding]:
                 f"RULE_CATALOG[{name!r}] must be 'compiled' or 'absorbed', "
                 f"got {story!r}",
             ))
+    return findings
+
+
+def _rule_subclasses(path: Path) -> "dict[str, int]":
+    """Transitive Rule subclasses defined in a faults module, by AST walk
+    (no import): {class name: lineno}."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rule_classes: dict[str, int] = {}
+    known = {"Rule"}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+        if bases & known:
+            known.add(node.name)
+            rule_classes[node.name] = node.lineno
+    return rule_classes
+
+
+def check_generator_reach() -> list[Finding]:
+    """Generator-reachability lint (the GEN_RULES sync discipline).
+
+    The nemesis search can only find bugs in faults it can emit:
+    rapid_tpu/search/generator.py keeps GEN_RULES, the literal tuple of
+    Rule subclasses its sampler draws from, and this lint pins it against
+    the Rule subclasses actually defined in rapid_tpu/faults.py -- the
+    same two-sided freshness contract RULE_CATALOG has. A new fault rule
+    that never enters GEN_RULES would silently stay outside every hunt;
+    a GEN_RULES entry with no backing class would crash the sampler."""
+    findings: list[Finding] = []
+    gen_path = REPO / "rapid_tpu" / "search" / "generator.py"
+    rule_classes = _rule_subclasses(REPO / "rapid_tpu" / "faults.py")
+
+    lits = _module_literals(gen_path, {"GEN_RULES"})
+    if "GEN_RULES" not in lits:
+        findings.append(Finding(
+            gen_path, 0, "gen-reach",
+            "GEN_RULES not found or not a pure literal",
+        ))
+        return findings
+    gen_rules, line = lits["GEN_RULES"]
+
+    for name in sorted(set(rule_classes) - set(gen_rules)):
+        findings.append(Finding(
+            gen_path, line, "gen-reach",
+            f"Rule subclass {name!r} missing from GEN_RULES: the nemesis "
+            "search can never emit it, so it ships untested",
+        ))
+    for name in sorted(set(gen_rules) - set(rule_classes)):
+        findings.append(Finding(
+            gen_path, line, "gen-reach",
+            f"GEN_RULES lists {name!r} but no such Rule subclass exists "
+            "in rapid_tpu/faults.py",
+        ))
+    return findings
+
+
+def check_plan_corpus() -> list[Finding]:
+    """Pinned-plan corpus lint over scenarios/corpus/*.json.
+
+    Each corpus file is the shrunk witness of a violation the nemesis
+    search once found, auto-registered by scenarios.py as a regression
+    scenario -- so a malformed pin fails silently at the worst moment (the
+    regression stops running). Stdlib-only checks: the JSON parses, the
+    harness is known, the plan carries an int seed and non-empty rules,
+    every rule type is a RULE_CATALOG class, windows are sane
+    [start, end|null] pairs, and probabilities sit in (0, 1]."""
+    findings: list[Finding] = []
+    corpus = sorted((REPO / "scenarios" / "corpus").glob("*.json"))
+    catalog = set(_rule_subclasses(REPO / "rapid_tpu" / "faults.py"))
+
+    def bad(path: Path, msg: str) -> None:
+        findings.append(Finding(path, 1, "plan-corpus", msg))
+
+    for path in corpus:
+        try:
+            spec = json.loads(path.read_text())
+        except json.JSONDecodeError as exc:
+            bad(path, f"not valid JSON: {exc}")
+            continue
+        if not isinstance(spec, dict):
+            bad(path, "top level must be a probe-spec object")
+            continue
+        if spec.get("harness") not in ("engine", "sim"):
+            bad(path, f"unknown harness {spec.get('harness')!r}")
+        plan = spec.get("plan")
+        if not isinstance(plan, dict):
+            bad(path, "missing 'plan' object (FaultPlan.to_json dict)")
+            continue
+        if not isinstance(plan.get("seed"), int):
+            bad(path, "plan.seed must be an int (determinism anchor)")
+        rules = plan.get("rules")
+        if not isinstance(rules, list) or not rules:
+            bad(path, "plan.rules must be a non-empty list (an empty pin "
+                      "witnesses nothing)")
+            continue
+        for i, rule in enumerate(rules):
+            if not isinstance(rule, dict):
+                bad(path, f"rules[{i}] is not an object")
+                continue
+            kind = rule.get("type")
+            if kind not in catalog:
+                bad(path, f"rules[{i}].type {kind!r} is not a Rule subclass "
+                          "in rapid_tpu/faults.py")
+            for window in rule.get("windows") or []:
+                if (
+                    not isinstance(window, list) or len(window) != 2
+                    or not isinstance(window[0], int) or window[0] < 0
+                    or not (
+                        window[1] is None
+                        or (isinstance(window[1], int)
+                            and window[1] > window[0])
+                    )
+                ):
+                    bad(path, f"rules[{i}] window {window!r} is not a sane "
+                              "[start_ms, end_ms|null] pair")
+            prob = rule.get("probability")
+            if prob is not None and not (
+                isinstance(prob, (int, float)) and 0 < prob <= 1
+            ):
+                bad(path, f"rules[{i}].probability {prob!r} outside (0, 1]")
     return findings
 
 
@@ -669,6 +785,8 @@ def run(paths: "list[str] | None" = None) -> list[Finding]:
         findings.extend(check_file(f))
     findings.extend(check_wire_tags())
     findings.extend(check_fault_rules())
+    findings.extend(check_generator_reach())
+    findings.extend(check_plan_corpus())
     return findings
 
 

@@ -1,0 +1,98 @@
+"""Coverage-guided nemesis search CLI ("Jepsen in a box", ROADMAP item 4).
+
+Drives a budgeted :class:`rapid_tpu.search.hunt.Hunter` run: sample or
+mutate FaultPlans, execute each as a probe on the chosen harness, check
+invariants (linearizability, view agreement, config parity, fingerprint
+agreement), bias generation toward unvisited coverage signals, shrink
+the first witness of each violation kind, and print a corpus/coverage
+report. Everything is deterministic per --seed.
+
+    python tools/hunt.py --budget 200                  # engine harness
+    python tools/hunt.py --harness sim --budget 20     # simulator replay
+    python tools/hunt.py --unguided                    # coverage bias off
+    python tools/hunt.py --pin scenarios/corpus        # write shrunk plans
+    python tools/hunt.py --json                        # machine-readable
+
+Pinned plans land as scenarios/corpus/*.json, which scenarios.py
+auto-registers into the battery as regression scenarios.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="coverage-guided nemesis search over FaultPlans"
+    )
+    parser.add_argument("--seed", type=int, default=0,
+                        help="search seed (same seed -> same hunt)")
+    parser.add_argument("--budget", type=int, default=200,
+                        help="number of probes to run")
+    parser.add_argument("--harness", choices=("engine", "sim"),
+                        default="engine",
+                        help="engine: real ServingEngines on the virtual-"
+                             "time fabric; sim: device-plane replay on the "
+                             "Simulator (slower, needs jax)")
+    parser.add_argument("--unguided", action="store_true",
+                        help="disable the coverage-bias corpus (baseline "
+                             "random search)")
+    parser.add_argument("--no-shrink", action="store_true",
+                        help="report violations without minimizing them")
+    parser.add_argument("--shrink-budget", type=int, default=200,
+                        help="probe budget per shrink")
+    parser.add_argument("--pin", metavar="DIR",
+                        help="write each shrunk violation to DIR as a "
+                             "corpus JSON (scenarios.py auto-registers "
+                             "scenarios/corpus/*.json)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON instead of text")
+    args = parser.parse_args(argv)
+
+    from rapid_tpu.search.hunt import Hunter, pin_to_file
+
+    hunter = Hunter(
+        seed=args.seed, budget=args.budget, harness=args.harness,
+        guided=not args.unguided, shrink=not args.no_shrink,
+        shrink_budget=args.shrink_budget,
+    )
+    report = hunter.run()
+
+    written = []
+    if args.pin:
+        pin_dir = Path(args.pin)
+        pin_dir.mkdir(parents=True, exist_ok=True)
+        for i, pin in enumerate(report.pinned):
+            kinds = "-".join(pin["kinds"])
+            name = f"hunt-s{args.seed}-{args.harness}-{kinds}-{i}"
+            path = pin_dir / f"{name}.json"
+            pin_to_file(
+                pin, str(path), name,
+                f"shrunk by tools/hunt.py --seed {args.seed} "
+                f"--budget {args.budget} --harness {args.harness}",
+            )
+            written.append(str(path))
+
+    if args.json:
+        out = report.to_json()
+        out["pinned_files"] = written
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        print(report.report_text())
+        for path in written:
+            print(f"  wrote {path}")
+    return 1 if report.violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
