@@ -78,7 +78,7 @@ WATCHDOG_S = 20 * 60
 # against a dying tunnel) must emit it rather than destroy it.
 _PROGRESS: dict = {
     "headline": None, "backend": None, "sweep": [], "wan": None,
-    "serving": None,
+    "serving": None, "messaging": None,
 }
 
 # jitwatch compile accounting of the most recent warmed_run (warmup vs
@@ -104,6 +104,21 @@ SERVING_PUT_FRACTION = 0.2
 # rapid_tpu/faults.py:apply_topology). 0 = the flat-fabric control point.
 WAN_N_NODES = 2_000
 WAN_RTTS_MS = (0, 500, 1000)
+
+# Messaging dimension: real-socket transport throughput on loopback. Two
+# workloads -- a pipelined request/response pair (RPC round-trip rate) and a
+# 16-node broadcast storm (every node broadcasts BURST votes per round to
+# every peer through the flush-window batching broadcaster) -- plus an
+# in-bench thread-per-message baseline reproducing the pre-event-loop
+# transport shape (blocking sendall per message: one write syscall per
+# message by construction) for the A/B speedup and syscall-reduction
+# numbers in the JSON line.
+MESSAGING_PAIR_MSGS = 2_000
+MESSAGING_STORM_NODES = 16
+MESSAGING_STORM_ROUNDS = 40
+MESSAGING_STORM_BURST = 8
+MESSAGING_FLUSH_WINDOW_MS = 5
+MESSAGING_DEADLINE_S = 120.0
 
 
 def _stable_view_hist() -> "dict | None":
@@ -217,6 +232,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "sweep": merged,
                 "wan_stable_view": _PROGRESS["wan"],
                 "serving_qps": _PROGRESS["serving"],
+                "messaging_throughput": _PROGRESS["messaging"],
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "placement_partitions_moved": _placement_hist(),
                 "handoff_session_bytes": _handoff_hist(),
@@ -482,6 +498,17 @@ def run_sweep(backend: str, seed: int) -> list:
         _PROGRESS["serving"] = {"error": f"{type(exc).__name__}: {exc}"}
         print(f"bench.py: serving dimension failed: {exc}", file=sys.stderr,
               flush=True)
+    # messaging dimension: real-socket transport throughput (loopback pair,
+    # broadcast storm, thread-per-message A/B baseline); same ride-along
+    # policy -- a stalled delivery keeps the artifact with an error entry
+    try:
+        run_messaging_dimension(seed)
+    except AssertionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 -- keep the artifact
+        _PROGRESS["messaging"] = {"error": f"{type(exc).__name__}: {exc}"}
+        print(f"bench.py: messaging dimension failed: {exc}", file=sys.stderr,
+              flush=True)
     return out
 
 
@@ -627,6 +654,397 @@ def run_serving_dimension(seed: int) -> dict:
         round(1000.0 * total_ops / total_ms, 1) if total_ms else None
     )
     _PROGRESS["serving"] = entry
+    return entry
+
+
+def _messaging_rate(count: int, wall_s: float, nbytes: float = 0.0) -> dict:
+    return {
+        "messages": count,
+        "wall_ms": round(wall_s * 1000.0, 1),
+        "messages_per_s": round(count / wall_s, 1) if wall_s > 0 else None,
+        "bytes_per_s": round(nbytes / wall_s, 1) if wall_s > 0 else None,
+    }
+
+
+def _messaging_loopback_pair() -> dict:
+    """Pipelined RPC round-trips over one loopback connection: every probe
+    is answered (the transport's built-in BOOTSTRAPPING responder), so the
+    rate includes framing, codec, dispatch, and the response path."""
+    from rapid_tpu.messaging.ports import free_port
+    from rapid_tpu.settings import Settings
+    from rapid_tpu.types import Endpoint, ProbeMessage, ProbeResponse
+
+    from rapid_tpu.messaging.tcp import TcpClientServer
+
+    settings = Settings(message_timeout_ms=int(MESSAGING_DEADLINE_S * 1000))
+    server = TcpClientServer(
+        Endpoint.from_parts("127.0.0.1", free_port()), settings
+    )
+    server.start()
+    client = TcpClientServer(Endpoint.from_parts("127.0.0.1", 0), settings)
+    me = client.address
+    try:
+        probe = ProbeMessage(sender=me)
+        # warm the dial + first flush before the timed window
+        assert isinstance(
+            client.send_message_best_effort(
+                server.address, probe
+            ).result(MESSAGING_DEADLINE_S),
+            ProbeResponse,
+        )
+        t0 = time.perf_counter()
+        promises = [
+            client.send_message_best_effort(server.address, probe)
+            for _ in range(MESSAGING_PAIR_MSGS)
+        ]
+        for p in promises:
+            p.result(MESSAGING_DEADLINE_S)
+        wall_s = time.perf_counter() - t0
+        sent = client.metrics.snapshot()
+        return {
+            **_messaging_rate(
+                MESSAGING_PAIR_MSGS, wall_s, sent.get("msg.bytes_sent", 0)
+            ),
+            "flush_syscalls_per_msg": round(
+                sent.get("msg.flush_syscalls", 0)
+                / max(1, sent.get("msg.sent", 0)),
+                3,
+            ),
+        }
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def _messaging_reactor_storm() -> dict:
+    """The broadcast storm on the event-loop transport: every node
+    broadcasts BURST votes per round through the flush-window batching
+    broadcaster, so per-peer traffic leaves as MessageBatch envelopes and
+    the reactor coalesces whatever accumulates per tick into single
+    writes. Counts are exact: the dimension waits until every inner
+    message has been dispatched on its destination node."""
+    from rapid_tpu.messaging.ports import free_port_base
+    from rapid_tpu.messaging.tcp import TcpClientServer
+    from rapid_tpu.messaging.unicast import UnicastToAllBroadcaster
+    from rapid_tpu.messaging.retries import wall_scheduler
+    from rapid_tpu.runtime.futures import Promise
+    from rapid_tpu.settings import Settings
+    from rapid_tpu.types import (
+        Endpoint,
+        FastRoundPhase2bMessage,
+        MessageBatch,
+        Response,
+    )
+
+    n = MESSAGING_STORM_NODES
+    rounds, burst = MESSAGING_STORM_ROUNDS, MESSAGING_STORM_BURST
+    settings = Settings(
+        message_timeout_ms=int(MESSAGING_DEADLINE_S * 1000),
+        broadcast_flush_window_ms=MESSAGING_FLUSH_WINDOW_MS,
+    )
+    base = free_port_base(n)
+    addrs = [Endpoint.from_parts("127.0.0.1", base + i) for i in range(n)]
+    received = threading.Semaphore(0)
+
+    class _CountingService:
+        """Destination-side sink: unwraps batch envelopes and releases one
+        semaphore permit per inner vote."""
+
+        def handle_message(self, msg):
+            if isinstance(msg, MessageBatch):
+                received.release(len(msg.messages))
+            else:
+                received.release()
+            return Promise.completed(Response())
+
+    nodes = []
+    try:
+        for addr in addrs:
+            node = TcpClientServer(addr, settings)
+            node.set_membership_service(_CountingService())
+            node.start()
+            nodes.append(node)
+        casters = [
+            UnicastToAllBroadcaster(
+                node, settings=settings, scheduler=wall_scheduler(),
+                my_addr=node.address,
+            )
+            for node in nodes
+        ]
+        for caster in casters:
+            caster.set_membership(list(addrs))
+        expected = n * (n - 1) * rounds * burst
+
+        def drive(i):
+            vote = FastRoundPhase2bMessage(
+                sender=addrs[i], configuration_id=-1, endpoints=(addrs[i],)
+            )
+            for _ in range(rounds):
+                for _ in range(burst):
+                    casters[i].broadcast(vote)
+
+        t0 = time.perf_counter()
+        drivers = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in drivers:
+            t.start()
+        for t in drivers:
+            t.join(MESSAGING_DEADLINE_S)
+        deadline = time.time() + MESSAGING_DEADLINE_S
+        for _ in range(expected):
+            if not received.acquire(timeout=max(0.0, deadline - time.time())):
+                raise RuntimeError("storm delivery stalled")
+        wall_s = time.perf_counter() - t0
+
+        sent, syscalls, nbytes = 0, 0, 0
+        for node in nodes:
+            snap = node.metrics.snapshot()
+            sent += snap.get("msg.sent", 0)
+            syscalls += snap.get("msg.flush_syscalls", 0)
+            nbytes += snap.get("msg.bytes_sent", 0)
+        return {
+            "n": n,
+            "rounds": rounds,
+            "burst": burst,
+            **_messaging_rate(expected, wall_s, nbytes),
+            "frames_sent": sent,
+            "flush_syscalls": syscalls,
+            "flush_syscalls_per_msg": round(syscalls / expected, 4),
+        }
+    finally:
+        for node in nodes:
+            node.shutdown()
+
+
+def _messaging_threaded_baseline() -> dict:
+    """The pre-event-loop transport shape, reproduced in-bench for the A/B
+    numbers: a reader thread per accepted connection that decodes every
+    frame and writes back a Response under the connection's write lock, a
+    response-reader thread per outbound connection that decodes and matches
+    replies against the per-node outstanding table, a Promise per request
+    armed on the shared timeout-wheel heap (the old transport's
+    ``_TimeoutWheel.arm``: heappush + notify under one condition, one
+    scanning deadline thread), and one blocking ``sendall`` per message (so
+    exactly one write syscall per message per direction, by construction).
+    Same storm workload, same codec, same RPC bookkeeping -- minus the
+    reactor, the coalescing, and the batch envelopes, which is precisely
+    the A/B."""
+    import heapq
+    import itertools
+    import socket as socket_mod
+
+    from rapid_tpu.messaging.codec import HEADER, decode, encode
+    from rapid_tpu.messaging.tcp import _read_frame
+    from rapid_tpu.runtime.futures import Promise
+    from rapid_tpu.types import Endpoint, FastRoundPhase2bMessage, Response
+
+    n = MESSAGING_STORM_NODES
+    rounds, burst = MESSAGING_STORM_ROUNDS, MESSAGING_STORM_BURST
+    expected = n * (n - 1) * rounds * burst
+    received = threading.Semaphore(0)
+    listeners, socks = [], []
+
+    # the pre-PR shared timeout wheel, verbatim shape: one heap, one
+    # condition, one scanning deadline thread; arm() is a heappush + notify
+    # per request, and completed promises simply expire off the heap
+    wheel_heap: list = []
+    wheel_seq = itertools.count()
+    wheel_cond = threading.Condition()
+    wheel_done = False
+
+    def wheel_arm(timeout_s, promise):
+        deadline = time.monotonic() + timeout_s
+        with wheel_cond:
+            heapq.heappush(wheel_heap, (deadline, next(wheel_seq), promise))
+            wheel_cond.notify()
+
+    def wheel_loop():
+        while True:
+            with wheel_cond:
+                while not wheel_heap:
+                    if wheel_done:
+                        return
+                    wheel_cond.wait()
+                delay = wheel_heap[0][0] - time.monotonic()
+                if delay > 0:
+                    if wheel_done:
+                        return
+                    wheel_cond.wait(delay)
+                    continue
+                _, _, promise = heapq.heappop(wheel_heap)
+            if not promise.done():
+                promise.try_set_exception(TimeoutError("baseline timeout"))
+
+    threading.Thread(target=wheel_loop, daemon=True).start()
+
+    def server_reader(sock):
+        """Pre-PR server half: decode, dispatch (counted), respond inline
+        under the connection write lock."""
+        wlock = threading.Lock()
+        try:
+            while True:
+                frame = _read_frame(sock)
+                if frame is None:
+                    return
+                request_no, _msg = decode(frame)
+                received.release()
+                resp = encode(request_no, Response())
+                with wlock:
+                    sock.sendall(HEADER.pack(len(resp)) + resp)
+        except OSError:
+            pass
+
+    def acceptor(listener):
+        try:
+            while True:
+                sock, _ = listener.accept()
+                socks.append(sock)
+                threading.Thread(
+                    target=server_reader, args=(sock,), daemon=True
+                ).start()
+        except OSError:
+            pass
+
+    def response_reader(sock, outstanding, lock):
+        """Pre-PR client half: match every reply against the outstanding
+        table (the per-message bookkeeping the old reader threads did)."""
+        try:
+            while True:
+                frame = _read_frame(sock)
+                if frame is None:
+                    return
+                request_no, resp = decode(frame)
+                with lock:
+                    promise = outstanding.pop(request_no, None)
+                if promise is not None:
+                    promise.try_set_result(resp)
+        except OSError:
+            pass
+
+    try:
+        ports = []
+        for _ in range(n):
+            listener = socket_mod.socket()
+            listener.bind(("127.0.0.1", 0))
+            listener.listen(n)
+            ports.append(listener.getsockname()[1])
+            listeners.append(listener)
+            threading.Thread(
+                target=acceptor, args=(listener,), daemon=True
+            ).start()
+        # node i: one blocking socket + response reader per peer, dialed up
+        # front; one outstanding table per node (as the old transport kept)
+        peers, tables = [], []
+        for i in range(n):
+            row = []
+            outstanding, lock = {}, threading.Lock()
+            tables.append((outstanding, lock))
+            for j in range(n):
+                if j == i:
+                    continue
+                sock = socket_mod.create_connection(
+                    ("127.0.0.1", ports[j]), timeout=MESSAGING_DEADLINE_S
+                )
+                sock.setsockopt(
+                    socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1
+                )
+                socks.append(sock)
+                threading.Thread(
+                    target=response_reader, args=(sock, outstanding, lock),
+                    daemon=True,
+                ).start()
+                row.append((sock, threading.Lock()))
+            peers.append(row)
+
+        def drive(i):
+            vote = FastRoundPhase2bMessage(
+                sender=Endpoint.from_parts("127.0.0.1", ports[i]),
+                configuration_id=-1,
+                endpoints=(Endpoint.from_parts("127.0.0.1", ports[i]),),
+            )
+            request_no = itertools.count()
+            outstanding, lock = tables[i]
+            for _ in range(rounds):
+                for _ in range(burst):
+                    for sock, wlock in peers[i]:
+                        no_ = next(request_no)
+                        frame = encode(no_, vote)
+                        out = Promise()
+                        with lock:
+                            outstanding[no_] = out
+                        with wlock:
+                            # one write syscall per message, pre-PR style
+                            sock.sendall(HEADER.pack(len(frame)) + frame)
+                        wheel_arm(MESSAGING_DEADLINE_S, out)
+
+        t0 = time.perf_counter()
+        drivers = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(n)
+        ]
+        for t in drivers:
+            t.start()
+        for t in drivers:
+            t.join(MESSAGING_DEADLINE_S)
+        deadline = time.time() + MESSAGING_DEADLINE_S
+        for _ in range(expected):
+            if not received.acquire(timeout=max(0.0, deadline - time.time())):
+                raise RuntimeError("baseline delivery stalled")
+        wall_s = time.perf_counter() - t0
+        vote = FastRoundPhase2bMessage(
+            sender=Endpoint.from_parts("127.0.0.1", ports[0]),
+            configuration_id=-1,
+            endpoints=(Endpoint.from_parts("127.0.0.1", ports[0]),),
+        )
+        vote_wire = HEADER.size + len(encode(0, vote))
+        return {
+            **_messaging_rate(expected, wall_s, float(expected * vote_wire)),
+            "flush_syscalls_per_msg": 1.0,  # by construction
+        }
+    finally:
+        with wheel_cond:
+            wheel_done = True
+            wheel_heap.clear()
+            wheel_cond.notify()
+        for sock in socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for listener in listeners:
+            try:
+                listener.close()
+            except OSError:
+                pass
+
+
+def run_messaging_dimension(seed: int) -> dict:
+    """The transport curve: loopback RPC round-trip rate, the 16-node
+    broadcast storm on the event-loop core, and the thread-per-message
+    baseline -- with the A/B speedup and write-syscall reduction that the
+    event-loop refactor (reactor coalescing + MessageBatch envelopes)
+    buys on storm traffic."""
+    del seed  # workload is fixed; socket timing is inherently wall-clock
+    entry = {
+        "loopback_pair": _messaging_loopback_pair(),
+        "broadcast_storm": _messaging_reactor_storm(),
+        "threaded_baseline": _messaging_threaded_baseline(),
+    }
+    storm = entry["broadcast_storm"]
+    baseline = entry["threaded_baseline"]
+    if storm["messages_per_s"] and baseline["messages_per_s"]:
+        entry["speedup_vs_threaded"] = round(
+            storm["messages_per_s"] / baseline["messages_per_s"], 2
+        )
+    if storm["flush_syscalls_per_msg"]:
+        entry["syscall_reduction_vs_threaded"] = round(
+            baseline["flush_syscalls_per_msg"]
+            / storm["flush_syscalls_per_msg"],
+            1,
+        )
+    _PROGRESS["messaging"] = entry
     return entry
 
 

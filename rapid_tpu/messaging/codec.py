@@ -38,6 +38,24 @@ _enc_memo: "OrderedDict[int, Tuple[tuple, list]]" = OrderedDict()
 # move_to_end into a KeyError and corrupts the dict's internal list
 _enc_memo_lock = make_lock("codec._enc_memo_lock")
 
+# Inner-message encode memo (the "__msg" field-value form): bounded LRU of
+# whole-message _enc results, hit once per peer after the first walk when a
+# broadcaster fans one frozen message object out through batch envelopes or
+# gossip relays. Identity-checked like the tuple memo; the strong reference
+# in each entry keeps the id() stable for the entry's lifetime.
+_MSG_MEMO_CAP = 512
+_msg_memo: "OrderedDict[int, Tuple[Any, dict]]" = OrderedDict()
+_msg_memo_lock = make_lock("codec._msg_memo_lock")
+
+# Decoded-Endpoint intern table: a cluster talks about the same few hundred
+# addresses over and over (every alert, vote, and membership row names
+# them), so decoding builds each address once and reuses the frozen
+# instance. Plain dict on purpose: reads and writes are GIL-atomic, a lost
+# race merely constructs a duplicate, and at the cap the table is cleared
+# wholesale -- correctness never depends on a hit.
+_EP_INTERN_CAP = 4096
+_ep_intern: Dict[Tuple[bytes, int], "T.Endpoint"] = {}
+
 # stable wire tags per message type (appending only; never renumber)
 _TYPES: Tuple[Type, ...] = (
     T.PreJoinMessage,  # 0
@@ -65,6 +83,7 @@ _TYPES: Tuple[Type, ...] = (
     T.Get,  # 22
     T.Put,  # 23
     T.PutAck,  # 24
+    T.MessageBatch,  # 25
 )
 _TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
 
@@ -100,13 +119,28 @@ def _enc(obj: Any) -> Any:
         # BatchedAlertMessage frames across versions
         return {"__al": {k: _enc(v) for k, v in _fields_of(obj).items()}}
     if type(obj) in _TAG_OF:
-        # a message carried as a field value (e.g. a GossipEnvelope payload)
-        return {
+        # a message carried as a field value (e.g. a GossipEnvelope payload
+        # or a MessageBatch inner). A broadcaster fans ONE message object to
+        # every peer, and each peer's envelope re-walks it -- with identical
+        # output every time, because messages are frozen dataclasses and the
+        # inner form never carries trace context. Memoize per object, same
+        # identity-checked shape as the tuple memo above.
+        with _msg_memo_lock:
+            hit = _msg_memo.get(id(obj))
+            if hit is not None and hit[0] is obj:
+                _msg_memo.move_to_end(id(obj))
+                return hit[1]
+        enc = {
             "__msg": [
                 _TAG_OF[type(obj)],
                 {k: _enc(v) for k, v in _fields_of(obj).items()},
             ]
         }
+        with _msg_memo_lock:
+            _msg_memo[id(obj)] = (obj, enc)
+            while len(_msg_memo) > _MSG_MEMO_CAP:
+                _msg_memo.popitem(last=False)
+        return enc
     if isinstance(obj, dict):
         return {k: _enc(v) for k, v in obj.items()}
     return obj
@@ -120,7 +154,14 @@ def _dec(obj: Any) -> Any:
     if isinstance(obj, dict):
         if "__ep" in obj:
             host, port = obj["__ep"]
-            return T.Endpoint(bytes(host), int(port))
+            key = (bytes(host), int(port))
+            ep = _ep_intern.get(key)
+            if ep is None:
+                if len(_ep_intern) >= _EP_INTERN_CAP:
+                    _ep_intern.clear()
+                ep = T.Endpoint(*key)
+                _ep_intern[key] = ep
+            return ep
         if "__id" in obj:
             return T.NodeId(*obj["__id"])
         if "__rk" in obj:

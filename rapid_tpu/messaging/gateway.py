@@ -307,7 +307,7 @@ class _GatewayNetwork:
         self._watch: Dict[Endpoint, _LivenessState] = {}
         self._watch_lock = make_lock("_GatewayNetwork._watch_lock")
         self._stop = threading.Event()
-        self._monitor = threading.Thread(
+        self._monitor = threading.Thread(  # noqa: messaging-thread
             target=self._monitor_loop, name="gateway-liveness", daemon=True
         )
         self._dialers = ThreadPoolExecutor(
@@ -682,7 +682,7 @@ class SwarmGateway:
         else:
             self._framed.start()
         for target, name in threads:
-            t = threading.Thread(target=target, name=name, daemon=True)
+            t = threading.Thread(target=target, name=name, daemon=True)  # noqa: messaging-thread
             t.start()
             self._threads.append(t)
 

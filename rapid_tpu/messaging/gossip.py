@@ -50,8 +50,10 @@ from ..observability import (
     trace_context_of,
 )
 from ..runtime.futures import Promise
+from ..settings import Settings
 from ..types import Endpoint, GossipEnvelope, NodeId, RapidMessage
 from .base import IBroadcaster, IMessagingClient
+from .unicast import make_batching_sink
 
 # Dedup memory is bounded by BOTH a size floor and an age floor: an entry is
 # only evicted once the table exceeds the cap AND the entry is older than
@@ -77,10 +79,16 @@ class GossipBroadcaster(IBroadcaster):
         ttl: Optional[int] = None,
         rng: Optional[random.Random] = None,
         mode: str = "eager",
+        settings: Optional[Settings] = None,
+        scheduler=None,
     ) -> None:
         assert mode in ("eager", "pushpull"), mode
         self._client = client
         self._my_addr = my_addr
+        # flush-window coalescing of outbound envelopes (one MessageBatch
+        # per peer per window) when Settings.broadcast_flush_window_ms > 0;
+        # None keeps the legacy send-per-envelope path
+        self._sink = make_batching_sink(client, my_addr, scheduler, settings)
         self._fanout = fanout
         self._relay_budget = relay_budget
         self._ttl_override = ttl
@@ -298,6 +306,10 @@ class GossipBroadcaster(IBroadcaster):
         targets = self._peers()
         if include_self:
             targets = [self._my_addr] + targets
+        if self._sink is not None:
+            for t in targets:
+                self._sink.offer(t, env)
+            return []  # fire-and-forget; flushed after the window
         return [
             self._client.send_message_best_effort(t, env) for t in targets
         ]

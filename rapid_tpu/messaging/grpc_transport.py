@@ -178,6 +178,13 @@ def to_wire_request(msg: T.RapidMessage):
         p.replicate = msg.replicate
         p.version = msg.version
         p.mapVersion = msg.map_version
+    elif isinstance(msg, T.MessageBatch):
+        b = req.messageBatch
+        b.sender.CopyFrom(_ep(msg.sender))
+        # whole envelopes nested: recursion carries each inner request's own
+        # oneof discriminator and trace context unchanged
+        for inner in msg.messages:
+            b.requests.append(to_wire_request(inner))
     else:
         raise TypeError(f"not a request type: {type(msg).__name__}")
     ctx = trace_context_of(msg)
@@ -307,6 +314,12 @@ def _from_wire_request_content(req) -> T.RapidMessage:
             replicate=int(m.replicate),
             version=int(m.version),
             map_version=int(m.mapVersion),
+        )
+    if which == "messageBatch":
+        m = req.messageBatch
+        return T.MessageBatch(
+            sender=_ep_back(m.sender),
+            messages=tuple(from_wire_request(r) for r in m.requests),
         )
     raise ValueError(f"empty RapidRequest envelope: {which}")
 
@@ -484,7 +497,7 @@ class _SharedAioLoop:
                     asyncio.set_event_loop(loop)
                     loop.run_forever()
 
-                thread = threading.Thread(
+                thread = threading.Thread(  # noqa: messaging-thread
                     target=run, name="grpc-aio-shared-loop", daemon=True
                 )
                 thread.start()

@@ -63,7 +63,7 @@ class NativeTcpClientServer(TcpClientServer):
         if self.address.port == 0:  # ephemeral bind: adopt the real port
             self.address = Endpoint(self.address.hostname, self._reactor.port)
         self._running = True
-        self._dispatcher = threading.Thread(
+        self._dispatcher = threading.Thread(  # noqa: messaging-thread
             target=self._dispatch_loop,
             name=f"native-tcp-{self.address}",
             daemon=True,

@@ -191,6 +191,13 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("leader", "M:Endpoint", 7, False),
         ("mapVersion", "int64", 8, False),
     ],
+    # transport batch envelope (messaging PR): whole RapidRequest envelopes
+    # nested so each inner request keeps its own oneof discriminator (and
+    # trace context); forward reference resolves at pool Add() time
+    "MessageBatch": [
+        ("sender", "M:Endpoint", 1, False),
+        ("requests", "M:RapidRequest", 2, True),
+    ],
 }
 
 # Trace context rides OUTSIDE the request oneof (a sibling of `content`):
@@ -211,14 +218,16 @@ _REQUEST_ONEOF = [
     ("phase2bMessage", "Phase2bMessage", 9),
     ("leaveMessage", "LeaveMessage", 10),
     ("clusterStatusRequest", "ClusterStatusRequest", 11),
-    # 12/13 are handoff-plane extensions, 14/16 serving-plane extensions;
-    # 15 is reserved for traceCtx (TRACE_CTX_FIELD_NUMBER), which rides
-    # outside the oneof -- the serving messages skip it, so the oneof is
-    # contiguous from 1 except for that one documented gap
+    # 12/13 are handoff-plane extensions, 14/16 serving-plane extensions,
+    # 17 the transport batch envelope; 15 is reserved for traceCtx
+    # (TRACE_CTX_FIELD_NUMBER), which rides outside the oneof -- the
+    # extension messages skip it, so the oneof is contiguous from 1 except
+    # for that one documented gap
     ("handoffRequest", "HandoffRequest", 12),
     ("handoffAck", "HandoffAck", 13),
     ("get", "Get", 14),
     ("put", "Put", 16),
+    ("messageBatch", "MessageBatch", 17),
 ]
 _RESPONSE_ONEOF = [
     ("joinResponse", "JoinResponse", 1),

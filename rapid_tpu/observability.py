@@ -94,6 +94,16 @@ METRIC_CATALOG = frozenset({
     "retry_exhausted",
     "retry_deadline_exceeded",
     "retry_backoff_ms",
+    # messaging transport (messaging/reactor.py, messaging/tcp.py)
+    "msg.sent",            # frames queued for transmission
+    "msg.received",        # frames parsed off the wire
+    "msg.bytes_sent",      # payload+header bytes actually written
+    "msg.bytes_received",  # bytes read off the wire
+    "msg.batch_size",      # frames coalesced per flush (histogram)
+    "msg.flush_syscalls",  # sendmsg/send calls issued by channel flushes
+    "msg.dial_backoffs",   # dials suppressed by the per-peer backoff gate
+    "msg.batches_sent",    # MessageBatch envelopes emitted by broadcasters
+    "msg.batched_messages",  # inner messages carried inside batch envelopes
     # simulator (sim/driver.py)
     "rounds",
     "device_dispatches",
@@ -226,6 +236,13 @@ HANDOFF_CHUNKS_BUCKETS: Tuple[float, ...] = (
 # quorum write during churn can stretch to seconds.
 SERVING_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
     0.25, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+)
+
+# Frames coalesced per channel flush (msg.batch_size): powers of two. A
+# saturated broadcast storm should push mass well past 1 -- that ratio IS
+# the write-coalescing win (syscalls per message = 1 / batch size).
+MSG_BATCH_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
 )
 
 

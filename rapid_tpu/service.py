@@ -71,6 +71,7 @@ from .types import (
     JoinResponse,
     JoinStatusCode,
     LeaveMessage,
+    MessageBatch,
     NodeId,
     PreJoinMessage,
     ProbeMessage,
@@ -138,7 +139,10 @@ class MembershipService:
         self._broadcaster = (
             broadcaster
             if broadcaster is not None
-            else UnicastToAllBroadcaster(client, rng=self._rng)
+            else UnicastToAllBroadcaster(
+                client, rng=self._rng, settings=settings,
+                scheduler=resources.scheduler, my_addr=my_addr,
+            )
         )
         self._subscriptions: Dict[ClusterEvents, List[SubscriptionCallback]] = {
             event: [] for event in ClusterEvents
@@ -285,7 +289,29 @@ class MembershipService:
             return self._handle_handoff_ack(msg)
         if isinstance(msg, (Get, Put)):
             return self._handle_serving(msg)
+        if isinstance(msg, MessageBatch):
+            return self._handle_message_batch(msg)
         raise TypeError(f"unidentified request type {type(msg).__name__}")
+
+    def _handle_message_batch(self, batch: MessageBatch) -> Promise:
+        """Unpack a transport batch envelope (a broadcaster's flush window,
+        messaging/unicast.py BatchingSink): dispatch each inner message
+        exactly as if it had arrived alone, ack the envelope. Inner
+        responses are dropped -- batched sends are fire-and-forget
+        broadcasts. The native codec carries only the envelope's trace
+        context, so inners that lost their own stamp adopt it (the gossip
+        receive() discipline)."""
+        ctx = trace_context_of(batch)
+        for inner in batch.messages:
+            if ctx is not None and trace_context_of(inner) is None:
+                stamp_trace_context(inner, ctx)
+            try:
+                self.handle_message(inner)
+            except Exception:  # noqa: BLE001 -- one poisoned inner message
+                # must not sink the rest of the batch (the unbatched
+                # equivalent fails one frame, not a window's traffic)
+                LOG.exception("batched message dispatch failed")
+        return Promise.completed(Response())
 
     def _handle_serving(self, msg: RapidMessage) -> Promise:
         """Serving-plane Get/Put: hop onto the protocol executor (leader
@@ -366,6 +392,12 @@ class MembershipService:
         from a quiesced cluster."""
         occupancy = self._cut_detection.occupancy()
         digest = sorted(self.metrics.snapshot().items())
+        # transport-plane digest (per-peer outbound queue depths) rides the
+        # same metric_names/metric_values streams, so statusz renders it
+        # with zero schema changes
+        transport_digest = getattr(self._client, "transport_digest", None)
+        if transport_digest is not None:
+            digest.extend(sorted(transport_digest().items()))
         pmap = self.placement_map()
         handoff_in_flight = handoff_completed = handoff_failed = 0
         handoff_partitions: Tuple[int, ...] = ()

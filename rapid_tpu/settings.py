@@ -27,9 +27,26 @@ class Settings:
     retry_max_delay_ms: int = 4000
     retry_jitter: str = "decorrelated"
 
+    # Dial backoff at the transport's connect seam (messaging/tcp.py).
+    # A peer whose dial failed is gated behind a decorrelated-jitter delay
+    # (base..max, the retries.py discipline) so a crashed peer costs one
+    # pending dial per window instead of a connect-syscall storm; the gate
+    # epoch resets every dial_deadline_ms so a long-dead peer still gets
+    # rate-limited fresh dials (it may have rebooted).
+    dial_backoff_base_ms: int = 50
+    dial_backoff_max_ms: int = 1000
+    dial_deadline_ms: int = 30000
+
     # Protocol engine (MembershipService.java:75-77)
     failure_detector_interval_ms: int = 1000
     batching_window_ms: int = 100
+
+    # Broadcast flush window (messaging/unicast.py, messaging/gossip.py):
+    # when > 0, per-recipient sends accumulate for this many ms and leave as
+    # one MessageBatch envelope per peer per window -- a churn wave's alerts
+    # ride one frame per peer. 0 preserves the legacy send-per-message path
+    # (and exact virtual-time timing) on both broadcasters.
+    broadcast_flush_window_ms: int = 0
 
     # Failure-detector policy, mirrored from the sim plane's SimConfig
     # (fd_policy/fd_window/fd_window_threshold) so both planes expose the
@@ -52,6 +69,9 @@ class Settings:
             f"{self.retry_jitter!r}"
         )
         assert 0 <= self.retry_base_delay_ms <= self.retry_max_delay_ms
+        assert 0 <= self.dial_backoff_base_ms <= self.dial_backoff_max_ms
+        assert self.dial_deadline_ms >= 0
+        assert self.broadcast_flush_window_ms >= 0
 
     # Consensus fallback (FastPaxos.java:46)
     consensus_fallback_base_delay_ms: int = 1000

@@ -258,6 +258,24 @@ class FastRoundVoteBatch:
 
 
 @dataclass(frozen=True)
+class MessageBatch:
+    """Transport-level batch envelope: one frame carrying several otherwise
+    independent requests to the same peer, flushed by a broadcaster's
+    coalescing window (messaging/unicast.py / messaging/gossip.py with
+    ``Settings.broadcast_flush_window_ms > 0``). Unlike FastRoundVoteBatch
+    (identical-value votes only) the inner messages are heterogeneous: a
+    churn wave's alerts, votes, and gossip ride one frame per peer. The
+    receiver dispatches each inner message exactly as if it had arrived
+    alone (one protocol task for the whole batch) and acks the envelope;
+    inner responses are dropped -- batched sends are fire-and-forget
+    broadcasts. Carried by both the native codec (tag 25) and the gRPC
+    transport (oneof field 17); peers that never batch interop unchanged."""
+
+    sender: "Endpoint"
+    messages: Tuple[object, ...] = ()  # inner RapidMessage requests
+
+
+@dataclass(frozen=True)
 class GossipEnvelope:
     """Epidemic-relay wrapper around any protocol message.
 

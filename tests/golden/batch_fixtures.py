@@ -1,0 +1,71 @@
+"""Fixture MessageBatch envelopes shared by the golden generator
+(generate_batch_frames.py) and the pinning tests (test_batch_messaging.py).
+
+Every object here is deterministic: fixed endpoints, fixed ids, and -- for
+byte-for-byte stability -- never trace-stamped (an unstamped message encodes
+no ``__tc`` envelope key).
+"""
+
+from rapid_tpu.types import (
+    AlertMessage,
+    BatchedAlertMessage,
+    EdgeStatus,
+    Endpoint,
+    FastRoundPhase2bMessage,
+    GossipEnvelope,
+    MessageBatch,
+    NodeId,
+    ProbeMessage,
+)
+
+BATCH_SENDER = Endpoint.from_parts("10.9.0.1", 7001)
+PEER_A = Endpoint.from_parts("10.9.0.2", 7002)
+PEER_B = Endpoint.from_parts("10.9.0.3", 7003)
+
+ALERT_DOWN = AlertMessage(
+    edge_src=PEER_A, edge_dst=PEER_B, edge_status=EdgeStatus.DOWN,
+    configuration_id=-11, ring_numbers=(0, 2),
+)
+ALERT_UP = AlertMessage(
+    edge_src=PEER_B, edge_dst=PEER_A, edge_status=EdgeStatus.UP,
+    configuration_id=-11, ring_numbers=(1,), node_id=NodeId(5, 6),
+    metadata=(("zone", b"z1"),),
+)
+ALERTS = BatchedAlertMessage(
+    sender=BATCH_SENDER, messages=(ALERT_DOWN, ALERT_UP),
+)
+VOTE = FastRoundPhase2bMessage(
+    sender=BATCH_SENDER, configuration_id=-11, endpoints=(PEER_A, PEER_B),
+)
+GOSSIP = GossipEnvelope(
+    sender=BATCH_SENDER, gossip_id=NodeId(41, 42), ttl=3,
+    payload=ProbeMessage(sender=BATCH_SENDER), kind=GossipEnvelope.KIND_PAYLOAD,
+)
+
+# named (request_no, batch) pairs pinned on the native msgpack wire. The
+# inner messages are request-surface types (what broadcasters actually
+# send): an AlertBatcher flush, a fast-round vote, a gossip relay. The
+# heterogeneous case is the envelope's reason to exist -- one churn wave's
+# traffic riding a single frame per peer.
+TCP_BATCHES = {
+    "alerts_pair": (
+        7,
+        MessageBatch(sender=BATCH_SENDER, messages=(ALERTS, ALERTS)),
+    ),
+    "heterogeneous": (
+        1025,
+        MessageBatch(
+            sender=BATCH_SENDER, messages=(ALERTS, VOTE, GOSSIP),
+        ),
+    ),
+    "singleton": (
+        0,
+        MessageBatch(sender=BATCH_SENDER, messages=(VOTE,)),
+    ),
+}
+
+# the gRPC schema mirrors rapid.proto and cannot carry GossipEnvelope, so
+# its pinned batch holds only reference-surface messages
+GRPC_BATCH = MessageBatch(
+    sender=BATCH_SENDER, messages=(ALERTS, VOTE),
+)

@@ -364,7 +364,7 @@ def test_tcp_dial_happens_outside_the_connection_cache_lock(monkeypatch):
     held_during_dial = []
 
     class _FakeConn:
-        def __init__(self, remote, timeout_s):
+        def __init__(self, remote, timeout_s, **kwargs):
             held_during_dial.append(cs._conn_lock.locked())
             self.closed = False
 
@@ -391,7 +391,7 @@ def test_tcp_dial_race_loser_closes_its_fresh_connection(monkeypatch):
     fresh_conns = []
 
     class _RacingConn:
-        def __init__(self, r, timeout_s):
+        def __init__(self, r, timeout_s, **kwargs):
             # while this thread was dialing, another thread won the race
             cs._connections[remote] = winner
             self.closed = False
@@ -406,6 +406,52 @@ def test_tcp_dial_race_loser_closes_its_fresh_connection(monkeypatch):
     assert cs._connections[remote] is winner  # cache not clobbered
     # the loser's fresh socket was closed, not leaked
     assert len(fresh_conns) == 1 and fresh_conns[0].closed
+
+
+def test_tcp_failed_dial_gates_redials_behind_jittered_backoff(monkeypatch):
+    """A refused dial must open a per-peer backoff gate: until the window
+    (drawn from the decorrelated-jitter RetryPolicy) expires, further
+    ``_connection`` calls fail fast with ConnectionError -- no socket work,
+    no retry storm against a dead peer -- and ``msg.dial_backoffs`` counts
+    each shed attempt. Success clears the gate entirely."""
+    from rapid_tpu.messaging import tcp as tcp_mod
+    from rapid_tpu.types import Endpoint
+
+    cs = tcp_mod.TcpClientServer(Endpoint.from_parts("127.0.0.1", 0))
+    remote = Endpoint.from_parts("10.0.0.4", 4)
+    dials = []
+
+    class _RefusedConn:
+        def __init__(self, r, timeout_s, **kwargs):
+            dials.append(r)
+            raise ConnectionRefusedError("refused")
+
+    monkeypatch.setattr(tcp_mod, "_Connection", _RefusedConn)
+    with pytest.raises(ConnectionRefusedError):
+        cs._connection(remote)
+    assert dials == [remote]
+    # inside the window: shed without dialing
+    with pytest.raises(ConnectionError) as shed:
+        cs._connection(remote)
+    assert "backoff" in str(shed.value)
+    assert dials == [remote]  # the socket was never touched again
+    assert cs.metrics.snapshot().get("msg.dial_backoffs") == 1
+    # the drawn delay obeys the policy bounds [base, cap]
+    gate = cs._dial_gate[remote]
+    assert (
+        cs._settings.dial_backoff_base_ms
+        <= gate["prev"]
+        <= cs._settings.dial_backoff_max_ms
+    )
+    # window expiry lets a real dial through again (which fails and re-arms)
+    gate["until"] = 0.0
+    with pytest.raises(ConnectionRefusedError):
+        cs._connection(remote)
+    assert dials == [remote, remote]
+    assert cs._dial_gate[remote]["until"] > 0.0
+    # an eventual success clears the gate: the next dial is immediate
+    cs._dial_outcome(remote, True)
+    assert remote not in cs._dial_gate
 
 
 def test_cluster_shutdown_runs_teardown_exactly_once_under_races():

@@ -1,0 +1,242 @@
+"""MessageBatch transport envelope: frozen wire bytes, rolling-upgrade
+dialect identity, flush-window sink semantics, and mixed batched/unbatched
+cluster interop over both socket transports.
+
+The envelope (types.py MessageBatch, codec tag 25, gRPC oneof field 17) is
+the alert/vote batching seam of the event-loop messaging core: broadcasters
+coalesce one flush window of per-peer traffic into one frame. These tests pin
+the three claims the seam makes: (1) the bytes are stable -- committed golden
+frames in tests/golden/batch_envelope_frames.json decode back to identical
+values; (2) the envelope rides every wire dialect unchanged (the PR 6
+versioned-wire identity matrix, extended to batches); (3) a cluster where
+only SOME nodes batch still converges through churn, because receivers
+dispatch inner messages exactly as if each had arrived alone.
+"""
+
+import json
+import time
+from pathlib import Path
+
+from golden.batch_fixtures import ALERTS, GRPC_BATCH, TCP_BATCHES, VOTE
+from harness import free_port_base
+
+from rapid_tpu import ClusterBuilder, Endpoint, Settings
+from rapid_tpu.messaging import grpc_transport as gt
+from rapid_tpu.messaging.codec import (
+    HEADER,
+    WIRE_VERSION,
+    decode,
+    encode,
+    encode_versioned,
+    wire_roundtrip,
+)
+from rapid_tpu.messaging.tcp import TcpClientServer
+from rapid_tpu.messaging.unicast import BatchingSink
+from rapid_tpu.messaging.wire_schema import MSG
+from rapid_tpu.monitoring.static_fd import StaticFailureDetectorFactory
+from rapid_tpu.runtime.scheduler import VirtualScheduler
+from rapid_tpu.types import MessageBatch
+
+GOLDEN = json.loads(
+    (Path(__file__).parent / "golden" / "batch_envelope_frames.json").read_text()
+)
+
+
+# ---------------------------------------------------------------------------
+# golden bytes
+# ---------------------------------------------------------------------------
+
+
+def test_batch_frame_bytes_golden():
+    """Native-codec batch frames serialize byte-for-byte to the committed
+    vectors, and the committed bytes decode back to identical envelopes --
+    both the msgpack body and the length-prefixed on-socket framing."""
+    assert set(GOLDEN["tcp_frames"]) == set(TCP_BATCHES)
+    for name, (request_no, batch) in TCP_BATCHES.items():
+        entry = GOLDEN["tcp_frames"][name]
+        assert entry["request_no"] == request_no, name
+        body = encode(request_no, batch)
+        assert body.hex() == entry["body_hex"], name
+        framed = HEADER.pack(len(body)) + body
+        assert framed.hex() == entry["framed_hex"], name
+        got_no, got = decode(bytes.fromhex(entry["body_hex"]))
+        assert got_no == request_no, name
+        assert got == batch, name
+
+
+def test_batch_grpc_bytes_golden():
+    """The gRPC batch envelope serializes deterministically to the committed
+    bytes and parses back identical through the programmatic schema."""
+    expect_hex = GOLDEN["grpc_requests"]["MessageBatch"]
+    got = gt.to_wire_request(GRPC_BATCH).SerializeToString(deterministic=True)
+    assert got.hex() == expect_hex
+    parsed = gt.from_wire_request(
+        MSG["RapidRequest"].FromString(bytes.fromhex(expect_hex))
+    )
+    assert parsed == GRPC_BATCH
+
+
+def test_batch_wire_roundtrip_identity_across_versions():
+    """PR 6's rolling-upgrade identity matrix, extended to the batch
+    envelope: every dialect a mixed-version cluster can speak round-trips
+    the batch to the identical value, and the current dialect is byte-parity
+    with the plain encoder."""
+    for request_no, batch in TCP_BATCHES.values():
+        assert encode_versioned(request_no, batch, WIRE_VERSION) == encode(
+            request_no, batch
+        )
+        for version in (0, 1, 2, 7):
+            assert wire_roundtrip(batch, version) == batch
+        # a NEWER dialect differs on the wire yet decodes to the same value
+        assert encode_versioned(
+            request_no, batch, WIRE_VERSION + 1
+        ) != encode(request_no, batch)
+
+
+# ---------------------------------------------------------------------------
+# flush-window sink semantics
+# ---------------------------------------------------------------------------
+
+
+class _RecordingClient:
+    def __init__(self):
+        self.sent = []
+
+    def send_message_best_effort(self, recipient, msg):
+        self.sent.append((recipient, msg))
+
+
+def test_batching_sink_coalesces_per_peer_and_singletons_stay_bare():
+    """One flush window: a peer owed several messages gets ONE MessageBatch
+    in offer order; a peer owed exactly one gets the bare message (an
+    unbatched receiver sees no format change on light traffic); nothing
+    leaves the sink before the window expires."""
+    sched = VirtualScheduler()
+    client = _RecordingClient()
+    me = Endpoint.from_parts("127.0.0.1", 101)
+    busy = Endpoint.from_parts("127.0.0.1", 102)
+    quiet = Endpoint.from_parts("127.0.0.1", 103)
+    sink = BatchingSink(client, me, sched, window_ms=20)
+
+    sink.offer(busy, VOTE)
+    sink.offer(busy, ALERTS)
+    sink.offer(quiet, VOTE)
+    assert client.sent == []  # in-window: nothing on the wire yet
+
+    sched.run_until_time(19)
+    assert client.sent == []
+    sched.run_until_time(20)
+    assert dict(client.sent) == {
+        busy: MessageBatch(sender=me, messages=(VOTE, ALERTS)),
+        quiet: VOTE,
+    }
+
+    # the window re-arms: a later offer schedules a fresh flush
+    sink.offer(quiet, ALERTS)
+    sched.run_until_time(40)
+    assert client.sent[-1] == (quiet, ALERTS)
+
+
+# ---------------------------------------------------------------------------
+# mixed batched/unbatched cluster interop (both socket transports)
+# ---------------------------------------------------------------------------
+
+
+def _wait_sizes(clusters, want, deadline_s=30):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if all(c.get_membership_size() == want for c in clusters):
+            return
+        time.sleep(0.05)
+    assert [c.get_membership_size() for c in clusters] == [want] * len(clusters)
+
+
+def _run_mixed_cluster(make_transport):
+    """3 live nodes where only nodes 0 and 2 batch broadcasts: join,
+    converge, push a concurrent broadcast burst through a batching node's
+    real broadcaster so MessageBatch envelopes actually flow to the
+    unbatched node (a quiet membership cluster's windows are singletons,
+    which the sink deliberately sends bare), then crash the batched node 2
+    and converge again. Proves a batching sender interops with an
+    unbatched receiver (and vice versa) through a real churn wave."""
+    base = free_port_base(4)
+    blacklist = set()
+
+    def settings_for(i):
+        return Settings(
+            failure_detector_interval_ms=50,
+            batching_window_ms=10,
+            consensus_fallback_base_delay_ms=300,
+            broadcast_flush_window_ms=15 if i % 2 == 0 else 0,
+        )
+
+    def build(i, seed=None):
+        addr = Endpoint.from_parts("127.0.0.1", base + i)
+        settings = settings_for(i)
+        client, server = make_transport(addr, settings)
+        builder = (
+            ClusterBuilder(addr)
+            .use_settings(settings)
+            .set_messaging_client_and_server(client, server)
+            .set_edge_failure_detector_factory(
+                StaticFailureDetectorFactory(blacklist)
+            )
+        )
+        if seed is None:
+            return builder.start()
+        return builder.join(seed, timeout=30)
+
+    seed = build(0)
+    clusters = [seed]
+    try:
+        for i in (1, 2):
+            clusters.append(build(i, seed.listen_address))
+        _wait_sizes(clusters, 3)
+        lists = {tuple(c.get_memberlist()) for c in clusters}
+        assert len(lists) == 1
+
+        # burst through batching node 0's real broadcaster: 6 probes fan to
+        # every member inside one flush window, so the unbatched node 1 must
+        # unwrap genuine MessageBatch envelopes via its service dispatch
+        from rapid_tpu.types import ProbeMessage
+
+        for _ in range(6):
+            clusters[0]._membership_service._broadcaster.broadcast(
+                ProbeMessage(sender=clusters[0].listen_address)
+            )
+        unbatched = clusters[1]._membership_service.metrics
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            snap = unbatched.snapshot()
+            if snap.get("messages.MessageBatch", 0) >= 1:
+                break
+            time.sleep(0.05)
+        snap = unbatched.snapshot()
+        assert snap.get("messages.MessageBatch", 0) >= 1, snap
+        assert snap.get("messages.ProbeMessage", 0) >= 6, snap
+
+        crashed = clusters.pop()  # node 2: a batching node
+        blacklist.add(crashed.listen_address)
+        crashed.shutdown()
+        _wait_sizes(clusters, 2)
+        assert {tuple(c.get_memberlist()) for c in clusters} == {
+            (clusters[0].listen_address, clusters[1].listen_address)
+        } or len({tuple(c.get_memberlist()) for c in clusters}) == 1
+    finally:
+        for c in clusters:
+            c.shutdown()
+
+
+def test_mixed_batched_unbatched_tcp_cluster_converges():
+    def make(addr, settings):
+        transport = TcpClientServer(addr, settings)
+        return transport, transport
+
+    _run_mixed_cluster(make)
+
+
+def test_mixed_batched_unbatched_grpc_cluster_converges():
+    def make(addr, settings):
+        return gt.GrpcClient(addr, settings), gt.GrpcServer(addr)
+
+    _run_mixed_cluster(make)

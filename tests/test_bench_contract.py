@@ -343,6 +343,60 @@ def test_serving_dimension_json_contract(monkeypatch, capsys):
     assert parsed["serving_qps"] == entry
 
 
+def _reduced_messaging_scale(monkeypatch):
+    monkeypatch.setattr(bench, "MESSAGING_PAIR_MSGS", 64)
+    monkeypatch.setattr(bench, "MESSAGING_STORM_NODES", 4)
+    monkeypatch.setattr(bench, "MESSAGING_STORM_ROUNDS", 5)
+    monkeypatch.setattr(bench, "MESSAGING_STORM_BURST", 4)
+
+
+def test_messaging_dimension_json_contract(monkeypatch, capsys):
+    """The messaging_throughput entry of the one JSON line carries the
+    loopback RPC rate, the broadcast-storm curve on the event-loop core,
+    the thread-per-message baseline, and the two A/B headline ratios the
+    harness tracks (messages/sec speedup and write-syscall reduction).
+    Run at a reduced scale so the contract check stays cheap."""
+    _reduced_messaging_scale(monkeypatch)
+    entry = bench.run_messaging_dimension(seed=3)
+    for workload in ("loopback_pair", "broadcast_storm", "threaded_baseline"):
+        stats = entry[workload]
+        assert stats["messages"] > 0
+        assert stats["messages_per_s"] > 0
+        assert stats["bytes_per_s"] > 0
+        assert "flush_syscalls_per_msg" in stats
+    storm = entry["broadcast_storm"]
+    assert storm["messages"] == 4 * 3 * 5 * 4  # n*(n-1)*rounds*burst, exact
+    assert storm["frames_sent"] > 0
+    assert entry["speedup_vs_threaded"] > 0
+    assert entry["syscall_reduction_vs_threaded"] > 0
+    # and the emitter folds the entry into the artifact line verbatim
+    bench._emit_json({"value": 120.0, "virtual_ms": 11_100}, "cpu", [])
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["messaging_throughput"] == entry
+
+
+def test_messaging_reactor_coalesces_vs_threaded_baseline(monkeypatch):
+    """The A/B the refactor exists for, guarded at reduced scale: the
+    threaded baseline pays exactly one write syscall per message by
+    construction, while the reactor+batching storm coalesces the same
+    traffic into far fewer writes. Syscall counts are deterministic-ish
+    (timing only shifts HOW MANY messages share a flush, and a whole
+    burst fits one window at this scale), so the guard binds them hard;
+    wall-clock speedup is asserted only to exist and be positive --
+    magnitude claims belong to the full-scale bench artifact, not a
+    shared CI box."""
+    _reduced_messaging_scale(monkeypatch)
+    storm = bench._messaging_reactor_storm()
+    baseline = bench._messaging_threaded_baseline()
+    assert baseline["flush_syscalls_per_msg"] == 1.0
+    assert storm["flush_syscalls_per_msg"] <= 0.5
+    assert (
+        baseline["flush_syscalls_per_msg"] / storm["flush_syscalls_per_msg"]
+        >= 2.0
+    )
+    assert storm["messages_per_s"] > 0 and baseline["messages_per_s"] > 0
+
+
 def test_serving_sim_steady_state_compiles_zero(monkeypatch):
     """With the serving plane enabled, a warmed crash->decision loop plus
     client traffic must not compile anything new: serving ops are host-side

@@ -299,6 +299,7 @@ def test_wire_roundtrip_identity_across_versions():
         EdgeStatus,
         JoinResponse,
         JoinStatusCode,
+        MessageBatch,
         NodeId,
     )
 
@@ -312,6 +313,10 @@ def test_wire_roundtrip_identity_across_versions():
         Response(),
         alert,
         BatchedAlertMessage(sender=A, messages=(alert,)),
+        MessageBatch(sender=A, messages=(
+            BatchedAlertMessage(sender=A, messages=(alert,)),
+            ProbeMessage(sender=B),
+        )),
         JoinResponse(sender=B, status_code=JoinStatusCode.SAFE_TO_JOIN,
                      configuration_id=7, endpoints=(A, B),
                      identifiers=(NodeId(1, 2),)),

@@ -70,6 +70,9 @@ RULE_DOCS = {
     # tools/check.py -- concurrency hygiene
     "thread-daemon": "a non-daemon thread outlives shutdown and hangs exit; "
                      "mark daemon=True or provably join it",
+    "messaging-thread": "rapid_tpu/messaging/ runs on the reactor event "
+                        "loop; new Thread constructions there (outside "
+                        "reactor.py) re-grow the thread-per-message design",
     "callback-under-lock": "user callbacks invoked under a lock can re-enter "
                            "and deadlock; call them after release",
     # tools/concur.py -- concurrency correctness
@@ -743,6 +746,16 @@ class _HygieneVisitor(ast.NodeVisitor):
                     node, "thread-daemon",
                     "threading.Thread in library code must be daemon=True "
                     "(or join it on shutdown and suppress this line)",
+                )
+            if (
+                "messaging" in self.path.parts
+                and self.path.name != "reactor.py"
+            ):
+                self._report(
+                    node, "messaging-thread",
+                    "thread construction in rapid_tpu/messaging/: socket "
+                    "I/O belongs on the reactor (messaging/reactor.py); a "
+                    "deliberately-owned worker needs an explicit waiver",
                 )
         if self._locks_held and name is not None:
             if name in CALLBACK_NAMES or name.startswith("on_"):

@@ -79,6 +79,22 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
             f" leads={sum(1 for lead in status.serving_leaders if lead == str(status.sender))}"
             f"/{len(status.serving_partitions)}"
         )
+    # transport summary: per-peer outbound queue depths (the backpressure
+    # signature of a slow-reading peer) get a first-class line above the
+    # raw metric digest they also appear in
+    depths = [
+        (name[len("msg.queue_depth{peer="):-1], value)
+        for name, value in zip(status.metric_names, status.metric_values)
+        if name.startswith("msg.queue_depth{peer=")
+    ]
+    if depths:
+        total = sum(v for _, v in depths)
+        deepest = max(depths, key=lambda kv: kv[1])
+        lines.append(
+            f"  transport: peers={len(depths)}"
+            f" queued-bytes={total:.0f}"
+            f" deepest={deepest[0]}:{deepest[1]:.0f}"
+        )
     for name, value in zip(status.metric_names, status.metric_values):
         lines.append(f"  metric {name} = {value}")
     tail = status.journal[-journal_lines:] if journal_lines else ()
