@@ -78,7 +78,7 @@ WATCHDOG_S = 20 * 60
 # against a dying tunnel) must emit it rather than destroy it.
 _PROGRESS: dict = {
     "headline": None, "backend": None, "sweep": [], "wan": None,
-    "serving": None, "messaging": None,
+    "serving": None, "messaging": None, "gray_detection": None,
 }
 
 # jitwatch compile accounting of the most recent warmed_run (warmup vs
@@ -113,6 +113,25 @@ WAN_RTTS_MS = (0, 500, 1000)
 # transport shape (blocking sendall per message: one write syscall per
 # message by construction) for the A/B speedup and syscall-reduction
 # numbers in the JSON line.
+# Gray-detection dimension: detection->decision latency of the simulator's
+# gray-aware FD mirror (SimConfig.fd_gray_confirm) vs the static cumulative
+# counter, A/B on an identical WAN-shaped cluster replaying the same
+# slow-node plan. Two fault shapes: a node that turns gray and stays gray
+# (gray_slow_node) and one oscillating slow/healthy (gray_flapping), whose
+# healthy gaps reset the adaptive miss streak but never the static counter.
+GRAY_N_NODES = 64
+GRAY_DELAY_MS = 5_000
+GRAY_CONFIRM = 3          # adaptive: sustained-miss streak that fires
+GRAY_WARMUP = 3           # successful probes before gray scoring engages
+GRAY_WINDOWS = {
+    # fault opens after 3 healthy probe intervals (>= GRAY_WARMUP)
+    "gray_slow_node": ((3_000, None),),
+    # three 6 s slow windows with 6 s healthy gaps: 6 misses per window,
+    # under the static threshold of 10, so the static counter must straddle
+    # two windows while the adaptive streak concludes inside the first
+    "gray_flapping": ((3_000, 9_000), (15_000, 21_000), (27_000, 33_000)),
+}
+
 MESSAGING_PAIR_MSGS = 2_000
 MESSAGING_STORM_NODES = 16
 MESSAGING_STORM_ROUNDS = 40
@@ -233,6 +252,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "wan_stable_view": _PROGRESS["wan"],
                 "serving_qps": _PROGRESS["serving"],
                 "messaging_throughput": _PROGRESS["messaging"],
+                "gray_detection_ms": _PROGRESS["gray_detection"],
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "placement_partitions_moved": _placement_hist(),
                 "handoff_session_bytes": _handoff_hist(),
@@ -509,6 +529,16 @@ def run_sweep(backend: str, seed: int) -> list:
         _PROGRESS["messaging"] = {"error": f"{type(exc).__name__}: {exc}"}
         print(f"bench.py: messaging dimension failed: {exc}", file=sys.stderr,
               flush=True)
+    # gray-detection dimension: adaptive-vs-static FD A/B on the simulator;
+    # a sub-2x speedup or broken cut parity is a regression and crashes
+    try:
+        run_gray_detection_dimension(seed)
+    except AssertionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 -- keep the artifact
+        _PROGRESS["gray_detection"] = {"error": f"{type(exc).__name__}: {exc}"}
+        print(f"bench.py: gray-detection dimension failed: {exc}",
+              file=sys.stderr, flush=True)
     return out
 
 
@@ -654,6 +684,66 @@ def run_serving_dimension(seed: int) -> dict:
         round(1000.0 * total_ops / total_ms, 1) if total_ms else None
     )
     _PROGRESS["serving"] = entry
+    return entry
+
+
+def run_gray_detection_dimension(seed: int) -> dict:
+    """Detection->decision latency of a gray fault, adaptive vs static, on
+    the simulator: identical WAN-shaped cluster, identical slow-node plan,
+    the only difference SimConfig.fd_gray_confirm (the sim mirror of
+    Settings.adaptive_fd). Detection is measured from the fault window
+    opening to the decided view change, on virtual time, so every number is
+    deterministic per seed. Cut parity (exactly the faulted node) and a
+    >= 2x adaptive speedup are asserted for both fault shapes."""
+    from rapid_tpu.faults import (
+        FaultPlan,
+        endpoint_slots,
+        replay_on_simulator,
+    )
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.sim.engine import SimConfig
+    from rapid_tpu.sim.topology import LatencyTopology
+
+    n = GRAY_N_NODES
+    entry: dict = {"n": n, "delay_ms": GRAY_DELAY_MS}
+    topo = LatencyTopology(racks=4, zones=2, regions=2, rack_rtt_ms=0,
+                           zone_rtt_ms=0, region_rtt_ms=0,
+                           inter_region_rtt_ms=200)
+    for scenario, windows in GRAY_WINDOWS.items():
+        fault_open_ms = windows[0][0]
+        detect = {}
+        for mode, confirm in (("static", 0), ("adaptive", GRAY_CONFIRM)):
+            config = SimConfig(capacity=n, groups=2, max_delivery_delay=2,
+                               fd_gray_confirm=confirm,
+                               fd_gray_warmup=GRAY_WARMUP)
+            sim = Simulator(n, config=config, seed=seed)
+            endpoint_of = {
+                slot: ep for ep, slot in endpoint_slots(sim).items()
+            }
+            victim_slot = n - 1
+            plan = FaultPlan(seed=seed).slow_node(
+                endpoint_of[victim_slot], GRAY_DELAY_MS, windows=windows
+            ).with_topology(topo)
+            epoch = sim.virtual_ms
+            records = replay_on_simulator(sim, plan, duration_ms=45_000)
+            assert records, f"{scenario}/{mode}: no decision"
+            assert [int(c) for c in records[0].cut] == [victim_slot], (
+                f"{scenario}/{mode}: cut parity violated"
+            )
+            detect[mode] = (
+                records[0].virtual_time_ms - epoch - fault_open_ms
+            )
+        speedup = detect["static"] / max(detect["adaptive"], 1)
+        assert speedup >= 2.0, (
+            f"{scenario}: adaptive detection {detect['adaptive']} ms is "
+            f"under 2x faster than static {detect['static']} ms"
+        )
+        entry[scenario] = {
+            "static_ms": int(detect["static"]),
+            "adaptive_ms": int(detect["adaptive"]),
+            "speedup": round(speedup, 2),
+        }
+    _PROGRESS["gray_detection"] = entry
     return entry
 
 

@@ -16,7 +16,7 @@ drives both the real-time scheduler and the deterministic virtual-time one;
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .cut_detector import MultiNodeCutDetector
 from .events import ClusterEvents
@@ -216,6 +216,7 @@ class ClusterBuilder:
         self._placement: Optional[PlacementConfig] = None
         self._handoff_store: Optional[PartitionStore] = None
         self._serving = False
+        self._tier_resolver: Optional[Callable[[Endpoint], str]] = None
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -225,6 +226,15 @@ class ClusterBuilder:
         self, factory: IEdgeFailureDetectorFactory
     ) -> "ClusterBuilder":
         self._fd_factory = factory
+        return self
+
+    def set_tier_resolver(
+        self, tier_of: Callable[[Endpoint], str]
+    ) -> "ClusterBuilder":
+        """Topology tier label per monitored subject (rack/zone/region/wan)
+        for the adaptive failure detector's peer grouping; ignored unless
+        settings.adaptive_fd.enabled (see monitoring/adaptive.py)."""
+        self._tier_resolver = tier_of
         return self
 
     def add_subscription(
@@ -359,6 +369,16 @@ class ClusterBuilder:
         # virtual-time runs measure deterministic fd.rtt_ms and a nemesis
         # clock-skew scheduler drifts the estimates with the node
         clock = self._scheduler.now_ms if self._scheduler is not None else None
+        if self._settings.adaptive_fd.enabled:
+            from .monitoring.adaptive import AdaptivePingPongFactory
+
+            return AdaptivePingPongFactory(
+                self._listen_address, client,
+                settings=self._settings,
+                metrics=self._metrics,
+                clock=clock,
+                tier_of=self._tier_resolver,
+            )
         if self._settings.fd_policy == "windowed":
             from .monitoring.pingpong import WindowedPingPongFailureDetectorFactory
 

@@ -367,6 +367,13 @@ def to_wire_response(msg) :
         s.servingPutAcks = msg.serving_put_acks
         s.servingPartitions.extend(msg.serving_partitions)
         s.servingLeaders.extend(msg.serving_leaders)
+        s.fdSubjects.extend(msg.fd_subjects)
+        s.fdRttMicros.extend(msg.fd_rtt_micros)
+        s.fdSuspicionMilli.extend(msg.fd_suspicion_milli)
+        s.fdTiers.extend(msg.fd_tiers)
+        s.fdTierIntervalMs.extend(msg.fd_tier_interval_ms)
+        s.fdTierThreshold.extend(msg.fd_tier_threshold)
+        s.fdTierFlushMs.extend(msg.fd_tier_flush_ms)
     elif isinstance(msg, T.PutAck):
         a = resp.putAck
         a.sender.CopyFrom(_ep(msg.sender))
@@ -440,6 +447,13 @@ def from_wire_response(resp):
             serving_put_acks=int(m.servingPutAcks),
             serving_partitions=tuple(int(p) for p in m.servingPartitions),
             serving_leaders=tuple(str(s) for s in m.servingLeaders),
+            fd_subjects=tuple(str(s) for s in m.fdSubjects),
+            fd_rtt_micros=tuple(int(v) for v in m.fdRttMicros),
+            fd_suspicion_milli=tuple(int(v) for v in m.fdSuspicionMilli),
+            fd_tiers=tuple(str(t) for t in m.fdTiers),
+            fd_tier_interval_ms=tuple(int(v) for v in m.fdTierIntervalMs),
+            fd_tier_threshold=tuple(int(v) for v in m.fdTierThreshold),
+            fd_tier_flush_ms=tuple(int(v) for v in m.fdTierFlushMs),
         )
     if which == "putAck":
         m = resp.putAck

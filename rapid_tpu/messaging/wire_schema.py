@@ -140,6 +140,17 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("servingPutAcks", "int64", 23, False),
         ("servingPartitions", "int64", 24, True),
         ("servingLeaders", "string", 25, True),
+        # failure-detector plane exposure: per-edge (subject, rtt micros,
+        # suspicion milli) digest plus per-tier adapted FD parameters as
+        # parallel arrays (integer units: proto3 floats are deliberately
+        # absent from this schema)
+        ("fdSubjects", "string", 26, True),
+        ("fdRttMicros", "int64", 27, True),
+        ("fdSuspicionMilli", "int64", 28, True),
+        ("fdTiers", "string", 29, True),
+        ("fdTierIntervalMs", "int64", 30, True),
+        ("fdTierThreshold", "int64", 31, True),
+        ("fdTierFlushMs", "int64", 32, True),
     ],
     "HandoffRequest": [
         ("sender", "M:Endpoint", 1, False),

@@ -26,6 +26,15 @@ BOOTSTRAP_COUNT_THRESHOLD = 30
 
 # EWMA smoothing for the per-edge RTT estimate (TCP SRTT's classic alpha)
 _RTT_ALPHA = 0.125
+# EWMA smoothing for the RTT deviation estimate (TCP RTTVAR's classic beta)
+_RTT_BETA = 0.25
+# The deviation estimate is seeded from the spread of the first
+# RTT_SEED_SAMPLES samples rather than TCP's single-sample R/2 point
+# estimate: one slow first probe on a fresh WAN edge would otherwise pin an
+# inflated variance (or, worse, a tiny one that flags normal jitter as
+# outlier) for many EWMA half-lives. Until the seed window fills,
+# rtt_var_ms() is None and suspicion scoring stays inactive.
+RTT_SEED_SAMPLES = 4
 
 
 def _wall_ms() -> int:
@@ -58,6 +67,9 @@ class PingPongFailureDetector:
         self._notified = False
         self._probe = ProbeMessage(sender=address)
         self._rtt_ms: Optional[float] = None  # per-edge EWMA estimate
+        self._rtt_var_ms: Optional[float] = None  # EWMA |deviation| estimate
+        self._seed_window: list = []  # first RTT_SEED_SAMPLES raw samples
+        self._sample_count = 0
 
     def has_failed(self) -> bool:
         return self._failure_count >= self._failure_threshold
@@ -68,6 +80,22 @@ class PingPongFailureDetector:
         from a dead one: a SlowNodeRule victim inside the timeout shows an
         inflated estimate here long before any eviction."""
         return self._rtt_ms
+
+    def rtt_var_ms(self) -> Optional[float]:
+        """Smoothed mean-absolute-deviation of the probe RTT, None until
+        RTT_SEED_SAMPLES answered probes seeded it (cold-start guard)."""
+        return self._rtt_var_ms
+
+    def sample_count(self) -> int:
+        """Answered probes observed on this edge (RTT samples)."""
+        return self._sample_count
+
+    def suspicion(self) -> float:
+        """Gray-failure suspicion score in [0, inf): 0 means healthy, >= 1
+        means the edge warrants an alert. The static detector never
+        suspects (alerts only via the hard failure_threshold); the adaptive
+        subclass overrides this with the tier-relative outlier score."""
+        return 0.0
 
     def __call__(self) -> None:
         if self.has_failed() and not self._notified:
@@ -86,11 +114,33 @@ class PingPongFailureDetector:
         ):
             rtt = max(0, self._clock() - sent_ms)
             self._metrics.observe("fd.rtt_ms", rtt)
+            srtt_before = self._rtt_ms
             self._rtt_ms = (
                 float(rtt) if self._rtt_ms is None
                 else (1 - _RTT_ALPHA) * self._rtt_ms + _RTT_ALPHA * rtt
             )
+            self._update_variance(float(rtt), srtt_before)
+            self._sample_count += 1
+            self._record_sample(float(rtt))
         self._on_probe_done(promise)
+
+    def _update_variance(self, rtt: float, srtt_before: Optional[float]) -> None:
+        if self._rtt_var_ms is None:
+            self._seed_window.append(rtt)
+            if len(self._seed_window) >= RTT_SEED_SAMPLES:
+                mean = sum(self._seed_window) / len(self._seed_window)
+                self._rtt_var_ms = sum(
+                    abs(x - mean) for x in self._seed_window
+                ) / len(self._seed_window)
+                self._seed_window = []
+            return
+        deviation = abs(rtt - (srtt_before if srtt_before is not None else rtt))
+        self._rtt_var_ms = (
+            (1 - _RTT_BETA) * self._rtt_var_ms + _RTT_BETA * deviation
+        )
+
+    def _record_sample(self, rtt: float) -> None:
+        """Per-answered-probe hook for subclasses (adaptive scoring)."""
 
     def _record_failure(self) -> None:
         self._failure_count += 1
@@ -110,7 +160,40 @@ class PingPongFailureDetector:
                 self._record_failure()
 
 
-class PingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
+class EdgeRegistryMixin:
+    """Tracks the live detector per monitored subject so the service can
+    expose per-edge RTT EWMAs and suspicion scores through cluster_status
+    (and statusz can render a worst-edges digest)."""
+
+    _edges: dict
+
+    def _register_edge(self, subject: Endpoint, detector) -> None:
+        if not hasattr(self, "_edges"):
+            self._edges = {}
+        self._edges[subject] = detector
+
+    def begin_configuration(self, subjects) -> None:
+        """Drop edges no longer monitored (called by the service before it
+        recreates detectors for a new configuration)."""
+        keep = set(subjects)
+        edges = getattr(self, "_edges", {})
+        for gone in [s for s in edges if s not in keep]:
+            del edges[gone]
+
+    def edge_digest(self):
+        """((subject_str, rtt_ms|None, suspicion), ...) sorted worst-first:
+        by suspicion desc, then smoothed RTT desc, then subject."""
+        edges = getattr(self, "_edges", {})
+        rows = [
+            (str(subject), det.rtt_ms(), det.suspicion())
+            for subject, det in edges.items()
+        ]
+        rows.sort(key=lambda r: (-r[2], -(r[1] or 0.0), r[0]))
+        return tuple(rows)
+
+
+class PingPongFailureDetectorFactory(EdgeRegistryMixin,
+                                     IEdgeFailureDetectorFactory):
     def __init__(self, address: Endpoint, client: IMessagingClient,
                  failure_threshold: int = FAILURE_THRESHOLD,
                  metrics: Optional[Metrics] = None,
@@ -120,15 +203,18 @@ class PingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
         self._failure_threshold = failure_threshold
         self._metrics = metrics
         self._clock = clock
+        self._edges = {}
 
     def create_instance(
         self, subject: Endpoint, notifier: Callable[[], None]
     ) -> Callable[[], None]:
-        return PingPongFailureDetector(
+        detector = PingPongFailureDetector(
             self._address, subject, self._client, notifier,
             self._failure_threshold, metrics=self._metrics,
             clock=self._clock,
         )
+        self._register_edge(subject, detector)
+        return detector
 
 
 class WindowedPingPongFailureDetector(PingPongFailureDetector):
@@ -161,7 +247,8 @@ class WindowedPingPongFailureDetector(PingPongFailureDetector):
         self._window.append(self._failure_count > before)
 
 
-class WindowedPingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
+class WindowedPingPongFailureDetectorFactory(EdgeRegistryMixin,
+                                             IEdgeFailureDetectorFactory):
     def __init__(self, address: Endpoint, client: IMessagingClient,
                  window: int = 10, threshold: float = 0.4,
                  metrics: Optional[Metrics] = None,
@@ -172,10 +259,13 @@ class WindowedPingPongFailureDetectorFactory(IEdgeFailureDetectorFactory):
         self._threshold = threshold
         self._metrics = metrics
         self._clock = clock
+        self._edges = {}
 
     def create_instance(self, subject, notifier):
-        return WindowedPingPongFailureDetector(
+        detector = WindowedPingPongFailureDetector(
             self._address, subject, self._client, notifier,
             self._window, self._threshold, metrics=self._metrics,
             clock=self._clock,
         )
+        self._register_edge(subject, detector)
+        return detector

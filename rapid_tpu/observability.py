@@ -70,6 +70,10 @@ METRIC_CATALOG = frozenset({
     "fd.probes",
     "fd.probe_failures",
     "fd.rtt_ms",  # per-probe round trip (the gray-node observable)
+    # adaptive gray-aware FD (monitoring/adaptive.py)
+    "fd.suspicion",            # per-probe tier-relative suspicion score
+    "fd.adapted_interval_ms",  # probe interval chosen per edge tier
+    "fd.gray_alerts",          # alerts fired by suspicion before hard-fail
     # cut detection (cut_detector.py)
     "cut.proposals_emitted",
     # consensus (fast_paxos.py / paxos.py)

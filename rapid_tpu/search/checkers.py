@@ -22,6 +22,7 @@ INVARIANTS = (
     "view-agreement",
     "config-parity",
     "fingerprint-agreement",
+    "gray-collateral",
 )
 
 
@@ -183,6 +184,30 @@ def _check_key_linearizable(key: bytes, ops: Sequence[ClientOp]) -> None:
                         f"non-monotonic reads on {key!r}: version {v1} then "
                         f"version {v2} later in real time",
                     )
+
+
+def check_gray_collateral(
+    faulted: Iterable[object], evicted: Iterable[object],
+) -> None:
+    """Pure gray plans (slow_node / lossy_link only) injure performance,
+    never liveness, so the only defensible eviction is of a node the plan
+    faulted. ``faulted`` is the label set of every gray rule's dst,
+    ``evicted`` the labels of every node a view change removed; an evicted
+    label outside ``faulted`` is a collateral eviction -- a healthy node
+    paying for someone else's grayness, the failure mode the adaptive FD's
+    tier-relative scoring exists to prevent. Callers must skip the check
+    (vacuous) when any gray rule carries ``dst=None``: an unscoped rule
+    faults every link, so every member is legitimately suspect."""
+    faulted_set = {str(f) for f in faulted}
+    collateral = sorted(
+        {str(e) for e in evicted if str(e) not in faulted_set}
+    )
+    if collateral:
+        raise InvariantViolation(
+            "gray-collateral",
+            f"healthy nodes evicted under a pure gray plan: "
+            f"{', '.join(collateral)} (faulted: {sorted(faulted_set)})",
+        )
 
 
 def check_view_agreement(views: Mapping[str, object]) -> None:

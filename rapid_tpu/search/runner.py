@@ -31,6 +31,7 @@ from .checkers import (
     InvariantViolation,
     check_config_parity,
     check_fingerprint_agreement,
+    check_gray_collateral,
     check_leader_agreement,
     check_linearizable_history,
     check_view_agreement,
@@ -68,6 +69,27 @@ def run_probe(spec: dict) -> ProbeResult:
     raise ValueError(f"unknown harness {harness!r}")
 
 
+def _gray_plan_victims(plan: FaultPlan):
+    """``(is_pure_gray, victims)`` for the gray-collateral invariant:
+    pure gray means every rule is a SlowNodeRule or LossyLinkRule (faults
+    that degrade, never kill). ``victims`` is the set of dst endpoints
+    those rules name, or None when any gray rule is unscoped (dst=None
+    faults every link, making collateral attribution vacuous)."""
+    from ..faults import LossyLinkRule, SlowNodeRule
+
+    if not plan.rules:
+        return False, None
+    victims = set()
+    for rule in plan.rules:
+        if not isinstance(rule, (SlowNodeRule, LossyLinkRule)):
+            return False, None
+        dst = rule.match.dst
+        if dst is None:
+            return True, None
+        victims.add(dst)
+    return True, victims
+
+
 def _collect(checks) -> List[dict]:
     violations: List[dict] = []
     for check in checks:
@@ -94,11 +116,25 @@ def run_engine_probe(spec: dict) -> ProbeResult:
         spec.get("horizon_ms", 4000), spec.get("ops", 40),
         keys=spec.get("keys", 6),
     )
-    violations = _collect([
+    checks = [
         lambda: check_linearizable_history(history),
         lambda: check_leader_agreement(fabric.live_digests()),
         lambda: check_view_agreement(fabric.map_versions()),
-    ])
+    ]
+    pure_gray, victims = _gray_plan_victims(plan)
+    if pure_gray and victims is not None:
+        evicted = [
+            entry["detail"]["evicted"]
+            for entry in fabric.journal()
+            if entry["kind"] == "view_install"
+            and "evicted" in entry["detail"]
+        ]
+        checks.append(
+            lambda: check_gray_collateral(
+                {str(v) for v in victims}, evicted
+            )
+        )
+    violations = _collect(checks)
     snapshot = {
         name: fabric.metrics.get(name) for name in COVERAGE_METRICS
     }
@@ -136,6 +172,7 @@ def run_sim_probe(spec: dict) -> ProbeResult:
         endpoint_slots,
     )
     from ..sim.driver import Simulator
+    from ..sim.engine import SimConfig
     from ..types import PutAck
 
     plan_spec = spec["plan"]
@@ -149,8 +186,18 @@ def run_sim_probe(spec: dict) -> ProbeResult:
     )
     device_plan = FaultPlan.from_json({**base, "rules": device_specs})
 
+    capacity = spec.get("capacity", 5)
+    # "fd_gray_confirm" > 0 runs the probe with the adaptive FD's sim-plane
+    # mirror on (engine.py gray streak path) -- the seam the regression
+    # suite uses to pin that adaptation does not perturb probe verdicts
     sim = Simulator(
-        spec.get("n", 4), capacity=spec.get("capacity", 5), seed=SIM_SEED
+        spec.get("n", 4), capacity=capacity,
+        config=SimConfig(
+            capacity=capacity,
+            fd_gray_confirm=spec.get("fd_gray_confirm", 0),
+            fd_gray_warmup=spec.get("fd_gray_warmup", 3),
+        ),
+        seed=SIM_SEED,
     ).ready()
     sim.enable_placement(**SIM_PLACEMENT)
     sim.enable_handoff(chunk_size=1024)
@@ -235,6 +282,22 @@ def run_sim_probe(spec: dict) -> ProbeResult:
         # every replication write went through
         checks.append(
             lambda: check_fingerprint_agreement(_sim_fingerprints(sim))
+        )
+    pure_gray, victims = _gray_plan_victims(device_plan)
+    if pure_gray and victims is not None:
+        # the sim probe never joins, so every cut entry is an eviction;
+        # rule dsts map to slots through the same seated-identity table
+        # apply_plan_at compiles the rules with
+        victim_labels = {
+            f"slot{slots[v]}" for v in victims if v in slots
+        }
+        evicted_labels = [
+            f"slot{int(c)}"
+            for rec in sim.view_changes
+            for c in rec.cut.reshape(-1)
+        ]
+        checks.append(
+            lambda: check_gray_collateral(victim_labels, evicted_labels)
         )
     violations = _collect(checks)
     snapshot = {name: sim.metrics.get(name) for name in COVERAGE_METRICS}

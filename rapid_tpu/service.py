@@ -427,6 +427,35 @@ class MembershipService:
             serving_partitions, serving_leaders = (
                 self._serving.leader_digest()
             )
+        # failure-detector plane: per-edge RTT/suspicion digest (worst
+        # first) and, when the adaptive factory is active, the derived
+        # per-tier parameters. Integer micro/milli units: the wire schema
+        # has no float scalar.
+        fd_subjects: Tuple[str, ...] = ()
+        fd_rtt_micros: Tuple[int, ...] = ()
+        fd_suspicion_milli: Tuple[int, ...] = ()
+        fd_tiers: Tuple[str, ...] = ()
+        fd_tier_interval_ms: Tuple[int, ...] = ()
+        fd_tier_threshold: Tuple[int, ...] = ()
+        fd_tier_flush_ms: Tuple[int, ...] = ()
+        edge_digest = getattr(self._fd_factory, "edge_digest", None)
+        if edge_digest is not None:
+            rows = edge_digest()
+            fd_subjects = tuple(r[0] for r in rows)
+            fd_rtt_micros = tuple(
+                int(round((r[1] if r[1] is not None else 0.0) * 1000))
+                for r in rows
+            )
+            fd_suspicion_milli = tuple(
+                int(round(r[2] * 1000)) for r in rows
+            )
+        tier_params = getattr(self._fd_factory, "tier_params", None)
+        if tier_params is not None:
+            tiers = tier_params()
+            fd_tiers = tuple(t[0] for t in tiers)
+            fd_tier_interval_ms = tuple(int(t[1]) for t in tiers)
+            fd_tier_threshold = tuple(int(t[2]) for t in tiers)
+            fd_tier_flush_ms = tuple(int(t[3]) for t in tiers)
         return ClusterStatusResponse(
             sender=self._my_addr,
             configuration_id=self._view.get_current_configuration_id(),
@@ -457,6 +486,13 @@ class MembershipService:
             serving_put_acks=serving_put_acks,
             serving_partitions=serving_partitions,
             serving_leaders=serving_leaders,
+            fd_subjects=fd_subjects,
+            fd_rtt_micros=fd_rtt_micros,
+            fd_suspicion_milli=fd_suspicion_milli,
+            fd_tiers=fd_tiers,
+            fd_tier_interval_ms=fd_tier_interval_ms,
+            fd_tier_threshold=fd_tier_threshold,
+            fd_tier_flush_ms=fd_tier_flush_ms,
         )
 
     # ------------------------------------------------------------------ #
@@ -1011,14 +1047,23 @@ class MembershipService:
             subjects = self._view.get_subjects_of(self._my_addr)
         except Exception:  # not in the ring (shouldn't happen; be safe)
             subjects = []
+        begin = getattr(self._fd_factory, "begin_configuration", None)
+        if begin is not None:
+            begin(tuple(subjects))
+        interval_for = getattr(self._fd_factory, "interval_ms_for", None)
         for subject in subjects:
             config_id = self._view.get_current_configuration_id()
             notifier = (
                 lambda s=subject, c=config_id: self._edge_failure_notification(s, c)
             )
             runnable = self._fd_factory.create_instance(subject, notifier)
+            interval_ms = self._settings.failure_detector_interval_ms
+            if interval_for is not None:
+                # adaptive factories probe per-tier: LAN edges faster than
+                # the static default, WAN edges slower (monitoring/adaptive)
+                interval_ms = interval_for(subject, interval_ms)
             job = self._scheduler.schedule_at_fixed_rate(
-                0, self._settings.failure_detector_interval_ms, runnable
+                0, interval_ms, runnable
             )
             self._failure_detector_jobs.append(job)
 
@@ -1054,10 +1099,13 @@ class MembershipService:
     def _alert_batcher_flush(self) -> None:
         if not self._alert_send_queue or self._last_enqueue_ms < 0:
             return
-        if (
-            self._scheduler.now_ms() - self._last_enqueue_ms
-            <= self._settings.batching_window_ms
-        ):
+        window_ms = self._settings.batching_window_ms
+        flush_for = getattr(self._fd_factory, "flush_window_ms", None)
+        if flush_for is not None:
+            # adaptive factories shrink the window while a gray alert is
+            # pending so the cut detector hears about it promptly
+            window_ms = flush_for(window_ms)
+        if self._scheduler.now_ms() - self._last_enqueue_ms <= window_ms:
             return
         messages = tuple(self._alert_send_queue)
         self._alert_send_queue.clear()

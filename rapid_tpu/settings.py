@@ -7,7 +7,105 @@ take the whole Settings (defaults cited per reference location).
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+# Bounds for every adaptive-FD knob, keyed "adaptive_fd.<field>". A pure
+# module-level literal so tools/check.py can lint it without importing this
+# module (settings-catalog rule): every AdaptiveFdSettings field must have an
+# entry here with its legal [min, max] range, and no stale keys may remain.
+SETTINGS_CATALOG = {
+    "adaptive_fd.enabled": {
+        "min": 0, "max": 1,
+        "doc": "kill switch: False preserves exact static-FD behavior",
+    },
+    "adaptive_fd.warmup_probes": {
+        "min": 1, "max": 64,
+        "doc": "RTT samples seeding the variance estimate before any "
+               "suspicion can accrue (cold-start bias guard)",
+    },
+    "adaptive_fd.gray_confirm": {
+        "min": 1, "max": 255,
+        "doc": "consecutive outlier/missed probes before a gray alert",
+    },
+    "adaptive_fd.outlier_z": {
+        "min": 1.0, "max": 16.0,
+        "doc": "robust z-score vs the tier peer group marking one probe "
+               "as an RTT outlier",
+    },
+    "adaptive_fd.min_spread_ms": {
+        "min": 0.0, "max": 1000.0,
+        "doc": "floor on the tier RTT spread so quiet LAN tiers cannot "
+               "flag microsecond jitter as outliers",
+    },
+    "adaptive_fd.interval_floor_ms": {
+        "min": 10, "max": 60000,
+        "doc": "fastest adapted probe interval (suspect edges)",
+    },
+    "adaptive_fd.interval_ceiling_ms": {
+        "min": 10, "max": 60000,
+        "doc": "slowest adapted probe interval (healthy WAN edges)",
+    },
+    "adaptive_fd.threshold_floor": {
+        "min": 1, "max": 255,
+        "doc": "lowest adapted hard-failure threshold",
+    },
+    "adaptive_fd.threshold_ceiling": {
+        "min": 1, "max": 255,
+        "doc": "highest adapted hard-failure threshold",
+    },
+    "adaptive_fd.flush_floor_ms": {
+        "min": 0, "max": 60000,
+        "doc": "shortest adapted alert-batching flush window",
+    },
+    "adaptive_fd.flush_ceiling_ms": {
+        "min": 0, "max": 60000,
+        "doc": "longest adapted alert-batching flush window",
+    },
+}
+
+
+@dataclass(frozen=True)
+class AdaptiveFdSettings:
+    """Knobs for the adaptive gray-aware failure detector
+    (monitoring/adaptive.py). Defaults are conservative: adaptation is off
+    (``enabled=False`` reproduces the static PingPong detector bit-for-bit)
+    and every controller output is clamped to the floors/ceilings below.
+    Bounds live in SETTINGS_CATALOG (linted by tools/check.py)."""
+
+    enabled: bool = False
+    warmup_probes: int = 4
+    gray_confirm: int = 3
+    outlier_z: float = 4.0
+    min_spread_ms: float = 5.0
+    interval_floor_ms: int = 250
+    interval_ceiling_ms: int = 4000
+    threshold_floor: int = 3
+    threshold_ceiling: int = 30
+    flush_floor_ms: int = 10
+    flush_ceiling_ms: int = 500
+
+    def __post_init__(self) -> None:
+        for key, value in (
+            ("enabled", int(self.enabled)),
+            ("warmup_probes", self.warmup_probes),
+            ("gray_confirm", self.gray_confirm),
+            ("outlier_z", self.outlier_z),
+            ("min_spread_ms", self.min_spread_ms),
+            ("interval_floor_ms", self.interval_floor_ms),
+            ("interval_ceiling_ms", self.interval_ceiling_ms),
+            ("threshold_floor", self.threshold_floor),
+            ("threshold_ceiling", self.threshold_ceiling),
+            ("flush_floor_ms", self.flush_floor_ms),
+            ("flush_ceiling_ms", self.flush_ceiling_ms),
+        ):
+            bounds = SETTINGS_CATALOG[f"adaptive_fd.{key}"]
+            assert bounds["min"] <= value <= bounds["max"], (
+                f"adaptive_fd.{key}={value!r} outside "
+                f"[{bounds['min']}, {bounds['max']}]"
+            )
+        assert self.interval_floor_ms <= self.interval_ceiling_ms
+        assert self.threshold_floor <= self.threshold_ceiling
+        assert self.flush_floor_ms <= self.flush_ceiling_ms
 
 
 @dataclass
@@ -58,6 +156,12 @@ class Settings:
     fd_failure_threshold: int = 10
     fd_window: int = 10
     fd_window_threshold: float = 0.4
+
+    # Adaptive gray-aware failure detection (monitoring/adaptive.py):
+    # per-tier RTT-outlier scoring with adapted probe intervals, failure
+    # thresholds, and alert-flush windows. Off by default; the enabled
+    # flag is the kill switch back to the static reference behavior.
+    adaptive_fd: AdaptiveFdSettings = field(default_factory=AdaptiveFdSettings)
 
     def __post_init__(self) -> None:
         assert self.fd_policy in ("cumulative", "windowed"), (

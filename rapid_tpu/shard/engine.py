@@ -165,6 +165,8 @@ def state_shardings(mesh: Mesh) -> SimState:
         fd_fail=row,
         fd_hist=row,
         fd_seen=row,
+        fd_streak=row,
+        fd_ok=row,
         alerted=row,
         reports=rep,
         arrival_hist=rep,
@@ -255,6 +257,7 @@ def _sharded_round(
     probed = edge_live & observer_up
     fail_event = probed & ~probe_ok
     fd_fail, fd_hist, fd_seen = state.fd_fail, state.fd_hist, state.fd_seen
+    fd_streak, fd_ok = state.fd_streak, state.fd_ok
 
     if config.fd_policy == "windowed":
         fd_hist, fd_seen, new_down = windowed_fd_phase(
@@ -265,6 +268,24 @@ def _sharded_round(
             fail_event & (state.fd_fail < jnp.uint8(255))
         ).astype(jnp.uint8)
         new_down = probed & (fd_fail >= config.fd_threshold) & ~state.alerted
+        if config.fd_gray_confirm > 0:
+            # gray streak mirror over the local observer rows (identical
+            # math to sim.engine.step's cumulative branch)
+            ok_event = probed & probe_ok
+            fd_streak = state.fd_streak + (
+                fail_event & (state.fd_streak < jnp.uint8(255))
+            ).astype(jnp.uint8)
+            fd_streak = jnp.where(ok_event, jnp.uint8(0), fd_streak)
+            fd_ok = state.fd_ok + (
+                ok_event & (state.fd_ok < jnp.uint8(255))
+            ).astype(jnp.uint8)
+            gray_down = (
+                fail_event
+                & (fd_streak >= config.fd_gray_confirm)
+                & (state.fd_ok >= config.fd_gray_warmup)
+                & ~state.alerted
+            )
+            new_down = new_down | gray_down
     alerted = state.alerted | new_down
 
     # --- alert fan-out: local scatter + psum(OR) over ICI ------------------
@@ -292,6 +313,8 @@ def _sharded_round(
         fd_fail=fd_fail,
         fd_hist=fd_hist,
         fd_seen=fd_seen,
+        fd_streak=fd_streak,
+        fd_ok=fd_ok,
         alerted=alerted,
         round=state.round + 1,
         rng_key=key,

@@ -88,6 +88,17 @@ class SimConfig:
     fd_policy: str = "cumulative"
     fd_window: int = 10
     fd_window_threshold: float = 0.4
+    # Adaptive gray-aware FD mirror (monitoring/adaptive.py). When
+    # fd_gray_confirm > 0, an edge with an established healthy history
+    # (>= fd_gray_warmup successful probes) also alerts after
+    # fd_gray_confirm CONSECUTIVE failed probes -- the sim-plane analogue
+    # of the adaptive detector's miss-streak suspicion (device probes
+    # carry no RTT, and a gray node past the probe timeout is exactly a
+    # consecutive-miss streak). 0 disables the gray path entirely (the
+    # static parity default; fd_streak/fd_ok are then never touched).
+    # Cumulative policy only: the windowed policy is already streak-like.
+    fd_gray_confirm: int = 0
+    fd_gray_warmup: int = 3
     # Extra proposal rows past the G group rows, reserved for values proposed
     # by bridged real nodes (sim/bridge.py registers their actual fast-round
     # votes into these rows). 0 = all-simulated cluster.
@@ -122,6 +133,18 @@ class SimConfig:
             f"is uint8 and saturates at 255, so a larger threshold would "
             f"never fire), got {self.fd_threshold}"
         )
+        assert 0 <= self.fd_gray_confirm <= 255, (
+            f"fd_gray_confirm must be in [0, 255] (uint8 streak counter; "
+            f"0 disables), got {self.fd_gray_confirm}"
+        )
+        assert 1 <= self.fd_gray_warmup <= 255, (
+            f"fd_gray_warmup must be in [1, 255] (uint8 success counter), "
+            f"got {self.fd_gray_warmup}"
+        )
+        assert self.fd_gray_confirm == 0 or self.fd_policy == "cumulative", (
+            "the gray streak path mirrors the adaptive detector on top of "
+            "the cumulative policy only"
+        )
 
     @property
     def proposal_rows(self) -> int:
@@ -153,6 +176,11 @@ class SimState:
     # per-round HBM traffic at 1M nodes vs int32)
     fd_hist: jax.Array  # uint16[C, K] last-W probe outcomes (windowed policy)
     fd_seen: jax.Array  # uint8[C, K] probes recorded, saturating at W (<=16)
+    fd_streak: jax.Array  # uint8[C, K] consecutive failed probes (gray path;
+    # resets to 0 on any successful probe, saturates at 255)
+    fd_ok: jax.Array  # uint8[C, K] successful probes observed, saturating at
+    # 255 (>= fd_gray_warmup establishes the healthy history the gray
+    # streak alert requires)
     alerted: jax.Array  # bool[C, K] edge already reported DOWN
     reports: jax.Array  # bool[G, C, K] per-group report tables (dst, ring)
     arrival_hist: jax.Array  # bool[Dmax, C, K] DOWN alerts aged 1..Dmax rounds
@@ -215,6 +243,8 @@ def initial_state(
         fd_fail=jnp.zeros((c, k), jnp.uint8),
         fd_hist=jnp.zeros((c, k), jnp.uint16),
         fd_seen=jnp.zeros((c, k), jnp.uint8),
+        fd_streak=jnp.zeros((c, k), jnp.uint8),
+        fd_ok=jnp.zeros((c, k), jnp.uint8),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         arrival_hist=jnp.zeros((config.max_delivery_delay, c, k), bool),
@@ -545,6 +575,7 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
         observer_up = observer_up & my_turn[:, None]
 
     fd_fail, fd_hist, fd_seen = state.fd_fail, state.fd_hist, state.fd_seen
+    fd_streak, fd_ok = state.fd_streak, state.fd_ok
     if config.fd_policy == "windowed":
         probed = edge_live & observer_up
         fd_hist, fd_seen, new_down = windowed_fd_phase(
@@ -564,6 +595,26 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
             & (fd_fail >= config.fd_threshold)
             & ~state.alerted
         )
+        if config.fd_gray_confirm > 0:
+            # gray streak path (statically elided when disabled): a probe
+            # that succeeds resets the streak; one that fails extends it,
+            # and a streak of fd_gray_confirm on an edge with >=
+            # fd_gray_warmup past successes fires like a hard failure
+            ok_event = edge_live & observer_up & probe_ok
+            fd_streak = state.fd_streak + (
+                fail_event & (state.fd_streak < jnp.uint8(255))
+            ).astype(jnp.uint8)
+            fd_streak = jnp.where(ok_event, jnp.uint8(0), fd_streak)
+            fd_ok = state.fd_ok + (
+                ok_event & (state.fd_ok < jnp.uint8(255))
+            ).astype(jnp.uint8)
+            gray_down = (
+                fail_event
+                & (fd_streak >= config.fd_gray_confirm)
+                & (state.fd_ok >= config.fd_gray_warmup)
+                & ~state.alerted
+            )
+            new_down = new_down | gray_down
         alerted = state.alerted | new_down
 
     # --- alert routing (dst-indexed): on ring k the subject and observer
@@ -590,6 +641,8 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
         fd_fail=fd_fail,
         fd_hist=fd_hist,
         fd_seen=fd_seen,
+        fd_streak=fd_streak,
+        fd_ok=fd_ok,
         alerted=alerted,
         round=state.round + 1,
         rng_key=key,
@@ -713,6 +766,19 @@ def _run_until_decided_const(
         fire_probe = jnp.maximum(
             config.fd_threshold - state.fd_fail.astype(jnp.int32), 1
         )
+        if config.fd_gray_confirm > 0:
+            # gray streak path: with a constant fault plane a failing edge
+            # fails every probe, so the streak alert fires at probe
+            # confirm - streak0 (>= 1) on edges whose healthy history was
+            # established before this dispatch (fd_ok cannot advance on a
+            # failing edge, so the qualification is constant here)
+            qualified = state.fd_ok >= config.fd_gray_warmup
+            gray_probe = jnp.maximum(
+                config.fd_gray_confirm - state.fd_streak.astype(jnp.int32), 1
+            )
+            fire_probe = jnp.where(
+                qualified, jnp.minimum(fire_probe, gray_probe), fire_probe
+            )
         fires = fail_event & ~state.alerted
     if rpi > 1:
         fire_round = p_rel[:, None] + 1 + (fire_probe - 1) * rpi
@@ -820,6 +886,28 @@ def _run_until_decided_const(
         state.fd_fail.astype(jnp.int32) + probes * fail_event.astype(jnp.int32),
         255,
     ).astype(jnp.uint8)
+    if config.fd_gray_confirm > 0:
+        # reconstruct the streak counters the executed rounds produced:
+        # constant outcome means a failing edge's streak grows by its probe
+        # count (saturating) and a succeeding edge's resets with any probe
+        ok_event = edge_live & observer_up & probe_ok
+        fd_streak = jnp.minimum(
+            state.fd_streak.astype(jnp.int32)
+            + probes * fail_event.astype(jnp.int32),
+            255,
+        )
+        fd_streak = jnp.where(
+            ok_event & (probes >= 1), 0, fd_streak
+        ).astype(jnp.uint8)
+        fd_ok = jnp.where(
+            ok_event,
+            jnp.minimum(state.fd_ok.astype(jnp.int32) + probes, 255),
+            state.fd_ok.astype(jnp.int32),
+        ).astype(jnp.uint8)
+        return dataclasses.replace(
+            final, fd_fail=fd_fail, fd_streak=fd_streak, fd_ok=fd_ok,
+            alerted=alerted,
+        )
     return dataclasses.replace(final, fd_fail=fd_fail, alerted=alerted)
 
 
@@ -889,6 +977,8 @@ def device_initial_state(
         fd_fail=jnp.zeros((c, k), jnp.uint8),
         fd_hist=jnp.zeros((c, k), jnp.uint16),
         fd_seen=jnp.zeros((c, k), jnp.uint8),
+        fd_streak=jnp.zeros((c, k), jnp.uint8),
+        fd_ok=jnp.zeros((c, k), jnp.uint8),
         alerted=jnp.zeros((c, k), bool),
         reports=jnp.zeros((g, c, k), bool),
         arrival_hist=jnp.zeros((config.max_delivery_delay, c, k), bool),

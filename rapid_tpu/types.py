@@ -364,6 +364,18 @@ class ClusterStatusResponse:
     serving_put_acks: int = 0
     serving_partitions: Tuple[int, ...] = ()
     serving_leaders: Tuple[str, ...] = ()
+    # failure-detector plane: parallel per-edge arrays (worst edge first --
+    # suspicion desc, then RTT desc) and, when adaptive FD is on, parallel
+    # per-tier arrays of the derived controller parameters. RTT in
+    # microseconds and suspicion in thousandths because the wire schema
+    # carries no float scalar.
+    fd_subjects: Tuple[str, ...] = ()
+    fd_rtt_micros: Tuple[int, ...] = ()
+    fd_suspicion_milli: Tuple[int, ...] = ()
+    fd_tiers: Tuple[str, ...] = ()
+    fd_tier_interval_ms: Tuple[int, ...] = ()
+    fd_tier_threshold: Tuple[int, ...] = ()
+    fd_tier_flush_ms: Tuple[int, ...] = ()
 
 
 @dataclass(frozen=True)

@@ -30,6 +30,9 @@ The gray-failure quartet (ISSUE 6) rides the same registry:
   zone partitioned; reports per-zone detection->decision latency.
 - gray-slow-node: a node that answers EVERY message, just slower than the
   probe deadline -- alive, processing, and evicted with zero collateral.
+- gray-flapping: a node oscillating slow/healthy across three windows; the
+  adaptive FD (ISSUE 14) must evict inside the first slow window with zero
+  collateral evictions and no view flip-flop afterwards.
 - clock-skew: one node's entire timer stack runs on a drifted clock while
   the cluster churns through a join + a crash around it.
 - rolling-upgrade: a mixed wire-version cluster (half the nodes encode with
@@ -454,6 +457,95 @@ def scenario_gray_slow_node(seed=7, n=5, response_delay_ms=5000):
     }
 
 
+def scenario_gray_flapping(seed=17, n=5, response_delay_ms=5000):
+    """Gray flapping: node n-1 oscillates between slow (answers every message
+    ``response_delay_ms`` late) and fully healthy across three 20 s slow
+    windows separated by 20 s healthy gaps. The adaptive failure detector
+    (Settings.adaptive_fd) must convert the miss streak into an eviction
+    within the FIRST slow window's budget -- before a healthy gap can reset
+    a windowed score -- with zero collateral evictions, and the view must
+    not flip-flop when the later windows open and close around the already
+    evicted node."""
+    from rapid_tpu.faults import FaultPlan
+    from rapid_tpu.observability import global_metrics
+    from rapid_tpu.settings import AdaptiveFdSettings, Settings
+    sys.path.insert(0, "tests")
+    from harness import ClusterHarness
+
+    t0 = time.perf_counter()
+    slow_windows = ((0, 20_000), (40_000, 60_000), (80_000, 100_000))
+    settings = Settings(adaptive_fd=AdaptiveFdSettings(enabled=True))
+    h = ClusterHarness(seed=seed, use_static_fd=False, settings=settings)
+    victim = h.addr(n - 1)
+    h.with_faults(
+        FaultPlan(seed=seed).slow_node(
+            victim, response_delay_ms, windows=slow_windows
+        )
+    )
+    _bootstrap(h, n)
+    # soak healthy: gray scoring only activates on warmed-up edges
+    # (adaptive_fd.warmup_probes successful samples), and a real gray fault
+    # hits a long-running cluster, not one mid-bootstrap
+    h.scheduler.run_until(lambda: False, timeout_ms=8_000)
+
+    def gray_alert_total() -> int:
+        return sum(
+            value
+            for kind, name, _labels, value in global_metrics().collect()
+            if kind == "counter" and name == "fd.gray_alerts"
+        )
+
+    gray_before = gray_alert_total()
+    h.nemesis.arm()  # window 1 opens: the victim turns gray now
+    start_virtual = h.scheduler.now_ms()
+    vic = h.instances.pop(victim)  # keeps RUNNING: flapping, not dead
+    try:
+        h.wait_and_verify_agreement(n - 1)
+        detect_ms = h.scheduler.now_ms() - start_virtual
+        survivor = h.instances[h.addr(0)]
+        survivors = set(survivor.get_memberlist())
+        config_after_cut = survivor.get_current_configuration_id()
+        # ride out the healthy gap + windows 2 and 3: the evicted node
+        # flapping back to healthy (and slow again) must not re-enter the
+        # view or cut anyone else -- no flip-flop
+        h.scheduler.run_until(
+            lambda: False,
+            timeout_ms=slow_windows[-1][1] + 20_000 - detect_ms,
+        )
+        virtual_ms = h.scheduler.now_ms() - start_virtual
+        stable = (
+            set(survivor.get_memberlist()) == survivors
+            and survivor.get_current_configuration_id() == config_after_cut
+        )
+        victim_alive = vic.get_membership_size() >= 1
+    finally:
+        vic.shutdown()
+        h.shutdown()
+    expected = {h.addr(i) for i in range(n - 1)}
+    gray_alerts = gray_alert_total() - gray_before
+    # budget: the cut must land inside slow window 1 (20 s); a detector that
+    # needs the flapping node to stay gray across windows would miss this
+    window_budget_ms = slow_windows[0][1] - slow_windows[0][0]
+    return {
+        "config": (
+            f"gray flapping: {n} nodes, victim {response_delay_ms} ms late "
+            f"across {len(slow_windows)} windows (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": virtual_ms,
+        "detect_ms": detect_ms,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": bool(
+            survivors == expected
+            and victim_alive
+            and detect_ms <= window_budget_ms
+            and stable
+            and gray_alerts > 0  # the adaptive path drove it, not fallback
+        ),
+        "gray_alerts": int(gray_alerts),
+    }
+
+
 def scenario_clock_skew(seed=13, n=5, offset_ms=350, rate=1.25):
     """One node's ENTIRE timer stack -- FD probe intervals, batching windows,
     retry backoff, message deadlines -- runs on a clock drifting at ``rate``x
@@ -688,6 +780,7 @@ register("nemesis-protocol", scenario_nemesis_protocol, seed=7, n=5)
 register("nemesis-smoke", scenario_nemesis_smoke, n=1000, seed=7)
 register("wan-zone-loss", scenario_wan_zone_loss, seed=11)
 register("gray-slow-node", scenario_gray_slow_node, seed=7)
+register("gray-flapping", scenario_gray_flapping, seed=17)
 register("clock-skew", scenario_clock_skew, seed=13)
 register("rolling-upgrade", scenario_rolling_upgrade, seed=21)
 register("serving-sawtooth", scenario_serving_sawtooth, seed=31)
@@ -704,7 +797,8 @@ register("flip-flop-join-1m", scenario_flip_flop_with_join_wave,
 BATTERY = [
     "cross-plane-10", "crash-1k", "crash-10k", "one-way-loss-50k",
     "flip-flop-join-100k", "nemesis-smoke", "wan-zone-loss",
-    "gray-slow-node", "clock-skew", "rolling-upgrade", "serving-sawtooth",
+    "gray-slow-node", "gray-flapping", "clock-skew", "rolling-upgrade",
+    "serving-sawtooth",
 ]
 SCALE_1M = ["crash-1m", "one-way-loss-1m", "flip-flop-join-1m"]
 

@@ -1,0 +1,567 @@
+"""Adaptive gray-aware failure detection (ISSUE 14).
+
+Pins the tentpole contracts layer by layer:
+
+- scoring (monitoring/adaptive.py): a sustained RTT outlier streak or miss
+  streak against an established healthy history ripens suspicion to >= 1
+  and fires through the EXISTING alert path before the hard
+  failure_threshold; warmup gates a fresh (or dead-on-arrival) edge onto
+  the unchanged static path;
+- safety under the nemesis algebra: clock skew (both directions) cannot
+  masquerade as outlierness because every edge of an observer is measured
+  with the same injectable probe clock;
+- controllers: probe interval is RTT-proportional per tier and floored
+  while the tier holds a suspect, the hard threshold keeps the static
+  detection-time budget, and the alert flush window drops to the floor
+  while a gray alert is ripe;
+- cluster level: an adaptive cluster evicts exactly the gray node (zero
+  collateral) and faster than the static budget, with the per-edge/per-tier
+  telemetry exposed in ClusterStatusResponse across both wires;
+- search plane: the corpus-* pinned plans and the RAPID_BUG_NEWROW_SYNC
+  rediscovery stay green with adaptation enabled (the sim probe's
+  fd_gray_confirm seam);
+- sim plane: the gray streak mirror is bit-identical between the scan path
+  and the closed-form fast path, including dispatch-boundary resume and
+  staggered probe phases.
+"""
+
+import json
+import pathlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rapid_tpu import Endpoint, Settings
+from rapid_tpu.faults import FaultPlan, SkewedScheduler
+from rapid_tpu.messaging import grpc_transport as gt
+from rapid_tpu.messaging.codec import decode, encode
+from rapid_tpu.messaging.wire_schema import MSG
+from rapid_tpu.monitoring.adaptive import (
+    TIER_DEFAULT,
+    TIER_RACK,
+    TIER_REGION,
+    TIER_WAN,
+    TIER_ZONE,
+    AdaptivePingPongFactory,
+    topology_tier_resolver,
+)
+from rapid_tpu.observability import Metrics, global_metrics
+from rapid_tpu.runtime.futures import Promise
+from rapid_tpu.runtime.scheduler import VirtualScheduler
+from rapid_tpu.search.runner import run_probe
+from rapid_tpu.settings import AdaptiveFdSettings
+from rapid_tpu.sim.driver import Simulator
+from rapid_tpu.sim.engine import (
+    SimConfig,
+    const_inputs,
+    run_rounds_const,
+    run_until_decided_const,
+)
+from rapid_tpu.sim.topology import LatencyTopology
+from rapid_tpu.types import ClusterStatusResponse, ProbeResponse
+
+from harness import ClusterHarness
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = sorted((REPO / "scenarios" / "corpus").glob("*.json"))
+
+OBSERVER = Endpoint.from_parts("10.9.0.1", 40)
+SUBJECTS = tuple(
+    Endpoint.from_parts("10.9.0.%d" % i, 50) for i in range(2, 6)
+)
+
+
+def _adaptive_settings(**overrides) -> Settings:
+    return Settings(
+        adaptive_fd=AdaptiveFdSettings(enabled=True, **overrides)
+    )
+
+
+class _Responder:
+    """Per-subject scripted probe behavior: a lag in ms (delivered via the
+    scheduler, like a real slow node) or None for a missed probe."""
+
+    def __init__(self, sched: VirtualScheduler) -> None:
+        self.sched = sched
+        self.lag = {}
+
+    def send_message_best_effort(self, remote, msg) -> Promise:
+        p = Promise()
+        lag = self.lag[remote]
+        if lag is None:
+            p.try_set_exception(TimeoutError(f"{remote} past the deadline"))
+        else:
+            self.sched.schedule(lag, lambda: p.try_set_result(ProbeResponse()))
+        return p
+
+
+def _edge_set(sched, metrics=None, tier_of=None, settings=None, lag_ms=10,
+              subjects=SUBJECTS, clock=None):
+    """A factory plus one detector per subject, all answering at lag_ms."""
+    responder = _Responder(sched)
+    factory = AdaptivePingPongFactory(
+        OBSERVER, responder,
+        settings if settings is not None else _adaptive_settings(),
+        metrics=metrics, clock=clock if clock is not None else sched.now_ms,
+        tier_of=tier_of,
+    )
+    fired = []
+    detectors = {}
+    for s in subjects:
+        responder.lag[s] = lag_ms
+        detectors[s] = factory.create_instance(s, lambda s=s: fired.append(s))
+    return factory, responder, detectors, fired
+
+
+def _tick(sched, detectors, settle_ms=600):
+    for det in detectors.values():
+        det()
+    sched.run_for(settle_ms)
+
+
+def _warm(sched, detectors, rounds=4):
+    for _ in range(rounds):
+        _tick(sched, detectors)
+
+
+def _gray_alert_total() -> float:
+    return sum(
+        value for kind, name, _, value in global_metrics().collect()
+        if kind == "counter" and name == "fd.gray_alerts"
+    )
+
+
+# ---------------------------------------------------------------------------
+# suspicion scoring
+# ---------------------------------------------------------------------------
+
+
+def test_soft_gray_outlier_streak_fires_before_hard_path():
+    """A node that still answers -- just far outside its tier's band --
+    accrues an outlier streak and gray-alerts with the hard counter at 0."""
+    sched = VirtualScheduler()
+    metrics = Metrics()
+    _, responder, dets, fired = _edge_set(sched, metrics=metrics)
+    victim = SUBJECTS[0]
+    _warm(sched, dets)
+    assert all(det.suspicion() == 0.0 for det in dets.values())
+
+    responder.lag[victim] = 500  # alive, late: tier peers sit at 10 ms
+    for expect in (1 / 3, 2 / 3, 1.0):
+        _tick(sched, dets)
+        assert dets[victim].suspicion() == pytest.approx(expect)
+    assert dets[victim].has_failed()
+    assert dets[victim]._failure_count == 0  # noqa: SLF001 -- gray, not hard
+    assert all(dets[s].suspicion() == 0.0 for s in SUBJECTS[1:])
+
+    assert fired == [] and metrics.get("fd.gray_alerts") in (None, 0)
+    _tick(sched, dets)  # the ripe suspicion rides the normal alert tick
+    assert fired == [victim]
+    assert metrics.get("fd.gray_alerts") == 1
+
+
+def test_hard_gray_miss_streak_and_success_reset():
+    """Misses against an established history ripen suspicion in
+    gray_confirm probes; one answered probe resets the miss streak."""
+    sched = VirtualScheduler()
+    metrics = Metrics()
+    _, responder, dets, fired = _edge_set(sched, metrics=metrics)
+    victim = SUBJECTS[0]
+    _warm(sched, dets)
+
+    responder.lag[victim] = None
+    _tick(sched, dets)
+    _tick(sched, dets)
+    assert dets[victim].suspicion() == pytest.approx(2 / 3)
+    responder.lag[victim] = 10  # a healthy answer clears the streak
+    _tick(sched, dets)
+    assert dets[victim].suspicion() == 0.0
+
+    responder.lag[victim] = None
+    for _ in range(3):
+        _tick(sched, dets)
+    assert dets[victim].suspicion() >= 1.0 and dets[victim].has_failed()
+    # the gray path concluded with the hard counter far from its threshold
+    assert dets[victim]._failure_count == 5  # noqa: SLF001
+    _tick(sched, dets)
+    assert fired == [victim] and metrics.get("fd.gray_alerts") == 1
+
+
+def test_warmup_gates_fresh_and_dead_on_arrival_edges():
+    """Below warmup_probes samples an edge can never be gray-suspected: a
+    dead-on-arrival subject takes the static hard path unchanged."""
+    sched = VirtualScheduler()
+    metrics = Metrics()
+    _, responder, dets, fired = _edge_set(
+        sched, metrics=metrics, subjects=SUBJECTS[:1], lag_ms=None
+    )
+    victim = SUBJECTS[0]
+    for _ in range(9):  # adapted threshold == static 10 on a cold tier
+        _tick(sched, dets)
+        assert dets[victim].suspicion() == 0.0
+        assert not dets[victim].has_failed()
+    _tick(sched, dets)
+    assert dets[victim].has_failed()  # hard counter reached 10
+    assert dets[victim].suspicion() == 0.0
+    _tick(sched, dets)  # notification tick: hard alert, not a gray one
+    assert fired == [victim]
+    assert metrics.get("fd.gray_alerts") in (None, 0)
+
+
+def test_outliers_below_warmup_accrue_no_suspicion():
+    sched = VirtualScheduler()
+    _, responder, dets, _ = _edge_set(sched, subjects=SUBJECTS[:1],
+                                      lag_ms=900)
+    victim = SUBJECTS[0]
+    for _ in range(3):  # warmup_probes=4: three huge samples stay inert
+        _tick(sched, dets, settle_ms=1000)
+        assert dets[victim].suspicion() == 0.0
+    assert not dets[victim].has_failed()
+
+
+# ---------------------------------------------------------------------------
+# clock skew must not masquerade as outlierness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("offset_ms,rate", [(500, 2.0), (-200, 0.5)])
+def test_skewed_probe_clock_accrues_no_suspicion(offset_ms, rate):
+    """A drifted observer clock (either direction) scales every edge's
+    measured RTT together and its offset cancels in the subtraction, so no
+    edge outlies its tier and no suspicion accrues."""
+    inner = VirtualScheduler()
+    skewed = SkewedScheduler(inner, offset_ms=offset_ms, rate=rate)
+    _, responder, dets, fired = _edge_set(inner, clock=skewed.now_ms)
+    for _ in range(10):
+        _tick(inner, dets)
+    for det in dets.values():
+        assert det.suspicion() == 0.0
+        assert det.rtt_ms() == pytest.approx(rate * 10)
+    assert fired == []
+
+
+def test_adaptive_cluster_tolerates_clock_skew_without_gray_alerts():
+    """test_clock_skew_cluster_converges_with_no_collateral, adaptation ON:
+    a drifted-but-fast member is never suspected, never evicted."""
+    n = 4
+    h = ClusterHarness(seed=5, use_static_fd=False,
+                       settings=_adaptive_settings())
+    skewed = h.addr(1)
+    h.with_faults(
+        FaultPlan(seed=5).clock_skew(skewed, offset_ms=350, rate=1.25)
+    )
+    h.nemesis.arm()
+    before = _gray_alert_total()
+    try:
+        h.create_cluster(n, parallel=False)
+        h.wait_and_verify_agreement(n)
+        h.fail_nodes([h.addr(n - 1)])
+        h.wait_and_verify_agreement(n - 1)
+        members = set(h.instances[h.addr(0)].get_memberlist())
+        assert skewed in members  # skew alone never evicts
+        assert members == {h.addr(i) for i in range(n - 1)}
+    finally:
+        h.shutdown()
+    assert _gray_alert_total() == before
+
+
+# ---------------------------------------------------------------------------
+# per-tier controllers
+# ---------------------------------------------------------------------------
+
+
+def test_interval_is_rtt_proportional_and_clamped():
+    for lag, expected in ((10, 250), (100, 800), (1000, 4000)):
+        sched = VirtualScheduler()
+        factory, _, dets, _ = _edge_set(sched, lag_ms=lag)
+        _warm(sched, dets, rounds=5)
+        assert factory.interval_ms_for(SUBJECTS[0], 1000) == expected
+
+
+def test_threshold_keeps_static_detection_budget():
+    # default budget: fd_failure_threshold=10 x interval 1000 ms
+    for lag, interval, expected in ((100, 800, 12), (10, 250, 30),
+                                    (1000, 4000, 3)):
+        sched = VirtualScheduler()
+        factory, _, dets, _ = _edge_set(sched, lag_ms=lag)
+        _warm(sched, dets, rounds=5)
+        assert factory._interval_no_metrics(SUBJECTS[0], 1000) == interval  # noqa: SLF001
+        assert factory.adapted_threshold(SUBJECTS[0]) == expected
+
+
+def test_suspect_tier_floors_interval_and_ripe_alert_floors_flush():
+    sched = VirtualScheduler()
+    factory, responder, dets, _ = _edge_set(sched, lag_ms=100)
+    _warm(sched, dets, rounds=5)
+    assert factory.interval_ms_for(SUBJECTS[1], 1000) == 800
+    assert factory.flush_window_ms(100) == 100
+    assert factory.flush_window_ms(5000) == 500  # clamped to the ceiling
+    assert factory.flush_window_ms(3) == 10      # clamped to the floor
+
+    victim = SUBJECTS[0]
+    responder.lag[victim] = None
+    _tick(sched, dets)  # one miss: the whole tier probes at the floor
+    assert dets[victim].suspicion() == pytest.approx(1 / 3)
+    assert factory.interval_ms_for(SUBJECTS[1], 1000) == 250
+    assert factory.flush_window_ms(100) == 100  # suspicion not ripe yet
+    _tick(sched, dets)
+    _tick(sched, dets)
+    assert dets[victim].suspicion() >= 1.0
+    assert factory.flush_window_ms(100) == 10
+
+
+def test_tier_params_separate_lan_from_wan():
+    rack = SUBJECTS[:2]
+    wan = SUBJECTS[2:]
+    tier_of = lambda s: TIER_RACK if s in rack else TIER_WAN  # noqa: E731
+    sched = VirtualScheduler()
+    factory, responder, dets, _ = _edge_set(sched, tier_of=tier_of)
+    for s in wan:
+        responder.lag[s] = 150
+    _warm(sched, dets, rounds=5)
+    params = {row[0]: row[1:] for row in factory.tier_params()}
+    assert params[TIER_RACK] == (250, 30, 100)
+    assert params[TIER_WAN] == (1200, 8, 100)
+    digest = factory.edge_digest()
+    assert [row[0] for row in digest[:2]] == sorted(str(s) for s in wan)
+
+
+def test_topology_tier_resolver_maps_widest_separating_boundary():
+    topo = LatencyTopology(racks=8, zones=4, regions=2,
+                           rack_rtt_ms=1, zone_rtt_ms=4, region_rtt_ms=20,
+                           inter_region_rtt_ms=150)
+    index = {SUBJECTS[0]: 8, SUBJECTS[1]: 4, SUBJECTS[2]: 2, SUBJECTS[3]: 1}
+    tier_of = topology_tier_resolver(topo, 0, index.get)
+    assert tier_of(SUBJECTS[0]) == TIER_RACK    # same rack as index 0
+    assert tier_of(SUBJECTS[1]) == TIER_ZONE    # same zone, other rack
+    assert tier_of(SUBJECTS[2]) == TIER_REGION  # same region, other zone
+    assert tier_of(SUBJECTS[3]) == TIER_WAN     # other region
+    assert tier_of(OBSERVER) == TIER_DEFAULT    # outside the topology
+
+
+# ---------------------------------------------------------------------------
+# cluster level: zero-collateral gray eviction + telemetry on the wire
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_cluster_evicts_gray_node_with_zero_collateral():
+    n = 4
+    h = ClusterHarness(seed=23, use_static_fd=False,
+                       settings=_adaptive_settings())
+    victim = h.addr(n - 1)
+    h.with_faults(FaultPlan(seed=23).slow_node(victim, response_delay_ms=5000))
+    h.nemesis.arm(epoch_ms=1 << 40)  # dormant during bootstrap
+    h.create_cluster(n, parallel=False)
+    h.wait_and_verify_agreement(n)
+    # gray scoring only activates on warmed-up edges (warmup_probes
+    # answered samples); real gray faults hit long-running clusters
+    h.scheduler.run_until(lambda: False, timeout_ms=8_000)
+
+    status = h.instances[h.addr(0)].get_cluster_status()
+    assert status.fd_subjects and len(status.fd_rtt_micros) == len(
+        status.fd_subjects
+    ) == len(status.fd_suspicion_milli)
+    assert status.fd_tiers and len(status.fd_tier_interval_ms) == len(
+        status.fd_tiers
+    )
+
+    before = _gray_alert_total()
+    h.nemesis.arm()  # the victim turns gray now
+    start = h.scheduler.now_ms()
+    vic = h.instances.pop(victim)  # keeps running: slow, not dead
+    try:
+        h.wait_and_verify_agreement(n - 1)
+        detect_ms = h.scheduler.now_ms() - start
+        survivors = set(h.instances[h.addr(0)].get_memberlist())
+        assert vic.get_membership_size() >= 1  # the gray node is alive
+    finally:
+        vic.shutdown()
+        h.shutdown()
+    assert survivors == {h.addr(i) for i in range(n - 1)}  # zero collateral
+    assert _gray_alert_total() > before
+    # gray_confirm misses at the static 1 s interval plus consensus: far
+    # inside the static hard path's ~12.5 s detection->decision budget
+    assert detect_ms <= 8_000, detect_ms
+
+
+def test_status_fd_fields_survive_both_wires():
+    """The fd columns of ClusterStatusResponse round-trip through the
+    msgpack codec and the gRPC oneofs; an old frame parses to defaults."""
+    r = ClusterStatusResponse(
+        sender=Endpoint.from_parts("h", 1), configuration_id=9,
+        membership_size=3,
+        fd_subjects=("h:2", "h:3"), fd_rtt_micros=(1500, 0),
+        fd_suspicion_milli=(333, 0), fd_tiers=("rack", "wan"),
+        fd_tier_interval_ms=(250, 1200), fd_tier_threshold=(30, 8),
+        fd_tier_flush_ms=(10, 100),
+    )
+    assert decode(encode(4, r)) == (4, r)
+    wire = gt.to_wire_response(r).SerializeToString(deterministic=True)
+    assert gt.from_wire_response(MSG["RapidResponse"].FromString(wire)) == r
+    old = ClusterStatusResponse(
+        sender=Endpoint.from_parts("h", 1), configuration_id=1,
+        membership_size=2,
+    )
+    wire = gt.to_wire_response(old).SerializeToString(deterministic=True)
+    back = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert back == old and back.fd_subjects == () and back.fd_tiers == ()
+
+
+# ---------------------------------------------------------------------------
+# search plane green with adaptation enabled (sim fd_gray_confirm seam)
+# ---------------------------------------------------------------------------
+
+# the known-bug plan from tests/test_search.py, reused verbatim so the
+# rediscovery runs against the same witness with adaptation switched on
+BUG_PLAN = {"seed": 3, "rules": [
+    {"type": "DropRule", "at": "egress", "windows": [[0, None]],
+     "src": None, "dst": "node:7003", "msg_types": ["Put"],
+     "probability": 1.0},
+    {"type": "PartitionRule", "at": "egress", "windows": [[1200, None]],
+     "src": None, "dst": "node:7000", "msg_types": None},
+    {"type": "DropRule", "at": "egress", "windows": [[1200, None]],
+     "src": None, "dst": "node:7002", "msg_types": ["Get"],
+     "probability": 1.0},
+]}
+
+ADAPTATION_ON = {"fd_gray_confirm": 3, "fd_gray_warmup": 3}
+
+
+class TestSearchPlaneWithAdaptation:
+    @pytest.mark.parametrize("path", CORPUS, ids=[p.stem for p in CORPUS])
+    def test_corpus_pins_stay_green_with_adaptation_enabled(self, path):
+        artifact = json.loads(path.read_text())
+        probe = {
+            k: v for k, v in artifact.items()
+            if k not in ("name", "description", "expect")
+        }
+        probe.update(ADAPTATION_ON)
+        result = run_probe(probe)
+        assert not result.violations, [
+            v["invariant"] for v in result.violations
+        ]
+
+    def test_newrow_sync_rediscovery_with_adaptation_enabled(
+        self, monkeypatch
+    ):
+        spec = {"harness": "engine", "n": 5, "partitions": 16, "replicas": 3,
+                "horizon_ms": 4000, "ops": 40, "keys": 6, "plan": BUG_PLAN,
+                **ADAPTATION_ON}
+        monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
+        assert {v["invariant"] for v in run_probe(spec).violations} == {
+            "linearizability"
+        }
+        monkeypatch.delenv("RAPID_BUG_NEWROW_SYNC")
+        assert not run_probe(spec).violations
+
+    def test_sim_probe_with_gray_mirror_deterministic_and_collateral_free(
+        self,
+    ):
+        """A pure-gray sim probe with the mirror on: the gray-collateral
+        invariant holds and the probe stays bit-deterministic."""
+        spec = {
+            "harness": "sim", "n": 4, "capacity": 5, "horizon_ms": 20_000,
+            "ops": 30, "keys": 8, **ADAPTATION_ON,
+            "plan": {"seed": 5, "rules": [
+                {"type": "SlowNodeRule", "at": "egress",
+                 "windows": [[5000, None]], "src": None,
+                 "dst": "10.0.0.3:5003", "msg_types": None,
+                 "response_delay_ms": 5000},
+            ]},
+        }
+        first = run_probe(spec)
+        second = run_probe(spec)
+        assert first.violations == second.violations == ()
+        assert first.coverage == second.coverage
+        assert first.info["view_changes"] >= 1  # the gray node was evicted
+
+
+# ---------------------------------------------------------------------------
+# sim plane: gray streak mirror, scan path vs closed-form fast path
+# ---------------------------------------------------------------------------
+
+
+def _assert_states_equal(a, b):
+    for name in a.__dataclass_fields__:
+        av, bv = np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        if name == "rng_key":
+            continue  # scan path consumes RNG per round; fast path does not
+        np.testing.assert_array_equal(av, bv, err_msg=f"field {name} diverged")
+
+
+def _gray_sim(config, seed=1, healthy_rounds=4, victim=5):
+    """A sim with warmed-up FD histories (healthy_rounds of clean probes)
+    and one node turned gray (alive, probes dropped)."""
+    sim = Simulator(config.capacity, config=config, seed=seed)
+    inputs = const_inputs(config, sim.alive)
+    sim.state = run_rounds_const(config, sim.state, inputs, healthy_rounds,
+                                 False)
+    sim.one_way_ingress_partition(np.array([victim]))
+    gray = const_inputs(config, sim.alive, probe_drop=sim._probe_drop_mask())  # noqa: SLF001
+    return sim, gray
+
+
+def test_gray_streak_path_matches_scan_path():
+    config = SimConfig(capacity=8, k=3, h=3, l=2, fd_threshold=10,
+                       fd_gray_confirm=3, fd_gray_warmup=2)
+    sim, gray = _gray_sim(config)
+    scan = run_rounds_const(config, sim.state, gray, 12, False)
+    fast = run_until_decided_const(config, sim.state, gray, jnp.int32(12),
+                                   True)
+    if int(fast.round) < int(scan.round):
+        fast = run_rounds_const(config, fast, gray,
+                                int(scan.round) - int(fast.round), False)
+    _assert_states_equal(scan, fast)
+    assert bool(scan.decided)
+
+
+def test_gray_streak_fires_before_static_threshold():
+    """Same gray plane, mirror on vs off: the streak path decides several
+    rounds before the cumulative counter reaches fd_threshold."""
+
+    def decide_round(confirm):
+        config = SimConfig(capacity=8, k=3, h=3, l=2, fd_threshold=10,
+                           fd_gray_confirm=confirm, fd_gray_warmup=2)
+        sim, gray = _gray_sim(config)
+        fast = run_until_decided_const(config, sim.state, gray,
+                                       jnp.int32(24), True)
+        assert bool(fast.decided)
+        return int(fast.round)
+
+    assert decide_round(3) <= decide_round(0) - 5
+
+
+def test_gray_streak_state_resumes_across_dispatches():
+    """fd_streak/fd_ok carried over a dispatch boundary must reconstruct
+    identically on the closed-form path."""
+    config = SimConfig(capacity=8, k=3, h=3, l=2, fd_threshold=10,
+                       fd_gray_confirm=4, fd_gray_warmup=2)
+    sim, gray = _gray_sim(config)
+    state_a = state_b = sim.state
+    for chunk in (2, 3, 2, 5):
+        state_a = run_rounds_const(config, state_a, gray, chunk, False)
+        state_b = run_until_decided_const(config, state_b, gray,
+                                          jnp.int32(chunk), True)
+        if int(state_b.round) < int(state_a.round):
+            state_b = run_rounds_const(
+                config, state_b, gray,
+                int(state_a.round) - int(state_b.round), False,
+            )
+        _assert_states_equal(state_a, state_b)
+
+
+def test_gray_streak_staggered_phases_matches_scan_path():
+    """rounds_per_interval > 1: only probing rounds advance the streak, in
+    both lowerings identically."""
+    config = SimConfig(capacity=16, k=4, h=3, l=2, fd_threshold=8,
+                       fd_gray_confirm=3, fd_gray_warmup=2,
+                       rounds_per_interval=4)
+    sim, gray = _gray_sim(config, seed=3, healthy_rounds=12, victim=9)
+    scan = run_rounds_const(config, sim.state, gray, 32, False)
+    fast = run_until_decided_const(config, sim.state, gray, jnp.int32(32),
+                                   True)
+    if int(fast.round) < int(scan.round):
+        fast = run_rounds_const(config, fast, gray,
+                                int(scan.round) - int(fast.round), False)
+    _assert_states_equal(scan, fast)

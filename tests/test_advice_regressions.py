@@ -587,3 +587,56 @@ def test_warmed_decision_loop_is_steady_state_clean():
     assert 3 in record2.cut
     assert after["compiles"] == before["compiles"]
     assert jitwatch.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# PR 14: RTT EWMA cold-start bias
+# ---------------------------------------------------------------------------
+
+
+def test_rtt_variance_seeds_from_first_k_samples_not_a_point_estimate():
+    """One slow first probe on a fresh WAN edge must not pin the deviation
+    estimate: rtt_var_ms stays None until RTT_SEED_SAMPLES answered probes,
+    then seeds from the window's mean absolute deviation (TCP's single-sample
+    R/2 point estimate would have locked in 200 ms here and flagged every
+    normal probe as an outlier for many EWMA half-lives). The srtt EWMA
+    itself is unchanged."""
+    from rapid_tpu.monitoring.pingpong import (
+        RTT_SEED_SAMPLES,
+        PingPongFailureDetector,
+    )
+    from rapid_tpu.runtime.scheduler import VirtualScheduler
+    from rapid_tpu.types import ProbeResponse
+
+    sched = VirtualScheduler()
+    lags = iter([400, 100, 100, 100, 100])
+
+    class _Lagged:
+        def send_message_best_effort(self, remote, msg):
+            p = Promise()
+            sched.schedule(next(lags), lambda: p.try_set_result(ProbeResponse()))
+            return p
+
+    fd = PingPongFailureDetector(
+        Endpoint.from_parts("a", 1), Endpoint.from_parts("b", 2), _Lagged(),
+        notifier=lambda: None, clock=sched.now_ms,
+    )
+    assert RTT_SEED_SAMPLES == 4
+    srtt = None
+    for i in range(4):
+        fd()
+        sched.run_for(401)
+        lag = 400 if i == 0 else 100
+        srtt = float(lag) if srtt is None else 0.875 * srtt + 0.125 * lag
+        assert fd.rtt_ms() == pytest.approx(srtt)  # EWMA path untouched
+        if i < 3:
+            assert fd.rtt_var_ms() is None  # seeding, not a point estimate
+    # seeded from the window's spread: mean 175, MAD (225 + 3*75) / 4
+    assert fd.rtt_var_ms() == pytest.approx(112.5)
+    # from the 5th sample on, the classic RTTVAR EWMA takes over
+    srtt_before = fd.rtt_ms()
+    fd()
+    sched.run_for(401)
+    assert fd.rtt_var_ms() == pytest.approx(
+        0.75 * 112.5 + 0.25 * abs(100 - srtt_before)
+    )

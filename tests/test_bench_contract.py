@@ -375,6 +375,31 @@ def test_messaging_dimension_json_contract(monkeypatch, capsys):
     assert parsed["messaging_throughput"] == entry
 
 
+def test_gray_detection_dimension_json_contract(monkeypatch, capsys):
+    """The gray_detection_ms entry of the one JSON line carries, for both
+    gray fault shapes (a node that stays gray, a node flapping slow/healthy
+    across windows), the static and adaptive detection->decision latencies
+    and their ratio, with the >= 2x adaptive speedup the dimension itself
+    asserts. Run at a reduced scale so the contract check stays cheap."""
+    monkeypatch.setattr(bench, "GRAY_N_NODES", 16)
+    entry = bench.run_gray_detection_dimension(seed=3)
+    assert entry["n"] == 16
+    for scenario in ("gray_slow_node", "gray_flapping"):
+        stats = entry[scenario]
+        assert stats["static_ms"] > stats["adaptive_ms"] > 0
+        assert stats["speedup"] >= 2.0
+    # flapping punishes the static counter extra: it must straddle a healthy
+    # gap the adaptive streak never sees
+    assert (
+        entry["gray_flapping"]["static_ms"]
+        > entry["gray_slow_node"]["static_ms"]
+    )
+    # and the emitter folds the entry into the artifact line verbatim
+    bench._emit_json({"value": 120.0, "virtual_ms": 11_100}, "cpu", [])
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["gray_detection_ms"] == entry
+
+
 def test_messaging_reactor_coalesces_vs_threaded_baseline(monkeypatch):
     """The A/B the refactor exists for, guarded at reduced scale: the
     threaded baseline pays exactly one write syscall per message by

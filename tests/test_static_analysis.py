@@ -107,6 +107,25 @@ def test_check_rules_prints_full_catalog():
         assert rule in proc.stdout
 
 
+def test_settings_catalog_lint_clean_and_two_sided():
+    """The settings-catalog lint passes on today's tree, and its contract
+    holds at runtime too: SETTINGS_CATALOG keys are exactly the
+    AdaptiveFdSettings fields (two-sided -- a knob without bounds or a
+    stale catalog row both fail), with each default inside its bounds."""
+    assert check.check_settings_catalog() == []
+    from dataclasses import fields as dc_fields
+
+    from rapid_tpu.settings import SETTINGS_CATALOG, AdaptiveFdSettings
+
+    knobs = {f"adaptive_fd.{f.name}" for f in dc_fields(AdaptiveFdSettings)}
+    assert set(SETTINGS_CATALOG) == knobs
+    defaults = AdaptiveFdSettings()
+    for key, entry in SETTINGS_CATALOG.items():
+        value = getattr(defaults, key.split(".", 1)[1])
+        assert entry["min"] <= value <= entry["max"], key
+        assert entry["doc"]
+
+
 def test_default_scan_skips_fixture_corpus():
     """The deliberately-bad exemplars must never leak into a default scan."""
     scanned = iter_py_files([Path("tests")])

@@ -67,6 +67,9 @@ RULE_DOCS = {
                    "types, sane windows/probabilities, a known harness",
     "gen-reach": "every fault Rule subclass must be reachable by the search "
                  "generator (GEN_RULES), or new faults stay untested",
+    "settings-catalog": "every adaptive-FD knob must be in SETTINGS_CATALOG "
+                        "with bounds its default satisfies, or operators "
+                        "tune blind",
     # tools/check.py -- concurrency hygiene
     "thread-daemon": "a non-daemon thread outlives shutdown and hangs exit; "
                      "mark daemon=True or provably join it",
@@ -618,6 +621,101 @@ def check_generator_reach() -> list[Finding]:
     return findings
 
 
+def check_settings_catalog() -> list[Finding]:
+    """Settings-catalog lint (the adaptive-FD knob discipline).
+
+    rapid_tpu/settings.py keeps SETTINGS_CATALOG, the pure-literal table of
+    every ``adaptive_fd.<knob>`` with its bounds and one-line doc -- the
+    table __post_init__ validates against and statusz/docs cite. Two-sided
+    freshness, same contract as RULE_CATALOG/GEN_RULES: every field of
+    AdaptiveFdSettings must have a catalog entry whose bounds are sane
+    (min <= max) and admit the field's default; every catalog key must name
+    a real field. All by AST walk -- importing settings would pull in the
+    package."""
+    findings: list[Finding] = []
+    path = REPO / "rapid_tpu" / "settings.py"
+
+    lits = _module_literals(path, {"SETTINGS_CATALOG"})
+    if "SETTINGS_CATALOG" not in lits:
+        findings.append(Finding(
+            path, 0, "settings-catalog",
+            "SETTINGS_CATALOG not found or not a pure literal",
+        ))
+        return findings
+    catalog, cat_line = lits["SETTINGS_CATALOG"]
+
+    # AdaptiveFdSettings fields with literal defaults, by AST
+    fields: dict = {}
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef) or node.name != "AdaptiveFdSettings":
+            continue
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+                and stmt.value is not None
+            ):
+                try:
+                    fields[stmt.target.id] = (
+                        ast.literal_eval(stmt.value), stmt.lineno
+                    )
+                except ValueError:
+                    pass
+
+    if not fields:
+        findings.append(Finding(
+            path, 0, "settings-catalog",
+            "AdaptiveFdSettings not found or has no literal-defaulted fields",
+        ))
+        return findings
+
+    for name, (default, lineno) in sorted(fields.items()):
+        key = f"adaptive_fd.{name}"
+        entry = catalog.get(key)
+        if entry is None:
+            findings.append(Finding(
+                path, lineno, "settings-catalog",
+                f"AdaptiveFdSettings.{name} missing from SETTINGS_CATALOG: "
+                "the knob ships without bounds or doc",
+            ))
+            continue
+        if not ({"min", "max", "doc"} <= set(entry)):
+            findings.append(Finding(
+                path, cat_line, "settings-catalog",
+                f"SETTINGS_CATALOG[{key!r}] must carry min/max/doc",
+            ))
+            continue
+        lo, hi = entry["min"], entry["max"]
+        if lo > hi:
+            findings.append(Finding(
+                path, cat_line, "settings-catalog",
+                f"SETTINGS_CATALOG[{key!r}] bounds inverted: {lo} > {hi}",
+            ))
+        default_n = float(default) if isinstance(default, bool) else default
+        if not (lo <= default_n <= hi):
+            findings.append(Finding(
+                path, lineno, "settings-catalog",
+                f"AdaptiveFdSettings.{name} default {default!r} outside "
+                f"its own catalog bounds [{lo}, {hi}]",
+            ))
+    for key in sorted(catalog):
+        if not key.startswith("adaptive_fd."):
+            findings.append(Finding(
+                path, cat_line, "settings-catalog",
+                f"SETTINGS_CATALOG key {key!r} outside the adaptive_fd. "
+                "namespace this catalog covers",
+            ))
+            continue
+        if key.split(".", 1)[1] not in fields:
+            findings.append(Finding(
+                path, cat_line, "settings-catalog",
+                f"SETTINGS_CATALOG lists {key!r} but AdaptiveFdSettings "
+                "has no such field",
+            ))
+    return findings
+
+
 def check_plan_corpus() -> list[Finding]:
     """Pinned-plan corpus lint over scenarios/corpus/*.json.
 
@@ -799,6 +897,7 @@ def run(paths: "list[str] | None" = None) -> list[Finding]:
     findings.extend(check_wire_tags())
     findings.extend(check_fault_rules())
     findings.extend(check_generator_reach())
+    findings.extend(check_settings_catalog())
     findings.extend(check_plan_corpus())
     return findings
 

@@ -79,6 +79,30 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
             f" leads={sum(1 for lead in status.serving_leaders if lead == str(status.sender))}"
             f"/{len(status.serving_partitions)}"
         )
+    # failure-detector digest: the node's worst monitored edges (already
+    # sorted suspicion desc, RTT desc by the service), the gray-failure
+    # signature an operator checks before any eviction shows up
+    if status.fd_subjects:
+        worst = list(
+            zip(status.fd_subjects, status.fd_rtt_micros,
+                status.fd_suspicion_milli)
+        )[:3]
+        edges = " ".join(
+            f"{subject}(rtt={rtt_us / 1000.0:.1f}ms"
+            f" susp={susp / 1000.0:.2f})"
+            for subject, rtt_us, susp in worst
+        )
+        lines.append(f"  fd-edges: monitored={len(status.fd_subjects)} {edges}")
+    if status.fd_tiers:
+        tiers = " ".join(
+            f"{tier}(interval={interval}ms threshold={threshold}"
+            f" flush={flush}ms)"
+            for tier, interval, threshold, flush in zip(
+                status.fd_tiers, status.fd_tier_interval_ms,
+                status.fd_tier_threshold, status.fd_tier_flush_ms,
+            )
+        )
+        lines.append(f"  fd-tiers: {tiers}")
     # transport summary: per-peer outbound queue depths (the backpressure
     # signature of a slow-reading peer) get a first-class line above the
     # raw metric digest they also appear in
@@ -143,6 +167,27 @@ def to_json(status: ClusterStatusResponse) -> dict:
             str(p): leader
             for p, leader in zip(
                 status.serving_partitions, status.serving_leaders
+            )
+        },
+        "fd_edges": {
+            subject: {
+                "rtt_ms": rtt_us / 1000.0,
+                "suspicion": susp / 1000.0,
+            }
+            for subject, rtt_us, susp in zip(
+                status.fd_subjects, status.fd_rtt_micros,
+                status.fd_suspicion_milli,
+            )
+        },
+        "fd_tiers": {
+            tier: {
+                "interval_ms": interval,
+                "threshold": threshold,
+                "flush_ms": flush,
+            }
+            for tier, interval, threshold, flush in zip(
+                status.fd_tiers, status.fd_tier_interval_ms,
+                status.fd_tier_threshold, status.fd_tier_flush_ms,
             )
         },
         "metrics": dict(zip(status.metric_names, status.metric_values)),
