@@ -229,6 +229,32 @@ def _write_telemetry() -> None:
         print(f"bench.py: wrote Prometheus text to {metrics_out}", file=sys.stderr, flush=True)
 
 
+def _device_info() -> dict:
+    """Device identity + topology stamped on every emitted JSON line, so a
+    curve point is attributable to the hardware that produced it (a v5e-8
+    number and a CPU number must never be comparable by accident). Never
+    raises: on the rc-17 outage path jax may be unimportable or deviceless,
+    and the artifact still has to go out."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        kinds = sorted({d.device_kind for d in devices})
+        return {
+            "device_kind": ",".join(kinds),
+            "device_count": len(devices),
+            "process_count": jax.process_count(),
+            # the sim plane shards over a 1-D mesh of every device
+            # (shard.engine.make_mesh); report that shape as the topology
+            "mesh_shape": {"nodes": len(devices)},
+        }
+    except Exception:  # noqa: BLE001 -- telemetry must never sink the artifact
+        return {
+            "device_kind": None, "device_count": 0,
+            "process_count": 0, "mesh_shape": None,
+        }
+
+
 def _emit_json(headline: dict, backend: str, sweep: list) -> None:
     merged = list(sweep) + [
         {
@@ -248,6 +274,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "unit": "ms",
                 "vs_baseline": round(headline["value"] / BASELINE_MS, 4),
                 "backend": backend,
+                **_device_info(),
                 "sweep": merged,
                 "wan_stable_view": _PROGRESS["wan"],
                 "serving_qps": _PROGRESS["serving"],
@@ -284,6 +311,7 @@ def _emit_outage_json(reason: str) -> None:
                 "outage": True,
                 "reason": reason,
                 "backend": _PROGRESS["backend"],
+                **_device_info(),
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "histograms": histograms,
             }

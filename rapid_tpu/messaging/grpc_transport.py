@@ -148,6 +148,7 @@ def to_wire_request(msg: T.RapidMessage):
         req.leaveMessage.sender.CopyFrom(_ep(msg.sender))
     elif isinstance(msg, T.ClusterStatusRequest):
         req.clusterStatusRequest.sender.CopyFrom(_ep(msg.sender))
+        req.clusterStatusRequest.includeHistory = msg.include_history
     elif isinstance(msg, T.HandoffRequest):
         h = req.handoffRequest
         h.sender.CopyFrom(_ep(msg.sender))
@@ -275,7 +276,8 @@ def _from_wire_request_content(req) -> T.RapidMessage:
         return T.LeaveMessage(sender=_ep_back(req.leaveMessage.sender))
     if which == "clusterStatusRequest":
         return T.ClusterStatusRequest(
-            sender=_ep_back(req.clusterStatusRequest.sender)
+            sender=_ep_back(req.clusterStatusRequest.sender),
+            include_history=int(req.clusterStatusRequest.includeHistory),
         )
     if which == "handoffRequest":
         m = req.handoffRequest
@@ -374,6 +376,7 @@ def to_wire_response(msg) :
         s.fdTierIntervalMs.extend(msg.fd_tier_interval_ms)
         s.fdTierThreshold.extend(msg.fd_tier_threshold)
         s.fdTierFlushMs.extend(msg.fd_tier_flush_ms)
+        s.history.extend(msg.history)
     elif isinstance(msg, T.PutAck):
         a = resp.putAck
         a.sender.CopyFrom(_ep(msg.sender))
@@ -454,6 +457,7 @@ def from_wire_response(resp):
             fd_tier_interval_ms=tuple(int(v) for v in m.fdTierIntervalMs),
             fd_tier_threshold=tuple(int(v) for v in m.fdTierThreshold),
             fd_tier_flush_ms=tuple(int(v) for v in m.fdTierFlushMs),
+            history=tuple(str(line) for line in m.history),
         )
     if which == "putAck":
         m = resp.putAck

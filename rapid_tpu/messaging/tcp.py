@@ -90,6 +90,7 @@ class _Connection:
         reactor: Optional[Reactor] = None,
         metrics: Optional[Metrics] = None,
         on_dial_outcome: Optional[Callable[[Endpoint, bool], None]] = None,
+        on_closed: Optional[Callable[["_Connection"], None]] = None,
     ) -> None:
         self.remote = remote
         self.reactor = reactor if reactor is not None else shared_reactor()
@@ -97,6 +98,7 @@ class _Connection:
         self.outstanding: Dict[int, Promise] = {}  # guarded-by: lock
         self.closed = False  # guarded-by: lock
         self._on_dial_outcome = on_dial_outcome
+        self._on_closed = on_closed
         self.channel = Channel.connect(
             self.reactor,
             (remote.hostname.decode(), remote.port),
@@ -127,6 +129,8 @@ class _Connection:
             self.outstanding.clear()
         if not channel.connected and self._on_dial_outcome is not None:
             self._on_dial_outcome(self.remote, False)
+        if self._on_closed is not None:
+            self._on_closed(self)
         for promise in pending:
             if not promise.done():
                 try:
@@ -405,6 +409,7 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
                 remote, self._settings.message_timeout_ms / 1000.0,
                 reactor=self._io, metrics=self.metrics,
                 on_dial_outcome=self._dial_outcome,
+                on_closed=self._forget_connection,
             )
         except OSError:
             self._dial_outcome(remote, False)
@@ -418,6 +423,16 @@ class TcpClientServer(IMessagingClient, IMessagingServer):
         if winner is not fresh:
             fresh.close()
         return winner
+
+    def _forget_connection(self, conn: _Connection) -> None:
+        """Evict a closed connection from the cache. Without this, every
+        departed peer leaves a closed _Connection in ``_connections``
+        forever -- the cache (and the transport_digest walk over it) grows
+        monotonically with peer churn. Identity-checked so a dial-race
+        loser's close can never evict the winning connection."""
+        with self._conn_lock:
+            if self._connections.get(conn.remote) is conn:
+                del self._connections[conn.remote]
 
     def _dial_outcome(self, remote: Endpoint, ok: bool) -> None:
         """Advance or clear the per-peer backoff gate. Failure delays follow

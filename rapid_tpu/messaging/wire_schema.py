@@ -107,7 +107,10 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("origin", "string", 3, False),
         ("flags", "int32", 4, False),
     ],
-    "ClusterStatusRequest": [("sender", "M:Endpoint", 1, False)],
+    "ClusterStatusRequest": [
+        ("sender", "M:Endpoint", 1, False),
+        ("includeHistory", "int32", 2, False),
+    ],
     "ClusterStatusResponse": [
         ("sender", "M:Endpoint", 1, False),
         ("configurationId", "int64", 2, False),
@@ -151,6 +154,9 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("fdTierIntervalMs", "int64", 30, True),
         ("fdTierThreshold", "int64", 31, True),
         ("fdTierFlushMs", "int64", 32, True),
+        # profiling plane exposure: the metric history-ring tail as JSON
+        # lines (one snapshot per line, MetricsHistory.to_wire)
+        ("history", "string", 33, True),
     ],
     "HandoffRequest": [
         ("sender", "M:Endpoint", 1, False),

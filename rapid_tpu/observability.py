@@ -155,6 +155,11 @@ METRIC_CATALOG = frozenset({
     "serving.leader_changes",
     "serving.reconciled_replicas",
     "serving.request_ms",
+    # profiling plane (profiling/, sim/driver.py, observability.py)
+    "profile.phase_ms",    # per-phase device attribution (histogram)
+    "profile.step_ms",     # shadow-measured full device step (histogram)
+    "profile.samples",     # shadow attribution samples taken
+    "profile.history_snapshots",  # metric history-ring snapshots recorded
 })
 
 # Dynamic name families: an f-string call site is legal iff its literal head
@@ -247,6 +252,15 @@ SERVING_LATENCY_BUCKETS_MS: Tuple[float, ...] = (
 # the write-coalescing win (syscalls per message = 1 / batch size).
 MSG_BATCH_BUCKETS: Tuple[float, ...] = (
     1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+)
+
+# Per-phase device attribution (profile.phase_ms / profile.step_ms): a
+# finer low end than DEFAULT_LATENCY_BUCKETS_MS because a single fused
+# round at small N is tens of microseconds, while a 1M-node dispatch
+# stretches to seconds.
+PROFILE_PHASE_BUCKETS_MS: Tuple[float, ...] = (
+    0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+    5000,
 )
 
 
@@ -556,6 +570,156 @@ _GLOBAL_METRICS = Metrics()
 
 def global_metrics() -> Metrics:
     return _GLOBAL_METRICS
+
+
+# --------------------------------------------------------------------------- #
+# Metric history rings
+# --------------------------------------------------------------------------- #
+
+DEFAULT_HISTORY_CAPACITY = 128
+DEFAULT_HISTORY_INTERVAL_S = 1.0
+
+
+class MetricsHistory:
+    """Bounded fixed-interval snapshot ring over a ``Metrics`` registry tree.
+
+    Point-in-time registries answer "what is the value now"; the history
+    ring answers "what was it over the last while" without an external
+    scraper. ``maybe_snapshot`` is called opportunistically from whatever
+    loop the owner already runs (the sim dispatch loop, a service timer, a
+    test); it records at most one snapshot per ``interval_s``. Each
+    snapshot captures every counter/gauge sample of ``collect()`` plus each
+    histogram's (count, sum) -- enough to reconstruct rates and means per
+    interval without shipping full bucket vectors.
+
+    Retention is bounded AND downsampled: the ring holds at most
+    ``capacity`` snapshots, and on overflow the oldest half is decimated
+    (every other entry dropped), so recent history keeps full resolution
+    while older history coarsens geometrically instead of falling off a
+    cliff. A ring that snapshots forever stays within
+    [3/4 * capacity, capacity] entries.
+
+    Lock order: ``collect()`` runs OUTSIDE the ring lock, so this class
+    adds no ``MetricsHistory._lock -> Metrics._lock`` edge.
+    """
+
+    def __init__(self, metrics: Optional[Metrics] = None,
+                 interval_s: float = DEFAULT_HISTORY_INTERVAL_S,
+                 capacity: int = DEFAULT_HISTORY_CAPACITY) -> None:
+        self._metrics = metrics if metrics is not None else global_metrics()
+        self.interval_s = max(float(interval_s), 0.0)
+        self.capacity = max(int(capacity), 4)
+        self._lock = make_lock("MetricsHistory._lock")
+        self._snaps: List[Dict[str, object]] = []
+        self._last_ts: Optional[float] = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._snaps)
+
+    def maybe_snapshot(self, now_s: Optional[float] = None) -> bool:
+        """Record a snapshot iff at least ``interval_s`` elapsed since the
+        last one (first call always records). Returns whether it did."""
+        now = float(now_s) if now_s is not None else time.time()
+        with self._lock:
+            last = self._last_ts
+        if last is not None and now - last < self.interval_s:
+            return False
+        self.snapshot(now)
+        return True
+
+    def snapshot(self, now_s: Optional[float] = None) -> Dict[str, object]:
+        """Unconditionally record one snapshot of the registry tree."""
+        now = float(now_s) if now_s is not None else time.time()
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        hists: Dict[str, List[float]] = {}
+        for kind, name, labels, value in self._metrics.collect():
+            rendered = _render(name, tuple(sorted(labels.items())))
+            if kind == "counter":
+                counters[rendered] = counters.get(rendered, 0) + value
+            elif kind == "gauge":
+                gauges[rendered] = value
+            elif kind == "histogram":
+                prev = hists.get(rendered)
+                if prev is None:
+                    hists[rendered] = [value.count, value.sum]
+                else:
+                    prev[0] += value.count
+                    prev[1] += value.sum
+        snap: Dict[str, object] = {
+            "ts_s": now,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        }
+        with self._lock:
+            self._snaps.append(snap)
+            self._last_ts = now
+            if len(self._snaps) >= self.capacity:
+                self._downsample_locked()
+        self._metrics.incr("profile.history_snapshots")
+        return snap
+
+    def _downsample_locked(self) -> None:
+        """Decimate the oldest half in place (caller holds ``_lock``)."""
+        half = len(self._snaps) // 2
+        self._snaps[:half] = self._snaps[:half][::2]
+
+    # -- reading ------------------------------------------------------------
+
+    def entries(self) -> List[Dict[str, object]]:
+        with self._lock:
+            return list(self._snaps)
+
+    def series(self, name: str) -> List[Tuple[float, float]]:
+        """(ts_s, value) timeseries of one rendered series name, searched
+        across counters, then gauges, then histogram counts. Snapshots in
+        which the series did not yet exist are skipped."""
+        out: List[Tuple[float, float]] = []
+        for snap in self.entries():
+            for table, pick in (("counters", None), ("gauges", None),
+                                ("histograms", 0)):
+                value = snap[table].get(name)  # type: ignore[union-attr]
+                if value is not None:
+                    out.append((
+                        snap["ts_s"],  # type: ignore[arg-type]
+                        float(value[pick] if pick is not None else value),
+                    ))
+                    break
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._snaps.clear()
+            self._last_ts = None
+
+    # -- wire ---------------------------------------------------------------
+
+    def to_wire(self, n: Optional[int] = None) -> Tuple[str, ...]:
+        """The ring's tail as sorted-key JSON lines: the form
+        ``ClusterStatusResponse.history`` carries on both transports."""
+        entries = self.entries()
+        if n is not None:
+            entries = entries[-n:]
+        return tuple(
+            json.dumps(snap, sort_keys=True, default=str)
+            for snap in entries
+        )
+
+    @staticmethod
+    def from_wire(lines: Tuple[str, ...]) -> List[Dict[str, object]]:
+        """Parse ``to_wire`` output back into snapshot dicts (malformed
+        lines are skipped -- a truncated scrape never breaks assembly)."""
+        out: List[Dict[str, object]] = []
+        for line in lines:
+            try:
+                snap = json.loads(line)
+            except (ValueError, TypeError):
+                continue
+            if isinstance(snap, dict) and "ts_s" in snap:
+                out.append(snap)
+        return out
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,167 @@
+"""Per-phase device attribution for the sim-plane round pipeline.
+
+The production dispatch loop is untouched: attribution is a *shadow*
+measurement. Every sampled dispatch, the profiler re-executes the current
+round's computation through three jitted prefixes of ``sim.engine.step``
+(non-donated, outputs discarded) and differences their wall times:
+
+    fd_scan         = t(step_fd_scan)
+    cut_detector    = t(step_cut_detector) - t(step_fd_scan)
+    consensus_count = t(step)              - t(step_cut_detector)
+
+so the three device phases sum to the measured full-step time by
+construction (ROADMAP item 2's megakernel fusion needs exactly this
+breakdown to know what to fuse). The fourth phase, ``host_transfer``, is
+not shadowed: the driver times the real post-dispatch decision fetch
+(``jitwatch.fetch("sim.decision_words", ...)``) and reports it here.
+
+Overhead discipline: the prefixes are compiled at ``warm()`` time (never
+inside a jitwatch timed window, so the bench's zero-steady-state-compile
+pin holds), and sampling is 1-of-N dispatches
+(``ProfilingSettings.sample_every_dispatches``), so the instrumented
+warmed decision loop stays within ``overhead_budget_pct`` of the raw one
+-- pinned by tests/test_profiling.py's overhead guard.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..observability import PROFILE_PHASE_BUCKETS_MS, Metrics, MetricsHistory
+from ..runtime import jitwatch
+from ..runtime.jitwatch import make_jit
+from ..settings import ProfilingSettings
+from ..sim.engine import step, step_cut_detector, step_fd_scan
+
+DEVICE_PHASES = ("fd_scan", "cut_detector", "consensus_count")
+PHASES = DEVICE_PHASES + ("host_transfer",)
+
+# The shadow entry points: plain (non-donated) jits of the engine's phase
+# prefixes -- the sampled state is still live in the production loop.
+profile_fd_scan = make_jit(
+    "sim.profile.fd_scan", step_fd_scan, static_argnums=(0, 3)
+)
+profile_cut_detector = make_jit(
+    "sim.profile.cut_detector", step_cut_detector, static_argnums=(0, 3)
+)
+profile_full_step = make_jit(
+    "sim.profile.full_step", step, static_argnums=(0, 3)
+)
+
+_PROFILE_FNS = (profile_fd_scan, profile_cut_detector, profile_full_step)
+
+
+class PhaseProfiler:  # guarded-by: dispatch-thread
+    """Sampled per-phase attribution plus the owning plane's history ring.
+
+    One instance per Simulator (sim/driver.py ``enable_profiling``), driven
+    entirely from the dispatch loop's thread. Phase times land in the
+    ``profile.phase_ms`` histogram (labels: phase, plane) and accumulate in
+    ``attribution()`` for direct assertions; ``history`` is the plane's
+    MetricsHistory ring, ticked once per dispatch."""
+
+    def __init__(self, metrics: Metrics,
+                 settings: Optional[ProfilingSettings] = None,
+                 plane: str = "sim") -> None:
+        self.settings = (
+            settings if settings is not None else ProfilingSettings(enabled=True)
+        )
+        self.metrics = metrics
+        self.plane = plane
+        self.samples = 0
+        self.last_sample: Optional[Dict[str, float]] = None
+        self._dispatches = 0
+        self._totals: Dict[str, float] = {phase: 0.0 for phase in PHASES}
+        self.history = MetricsHistory(
+            metrics,
+            interval_s=self.settings.history_interval_ms / 1000.0,
+            capacity=self.settings.history_capacity,
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.settings.enabled)
+
+    def should_sample(self) -> bool:
+        """Advance the dispatch counter; True on 1 of every N dispatches."""
+        if not self.enabled:
+            return False
+        self._dispatches += 1
+        return (
+            (self._dispatches - 1) % self.settings.sample_every_dispatches == 0
+        )
+
+    # -- measurement --------------------------------------------------------
+
+    def _timed_ms(self, fn, config, state, inputs, random_loss: bool) -> float:
+        t0 = time.perf_counter()
+        out = fn(config, state, inputs, random_loss)
+        jitwatch.drain("sim.profile.sample", out)
+        return (time.perf_counter() - t0) * 1000.0
+
+    def warm(self, config, state, inputs, random_loss: bool = False) -> None:
+        """Compile (and first-run) every shadow prefix for this (config,
+        shapes, random_loss) class, outside any timed window -- so no later
+        sample ever compiles on a steady-state path."""
+        for fn in _PROFILE_FNS:
+            jitwatch.drain(
+                "sim.profile.warm", fn(config, state, inputs, random_loss)
+            )
+
+    def sample(self, config, state, inputs, random_loss: bool = False,
+               repeats: int = 1) -> Dict[str, float]:
+        """One shadow attribution of the current round's computation.
+        ``repeats`` takes the best-of-N per prefix (timing noise guard for
+        assertions; the in-loop default is one shot)."""
+        reps = max(1, int(repeats))
+        t_fd = min(
+            self._timed_ms(profile_fd_scan, config, state, inputs, random_loss)
+            for _ in range(reps)
+        )
+        t_cut = min(
+            self._timed_ms(
+                profile_cut_detector, config, state, inputs, random_loss
+            )
+            for _ in range(reps)
+        )
+        t_full = min(
+            self._timed_ms(profile_full_step, config, state, inputs, random_loss)
+            for _ in range(reps)
+        )
+        phases = {
+            "fd_scan": t_fd,
+            "cut_detector": max(t_cut - t_fd, 0.0),
+            "consensus_count": max(t_full - t_cut, 0.0),
+        }
+        for phase, ms in phases.items():
+            self.metrics.observe(
+                "profile.phase_ms", ms, buckets=PROFILE_PHASE_BUCKETS_MS,
+                phase=phase, plane=self.plane,
+            )
+            self._totals[phase] += ms
+        self.metrics.observe(
+            "profile.step_ms", t_full, buckets=PROFILE_PHASE_BUCKETS_MS,
+            plane=self.plane,
+        )
+        self.metrics.incr("profile.samples")
+        self.samples += 1
+        self.last_sample = dict(phases, step_ms=t_full)
+        return self.last_sample
+
+    def record_host_transfer(self, ms: float) -> None:
+        """The real decision-fetch leg, timed by the driver per dispatch."""
+        self.metrics.observe(
+            "profile.phase_ms", ms, buckets=PROFILE_PHASE_BUCKETS_MS,
+            phase="host_transfer", plane=self.plane,
+        )
+        self._totals["host_transfer"] += ms
+
+    def tick_history(self, now_s: Optional[float] = None) -> bool:
+        return self.history.maybe_snapshot(now_s)
+
+    # -- reading ------------------------------------------------------------
+
+    def attribution(self) -> Dict[str, float]:
+        """Accumulated per-phase wall ms across every sample so far."""
+        return dict(self._totals)

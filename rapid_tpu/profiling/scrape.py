@@ -1,0 +1,79 @@
+"""Cluster-wide timeseries assembly from scraped status history lines.
+
+Any member answers a ``ClusterStatusRequest`` with its history ring's tail
+(``ClusterStatusResponse.history``, JSON lines -- the same carriage as the
+flight-recorder journal). These helpers fold a set of such responses into
+queryable views: per-node series maps (``cluster_timeseries``) and the
+transposed per-series node map (``merge_by_series``) that tools/statusz.py
+and tools/perfscope.py render."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+from ..observability import MetricsHistory
+
+# node -> series name -> [(ts_s, value)]
+ClusterSeries = Dict[str, Dict[str, List[Tuple[float, float]]]]
+
+
+def node_series(history_lines: Iterable[str]) -> Dict[str, List[Tuple[float, float]]]:
+    """One node's scraped history lines -> series name -> sorted points.
+    Counters and gauges map to their values; each histogram contributes
+    ``<name>.count`` and ``<name>.sum`` series."""
+    series: Dict[str, List[Tuple[float, float]]] = {}
+    for snap in MetricsHistory.from_wire(tuple(history_lines)):
+        try:
+            ts = float(snap.get("ts_s", 0.0))
+        except (TypeError, ValueError):
+            continue
+        for table in ("counters", "gauges"):
+            rows = snap.get(table)
+            if not isinstance(rows, dict):
+                continue
+            for name, value in rows.items():
+                try:
+                    series.setdefault(str(name), []).append((ts, float(value)))
+                except (TypeError, ValueError):
+                    continue
+        hists = snap.get("histograms")
+        if isinstance(hists, dict):
+            for name, pair in hists.items():
+                try:
+                    count, total = pair
+                    series.setdefault(f"{name}.count", []).append(
+                        (ts, float(count))
+                    )
+                    series.setdefault(f"{name}.sum", []).append(
+                        (ts, float(total))
+                    )
+                except (TypeError, ValueError):
+                    continue
+    return {name: sorted(points) for name, points in series.items()}
+
+
+def cluster_timeseries(statuses: Iterable[object]) -> ClusterSeries:
+    """A set of ``ClusterStatusResponse``s -> node -> series -> points.
+    Responses without history (old peers, profiling off) contribute an
+    empty map; duplicate responses from one node keep the larger scrape."""
+    out: ClusterSeries = {}
+    for status in statuses:
+        node = str(getattr(status, "sender", ""))
+        lines = tuple(getattr(status, "history", ()) or ())
+        series = node_series(lines)
+        prev = out.get(node)
+        if prev is None or sum(map(len, series.values())) > sum(
+            map(len, prev.values())
+        ):
+            out[node] = series
+    return out
+
+
+def merge_by_series(cluster: ClusterSeries) -> Dict[str, Dict[str, List[Tuple[float, float]]]]:
+    """Transpose: series name -> node -> points (the cross-node comparison
+    view -- e.g. one ``rounds`` panel with a line per member)."""
+    out: Dict[str, Dict[str, List[Tuple[float, float]]]] = {}
+    for node, series in cluster.items():
+        for name, points in series.items():
+            out.setdefault(name, {})[node] = points
+    return out

@@ -32,6 +32,7 @@ from .observability import (
     PARTITIONS_MOVED_BUCKETS,
     FlightRecorder,
     Metrics,
+    MetricsHistory,
     StableViewTimer,
     TraceContext,
     Tracer,
@@ -178,6 +179,17 @@ class MembershipService:
             if recorder is not None
             else FlightRecorder(node=str(my_addr), clock=self._scheduler.now_ms)
         )
+        # profiling plane: a metric history ring over this node's registry,
+        # snapshotted opportunistically from the status RPC and served as
+        # ClusterStatusResponse.history (settings.profiling is the kill
+        # switch; None keeps the response field empty for old goldens)
+        self._history: Optional[MetricsHistory] = None
+        if settings.profiling.enabled:
+            self._history = MetricsHistory(
+                self.metrics,
+                interval_s=settings.profiling.history_interval_ms / 1000.0,
+                capacity=settings.profiling.history_capacity,
+            )
         # the trace context of the churn this node is currently working on:
         # minted by the local fd_signal root or adopted from the first
         # traced alert/vote, carried onto outgoing alerts and the eventual
@@ -381,15 +393,18 @@ class MembershipService:
 
         def task() -> None:
             self.recorder.record("status_served", requester=str(msg.sender))
-            future.set_result(self.cluster_status())
+            future.set_result(
+                self.cluster_status(include_history=msg.include_history)
+            )
 
         self._resources.protocol_executor.execute(task)
         return future
 
-    def cluster_status(self) -> ClusterStatusResponse:
+    def cluster_status(self, include_history: int = 0) -> ClusterStatusResponse:
         """The local introspection snapshot (also reachable without the RPC:
         Cluster.get_cluster_status). Only call on the protocol executor or
-        from a quiesced cluster."""
+        from a quiesced cluster. ``include_history`` bounds how many metric
+        history-ring snapshots ride along (0 = none)."""
         occupancy = self._cut_detection.occupancy()
         digest = sorted(self.metrics.snapshot().items())
         # transport-plane digest (per-peer outbound queue depths) rides the
@@ -449,6 +464,14 @@ class MembershipService:
             fd_suspicion_milli = tuple(
                 int(round(r[2] * 1000)) for r in rows
             )
+        # profiling plane: every status call opportunistically ticks the
+        # history ring (scrape cadence IS the snapshot cadence, rate-limited
+        # by the ring's own interval), then ships the requested tail
+        history: Tuple[str, ...] = ()
+        if self._history is not None:
+            self._history.maybe_snapshot(self._scheduler.now_ms() / 1000.0)
+            if include_history > 0:
+                history = self._history.to_wire(include_history)
         tier_params = getattr(self._fd_factory, "tier_params", None)
         if tier_params is not None:
             tiers = tier_params()
@@ -493,6 +516,7 @@ class MembershipService:
             fd_tier_interval_ms=fd_tier_interval_ms,
             fd_tier_threshold=fd_tier_threshold,
             fd_tier_flush_ms=fd_tier_flush_ms,
+            history=history,
         )
 
     # ------------------------------------------------------------------ #

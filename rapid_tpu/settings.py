@@ -61,6 +61,29 @@ SETTINGS_CATALOG = {
         "min": 0, "max": 60000,
         "doc": "longest adapted alert-batching flush window",
     },
+    "profiling.enabled": {
+        "min": 0, "max": 1,
+        "doc": "kill switch: False runs the raw dispatch loop with zero "
+               "profiling work on any path",
+    },
+    "profiling.sample_every_dispatches": {
+        "min": 1, "max": 1000000,
+        "doc": "shadow-profile one of every N device dispatches (1 = every "
+               "dispatch; large N keeps steady-state overhead negligible)",
+    },
+    "profiling.history_interval_ms": {
+        "min": 1, "max": 3600000,
+        "doc": "minimum spacing between metric history-ring snapshots",
+    },
+    "profiling.history_capacity": {
+        "min": 4, "max": 65536,
+        "doc": "history-ring size before the oldest half is downsampled",
+    },
+    "profiling.overhead_budget_pct": {
+        "min": 0.0, "max": 100.0,
+        "doc": "overhead guard: instrumented warmed decision loop must stay "
+               "within this percentage of the raw one",
+    },
 }
 
 
@@ -106,6 +129,36 @@ class AdaptiveFdSettings:
         assert self.interval_floor_ms <= self.interval_ceiling_ms
         assert self.threshold_floor <= self.threshold_ceiling
         assert self.flush_floor_ms <= self.flush_ceiling_ms
+
+
+@dataclass(frozen=True)
+class ProfilingSettings:
+    """Knobs for the continuous profiling plane (profiling/). Defaults are
+    conservative: profiling is off (``enabled=False`` leaves the dispatch
+    loop untouched) and, when on, shadow attribution samples only one of
+    every ``sample_every_dispatches`` dispatches so the steady-state loop
+    stays within ``overhead_budget_pct`` of the raw one. Bounds live in
+    SETTINGS_CATALOG (linted by tools/check.py)."""
+
+    enabled: bool = False
+    sample_every_dispatches: int = 16
+    history_interval_ms: int = 1000
+    history_capacity: int = 128
+    overhead_budget_pct: float = 10.0
+
+    def __post_init__(self) -> None:
+        for key, value in (
+            ("enabled", int(self.enabled)),
+            ("sample_every_dispatches", self.sample_every_dispatches),
+            ("history_interval_ms", self.history_interval_ms),
+            ("history_capacity", self.history_capacity),
+            ("overhead_budget_pct", self.overhead_budget_pct),
+        ):
+            bounds = SETTINGS_CATALOG[f"profiling.{key}"]
+            assert bounds["min"] <= value <= bounds["max"], (
+                f"profiling.{key}={value!r} outside "
+                f"[{bounds['min']}, {bounds['max']}]"
+            )
 
 
 @dataclass
@@ -162,6 +215,12 @@ class Settings:
     # thresholds, and alert-flush windows. Off by default; the enabled
     # flag is the kill switch back to the static reference behavior.
     adaptive_fd: AdaptiveFdSettings = field(default_factory=AdaptiveFdSettings)
+
+    # Continuous profiling plane (profiling/): per-phase device attribution
+    # sampling, metric history rings, and the telemetry scrape surface. Off
+    # by default; the enabled flag is the kill switch back to the raw,
+    # uninstrumented dispatch loop.
+    profiling: ProfilingSettings = field(default_factory=ProfilingSettings)
 
     def __post_init__(self) -> None:
         assert self.fd_policy in ("cumulative", "windowed"), (

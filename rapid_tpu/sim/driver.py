@@ -236,6 +236,9 @@ class Simulator:  # guarded-by: sim-loop
         self._injected_down = np.zeros(
             (self.config.capacity, self.config.k), dtype=bool
         )
+        # profiling plane (opt-in via enable_profiling; like placement, a
+        # restored simulator re-enables it explicitly)
+        self._profiler = None
         # placement plane (opt-in via enable_placement; not part of protocol
         # state, so from_configuration restores re-enable it explicitly)
         self._placement = None
@@ -1192,6 +1195,39 @@ class Simulator:  # guarded-by: sim-loop
         return self._deliver_delay_dev
 
     # ------------------------------------------------------------------ #
+    # Profiling plane
+    # ------------------------------------------------------------------ #
+
+    def enable_profiling(self, settings=None):
+        """Attach the continuous profiling plane (profiling/): sampled
+        shadow attribution of the dispatch pipeline into FD-scan /
+        cut-detector / consensus-count phases, real-fetch timing of the
+        host-transfer leg, and a metric history ring ticked once per
+        dispatch. ``settings.enabled`` is the kill switch: when False this
+        is a no-op returning None and the dispatch loop stays exactly the
+        raw path. The shadow prefixes are compiled here, up front, for both
+        random-loss classes, so no later sample compiles inside a jitwatch
+        timed window (the bench's zero-steady-state-compile pin). Shadow
+        sampling is single-device; in mesh mode only the history ring and
+        host-transfer phase are recorded. Returns the PhaseProfiler (or
+        None when disabled)."""
+        from ..profiling import PhaseProfiler
+        from ..settings import ProfilingSettings
+
+        if settings is None:
+            settings = ProfilingSettings(enabled=True)
+        if not settings.enabled:
+            self._profiler = None
+            return None
+        prof = PhaseProfiler(self.metrics, settings, plane="sim")
+        if self.mesh is None:
+            inputs = self._const_inputs(None)
+            for random_loss in (False, True):
+                prof.warm(self.config, self.state, inputs, random_loss)
+        self._profiler = prof
+        return prof
+
+    # ------------------------------------------------------------------ #
     # Joins
     # ------------------------------------------------------------------ #
 
@@ -1293,6 +1329,15 @@ class Simulator:  # guarded-by: sim-loop
             inputs = self._const_inputs(join_reports)
             n = min(batch, max_rounds - rounds_done)
             random_loss = bool((self._drop_prob > 0).any())
+            prof = self._profiler
+            if prof is not None:
+                # shadow attribution samples 1-of-N dispatches against the
+                # live pre-dispatch state (pure, non-donated prefixes; the
+                # donated production dispatch below is untouched); the
+                # history ring ticks every dispatch
+                if self.mesh is None and prof.should_sample():
+                    prof.sample(self.config, self.state, inputs, random_loss)
+                prof.tick_history()
             if stop_when_announced and not random_loss:
                 # the const/mesh while_loop pauses at the announcement round
                 # in-engine, so the whole remaining budget rides one dispatch
@@ -1346,7 +1391,14 @@ class Simulator:  # guarded-by: sim-loop
                 # matches the decision).
                 packed = pack_decision(self.config, self.state)
                 spec_worker = self._speculate_view_change()
-                words = jitwatch.fetch("sim.decision_words", packed)
+                if prof is not None:
+                    t_fetch = time.perf_counter()
+                    words = jitwatch.fetch("sim.decision_words", packed)
+                    prof.record_host_transfer(
+                        (time.perf_counter() - t_fetch) * 1000.0
+                    )
+                else:
+                    words = jitwatch.fetch("sim.decision_words", packed)
                 if spec_worker is not None:
                     spec_worker.join()
                 (decided, announced_np, announced_round_np, proposal_np,

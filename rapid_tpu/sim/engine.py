@@ -279,6 +279,7 @@ def route_and_tally(
     *,
     uniform_delivery: bool = False,
     gate_implicit: bool = False,
+    stop_after_cut: bool = False,
 ) -> SimState:
     """Alert delivery, per-group cut detection, per-node vote casting, the
     vote delivery hop, and the fast-round tally -- shared by the
@@ -297,6 +298,11 @@ def route_and_tally(
     ``lax.cond`` so its [G, C, K] gather only runs in rounds where some group
     both saw a DOWN alert and has a node in flux -- it is the identity
     otherwise, so gating is exact.
+
+    ``stop_after_cut`` (static) returns right after proposal emission with
+    the vote/tally fields untouched -- the cut-detector phase boundary the
+    profiling plane's shadow attribution times against (profiling/phases.py);
+    never used on a production dispatch path.
 
     Returns ``state`` with the tally-owned fields replaced (reports,
     seen_down, announced, proposal, voted, vote_prop, vote_new, vote_hist,
@@ -392,6 +398,17 @@ def route_and_tally(
         state.round + 1,
         state.announced_round,
     )
+
+    if stop_after_cut:
+        return dataclasses.replace(
+            state,
+            reports=reports,
+            arrival_hist=arrival_hist,
+            seen_down=seen_down,
+            announced=announced,
+            announced_round=announced_round,
+            proposal=proposal,
+        )
 
     # --- per-node fast-round votes (FastPaxos.java:125-156) ----------------
     # A node casts its vote -- for its own group's proposal -- the round that
@@ -540,17 +557,16 @@ def windowed_fd_phase(
     return fd_hist, fd_seen, crossed & ~state.alerted
 
 
-def step(config: SimConfig, state: SimState, inputs: RoundInputs,
-         random_loss: bool = True) -> SimState:
-    """One protocol round. Pure; jit/scan-friendly.
-
-    ``random_loss`` statically elides the per-edge RNG draw when no lossy
-    ingress fault is active (the common case) -- the threefry generation over
-    [C, K] per round is otherwise a real bandwidth cost at C=100k.
-    """
+def _fd_phase(
+    config: SimConfig, state: SimState, inputs: RoundInputs,
+    random_loss: bool,
+) -> Tuple[jax.Array, ...]:
+    """Probe evaluation + alert routing: the leading FD-scan phase of
+    ``step``, shared with the profiling prefixes so the shadow-measured
+    phase is the production computation, not a re-derivation. Returns
+    ``(rng_key, active, alive, fd_fail, fd_hist, fd_seen, fd_streak,
+    fd_ok, alerted, down_arrivals)``."""
     c, k = config.capacity, config.k
-    halt = state.decided
-
     key, probe_key = jax.random.split(state.rng_key)
     active = state.active
     alive = inputs.alive & active  # membership ∩ fault-model liveness
@@ -630,6 +646,21 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
     down_arrivals = (
         new_down[state.observers, cols] | inputs.down_reports
     ) & active[:, None]
+    return (key, active, alive, fd_fail, fd_hist, fd_seen, fd_streak,
+            fd_ok, alerted, down_arrivals)
+
+
+def step(config: SimConfig, state: SimState, inputs: RoundInputs,
+         random_loss: bool = True) -> SimState:
+    """One protocol round. Pure; jit/scan-friendly.
+
+    ``random_loss`` statically elides the per-edge RNG draw when no lossy
+    ingress fault is active (the common case) -- the threefry generation over
+    [C, K] per round is otherwise a real bandwidth cost at C=100k.
+    """
+    halt = state.decided
+    (key, active, alive, fd_fail, fd_hist, fd_seen, fd_streak, fd_ok,
+     alerted, down_arrivals) = _fd_phase(config, state, inputs, random_loss)
 
     tallied = route_and_tally(config, state, down_arrivals, inputs,
                               active, alive)
@@ -651,6 +682,66 @@ def step(config: SimConfig, state: SimState, inputs: RoundInputs,
     # view change: all updates become no-ops.
     return jax.tree_util.tree_map(
         lambda old, new: jnp.where(halt, old, new), state, new_state
+    )
+
+
+# --------------------------------------------------------------------- #
+# Profiling phase prefixes (profiling/phases.py)
+# --------------------------------------------------------------------- #
+# Each entry point executes only the leading phases of one round, so the
+# shadow profiler can time consecutive prefixes and difference them:
+# per-phase wall time then sums to the full step by construction. Never
+# called on a production dispatch path; outputs exist only so XLA cannot
+# dead-code the phase's work.
+
+
+def step_fd_scan(
+    config: SimConfig, state: SimState, inputs: RoundInputs,
+    random_loss: bool = True,
+) -> Tuple[SimState, jax.Array]:
+    """FD-scan prefix: probe evaluation + alert routing only. Returns the
+    partially-updated state and the ``down_arrivals`` gather (a live output,
+    so the routing cost is measured, not eliminated)."""
+    (key, active, _alive, fd_fail, fd_hist, fd_seen, fd_streak, fd_ok,
+     alerted, down_arrivals) = _fd_phase(config, state, inputs, random_loss)
+    partial = dataclasses.replace(
+        state,
+        active=active,
+        alive=inputs.alive,
+        fd_fail=fd_fail,
+        fd_hist=fd_hist,
+        fd_seen=fd_seen,
+        fd_streak=fd_streak,
+        fd_ok=fd_ok,
+        alerted=alerted,
+        rng_key=key,
+    )
+    return partial, down_arrivals
+
+
+def step_cut_detector(
+    config: SimConfig, state: SimState, inputs: RoundInputs,
+    random_loss: bool = True,
+) -> SimState:
+    """FD-scan + cut-detector prefix: everything in ``step`` through
+    proposal emission; vote casting and the fast-round tally are skipped
+    (``route_and_tally(stop_after_cut=True)``)."""
+    (key, active, alive, fd_fail, fd_hist, fd_seen, fd_streak, fd_ok,
+     alerted, down_arrivals) = _fd_phase(config, state, inputs, random_loss)
+    tallied = route_and_tally(config, state, down_arrivals, inputs,
+                              active, alive, stop_after_cut=True)
+    return dataclasses.replace(
+        tallied,
+        active=active,
+        alive=inputs.alive,
+        fd_fail=fd_fail,
+        fd_hist=fd_hist,
+        fd_seen=fd_seen,
+        fd_streak=fd_streak,
+        fd_ok=fd_ok,
+        alerted=alerted,
+        round=state.round + 1,
+        rng_key=key,
     )
 
 

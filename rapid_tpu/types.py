@@ -310,9 +310,14 @@ class ClusterStatusRequest:
     Not in rapid.proto's reference surface -- an extension message carried
     by every transport (the proto schema grows matching messages in
     messaging/wire_schema.py). Answered synchronously from protocol state,
-    so it works while consensus is in flight and through the nemesis."""
+    so it works while consensus is in flight and through the nemesis.
+
+    ``include_history`` asks for up to that many metric history-ring
+    snapshots in the response (0 = none, the default, which keeps the
+    answer small and matches pre-profiling peers' frames)."""
 
     sender: Endpoint
+    include_history: int = 0
 
 
 @dataclass(frozen=True)
@@ -376,6 +381,11 @@ class ClusterStatusResponse:
     fd_tier_interval_ms: Tuple[int, ...] = ()
     fd_tier_threshold: Tuple[int, ...] = ()
     fd_tier_flush_ms: Tuple[int, ...] = ()
+    # profiling plane (empty unless profiling is enabled AND the request
+    # set include_history): the node's metric history-ring tail as
+    # sorted-key JSON lines (MetricsHistory.to_wire), the carriage a
+    # scraper folds into a cluster-wide timeseries (profiling/scrape.py)
+    history: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,51 @@
+"""Fixture telemetry-scrape messages shared by the golden generator
+(generate_scrape_frames.py) and the pinning tests (test_profiling.py).
+
+Everything here is deterministic: fixed endpoints, fixed timestamps, and
+hand-written history lines in exactly the sorted-key JSON form
+``MetricsHistory.to_wire`` emits -- so the pinned frames freeze both the
+new wire fields (ClusterStatusRequest.include_history,
+ClusterStatusResponse.history: proto field 33) and the snapshot-line
+dialect they carry. Never trace-stamped (an unstamped message encodes no
+``__tc`` envelope key).
+"""
+
+from rapid_tpu.types import (
+    ClusterStatusRequest,
+    ClusterStatusResponse,
+    Endpoint,
+)
+
+SCRAPER = Endpoint.from_parts("10.9.1.1", 7101)
+MEMBER = Endpoint.from_parts("10.9.1.2", 7102)
+
+# exactly what MetricsHistory.to_wire produces: one sorted-key JSON object
+# per line with ts_s / counters / gauges / histograms ([count, sum]) tables
+HISTORY_LINES = (
+    '{"counters": {"rounds": 3.0}, "gauges": {"msg.queue_depth{peer=10.9.1.3:7103}": 128.0}, '
+    '"histograms": {"profile.phase_ms{phase=fd_scan,plane=sim}": [3, 1.5]}, "ts_s": 12.0}',
+    '{"counters": {"rounds": 5.0}, "gauges": {}, '
+    '"histograms": {"profile.phase_ms{phase=fd_scan,plane=sim}": [5, 2.25]}, "ts_s": 13.0}',
+)
+
+SCRAPE_REQUEST = ClusterStatusRequest(sender=SCRAPER, include_history=16)
+
+SCRAPE_RESPONSE = ClusterStatusResponse(
+    sender=MEMBER,
+    configuration_id=-6148914691236517206,
+    membership_size=3,
+    reports_tracked=1,
+    consensus_votes=2,
+    metric_names=("rounds",),
+    metric_values=(5,),
+    history=HISTORY_LINES,
+)
+
+# named (request_no, message) pairs pinned on the native msgpack wire
+TCP_SCRAPES = {
+    "request_with_history": (11, SCRAPE_REQUEST),
+    # a pre-profiling scrape: default include_history=0 must still encode
+    # (old peers' frames simply omit what their dataclass defaults fill)
+    "request_plain": (12, ClusterStatusRequest(sender=SCRAPER)),
+    "response_with_history": (13, SCRAPE_RESPONSE),
+}

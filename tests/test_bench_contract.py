@@ -61,6 +61,8 @@ def test_unreachable_accelerator_exits_17(monkeypatch, capsys):
     assert parsed["value"] is None
     assert "unreachable" in parsed["reason"]
     assert "time_to_stable_view_ms" in parsed  # sim-plane telemetry carried
+    # outage lines carry device attribution too (None/0 when jax is down)
+    assert "device_kind" in parsed and "mesh_shape" in parsed
 
 
 def test_budget_breach_prints_json_then_exits_18(monkeypatch, capsys):
@@ -95,6 +97,12 @@ def test_success_emits_sweep_curve(monkeypatch, capsys):
     assert parsed["vs_baseline"] == round(120.0 / bench.BASELINE_MS, 4)
     sizes = [e["n"] for e in parsed["sweep"]]
     assert sizes == [1_000, 100_000, 1_000_000]  # headline folded in, sorted
+    # every artifact line names the hardware that produced it: device kind
+    # plus the mesh/device topology the sim plane would shard over
+    assert isinstance(parsed["device_kind"], str) and parsed["device_kind"]
+    assert parsed["device_count"] >= 1
+    assert parsed["process_count"] >= 1
+    assert parsed["mesh_shape"] == {"nodes": parsed["device_count"]}
 
 
 def test_cpu_wall_within_budget_is_rc0(monkeypatch, capsys):

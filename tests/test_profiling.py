@@ -1,0 +1,543 @@
+"""Continuous profiling plane (ISSUE 15): per-phase device attribution,
+metric history rings, and the cluster-wide telemetry scrape.
+
+Pins the acceptance criteria layer by layer:
+
+- attribution (profiling/phases.py): sampled shadow measurement splits the
+  sim round pipeline into fd_scan / cut_detector / consensus_count /
+  host_transfer, the device phases track the independently timed full step
+  (>= 90% coverage at the 10k bench point, slow-marked), sampling cadence
+  is 1-of-N, and the kill switch leaves the dispatch loop untouched;
+- overhead discipline: the instrumented warmed decision loop stays within
+  the profiling overhead budget of the raw one, and a steady-state run
+  with profiling ON still compiles nothing (the bench's
+  ``jit_compiles_steady == 0`` pin survives the plane);
+- history rings (observability.MetricsHistory): interval gating, bounded
+  downsample-on-overflow retention, wire round-trip with malformed-line
+  tolerance, and export stability under concurrent child registry churn
+  (the GC-finalizer absorb path);
+- the scrape surface: frozen wire bytes for the extended cluster-status
+  RPC (tests/golden/scrape_frames.json, both transports), old-frame
+  default tolerance, scrape assembly (profiling/scrape.py), and a 3-node
+  in-process cluster whose scraped responses fold into a cluster-wide
+  timeseries;
+- tools/perfscope.py: the render/diff CLI contract over real exporter
+  output.
+"""
+
+import gc
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from golden.scrape_fixtures import (
+    HISTORY_LINES,
+    SCRAPE_REQUEST,
+    SCRAPE_RESPONSE,
+    TCP_SCRAPES,
+)
+from harness import ClusterHarness
+
+from rapid_tpu import Endpoint, Settings
+from rapid_tpu.messaging import grpc_transport as gt
+from rapid_tpu.messaging.codec import HEADER, decode, encode
+from rapid_tpu.messaging.inprocess import InProcessClient
+from rapid_tpu.messaging.wire_schema import MSG
+from rapid_tpu.observability import (
+    Metrics,
+    MetricsHistory,
+    json_snapshot,
+    prometheus_text,
+)
+from rapid_tpu.profiling import (
+    DEVICE_PHASES,
+    PhaseProfiler,
+    cluster_timeseries,
+    merge_by_series,
+)
+from rapid_tpu.profiling.scrape import node_series
+from rapid_tpu.settings import ProfilingSettings
+from rapid_tpu.types import ClusterStatusRequest, ClusterStatusResponse
+from tools.perfscope import diff_artifacts, extract_phases, parse_rendered
+
+GOLDEN = json.loads(
+    (Path(__file__).parent / "golden" / "scrape_frames.json").read_text()
+)
+
+
+# ---------------------------------------------------------------------------
+# attribution: the PhaseProfiler over a real simulator
+# ---------------------------------------------------------------------------
+
+
+def _profiled_sim(n, seed, sample_every=1):
+    from rapid_tpu.sim.driver import Simulator
+
+    sim = Simulator(n, seed=seed, metrics=Metrics())
+    sim.ready()
+    prof = sim.enable_profiling(ProfilingSettings(
+        enabled=True, sample_every_dispatches=sample_every,
+    ))
+    assert prof is not None and prof.enabled
+    return sim, prof
+
+
+def test_sampling_cadence_is_one_of_n():
+    prof = PhaseProfiler(
+        Metrics(), ProfilingSettings(enabled=True, sample_every_dispatches=4)
+    )
+    pattern = [prof.should_sample() for _ in range(8)]
+    assert pattern == [True, False, False, False, True, False, False, False]
+
+
+def test_kill_switch_disables_everything():
+    prof = PhaseProfiler(Metrics(), ProfilingSettings(enabled=False))
+    assert not prof.enabled
+    assert not any(prof.should_sample() for _ in range(32))
+
+    from rapid_tpu.sim.driver import Simulator
+
+    sim = Simulator(64, seed=3, metrics=Metrics())
+    assert sim.enable_profiling(ProfilingSettings(enabled=False)) is None
+    assert sim._profiler is None
+
+
+def test_shadow_sample_attributes_the_phase_pipeline():
+    """One shadow sample yields every device phase, non-negative and on the
+    same scale as the full step; the histograms land in the registry in
+    exactly the shape tools/perfscope.py parses back out."""
+    sim, prof = _profiled_sim(256, seed=7)
+    inputs = sim._const_inputs(None)
+    s = prof.sample(sim.config, sim.state, inputs, False, repeats=3)
+    assert set(s) == set(DEVICE_PHASES) | {"step_ms"}
+    assert all(v >= 0.0 for v in s.values())
+    device_ms = sum(s[p] for p in DEVICE_PHASES)
+    # the phases are differenced prefixes: they reconstruct the full step
+    # up to per-prefix timer noise (clamping at zero can only push the sum
+    # a noise-term above the step, never to a different scale)
+    assert device_ms <= s["step_ms"] * 2.0 + 1.0
+
+    phases, step = extract_phases(json_snapshot(sim.metrics))
+    assert set(phases) >= set(DEVICE_PHASES)
+    assert step is not None and step[0] >= 1
+    totals = prof.attribution()
+    assert set(totals) == {*DEVICE_PHASES, "host_transfer"}
+    assert totals["fd_scan"] == pytest.approx(phases["fd_scan"][1])
+
+
+def test_dispatch_loop_samples_and_times_host_transfer():
+    """With profiling enabled the decision loop records shadow samples, the
+    real decision-fetch leg, and history snapshots -- and still decides the
+    identical cut."""
+    sim, prof = _profiled_sim(64, seed=5, sample_every=1)
+    sim.crash(np.array([3]))
+    record = sim.run_until_decision(max_rounds=40)
+    assert record is not None and set(record.cut) == {3}
+    assert prof.samples >= 1
+    totals = prof.attribution()
+    assert totals["host_transfer"] > 0.0  # the fetch is real, so is its time
+    assert len(prof.history) >= 1
+    assert sim.metrics.get("profile.samples") == prof.samples
+
+
+@pytest.mark.slow
+def test_attribution_covers_device_step_at_bench_point():
+    """ISSUE 15 acceptance: at the 10k-node bench point the attributed
+    device phases cover >= 90% of the independently measured device step
+    time. Best-of-N on both sides so scheduler jitter cannot fail a
+    structurally sound attribution."""
+    from rapid_tpu.profiling.phases import profile_full_step
+    from rapid_tpu.runtime import jitwatch
+
+    sim, prof = _profiled_sim(10_000, seed=11)
+    inputs = sim._const_inputs(None)
+    s = prof.sample(sim.config, sim.state, inputs, False, repeats=5)
+
+    def timed_step():
+        t0 = time.perf_counter()
+        out = profile_full_step(sim.config, sim.state, inputs, False)
+        jitwatch.drain("test.profile.step", out)
+        return (time.perf_counter() - t0) * 1000.0
+
+    step_ms = min(timed_step() for _ in range(5))
+    device_ms = sum(s[p] for p in DEVICE_PHASES)
+    assert device_ms >= 0.9 * step_ms, (
+        f"attribution covers {device_ms / step_ms * 100:.1f}% "
+        f"(device={device_ms:.3f}ms step={step_ms:.3f}ms): {s}"
+    )
+
+
+def test_profiling_overhead_within_budget():
+    """The instrumented warmed decision loop (profiling ON, default 1-of-16
+    sampling) stays within ProfilingSettings.overhead_budget_pct of the raw
+    loop, plus a small absolute allowance for timer noise."""
+    import sys
+
+    from rapid_tpu.sim.driver import Simulator
+
+    budget_pct = ProfilingSettings(enabled=True).overhead_budget_pct
+
+    def best_of(profiled, runs=5):
+        best = float("inf")
+        for _ in range(runs):
+            sim = Simulator(64, seed=5, metrics=Metrics())
+            sim.ready()
+            if profiled:
+                sim.enable_profiling(ProfilingSettings(enabled=True))
+            sim.crash(np.array([3]))
+            t0 = time.perf_counter()
+            record = sim.run_until_decision(max_rounds=40)
+            best = min(best, time.perf_counter() - t0)
+            assert record is not None
+        return best
+
+    best_of(True, runs=1)  # jit warmup (shadow prefixes included)
+    plain = best_of(False)
+    instrumented = best_of(True)
+    slack = 0.25 if sys.gettrace() is not None else 0.05
+    assert instrumented <= plain * (1.0 + budget_pct / 100.0) + slack, (
+        f"profiling overhead: instrumented={instrumented * 1e3:.1f}ms "
+        f"plain={plain * 1e3:.1f}ms budget={budget_pct}%"
+    )
+
+
+def test_profiling_on_keeps_steady_state_compiles_zero():
+    """The bench pin extended to the profiling plane: after one warmup run,
+    an identically shaped profiled run compiles NOTHING -- the shadow
+    prefixes were compiled at enable time, never on the steady path."""
+    from rapid_tpu.runtime import jitwatch
+    from rapid_tpu.sim.driver import Simulator
+
+    def run():
+        sim = Simulator(64, seed=5, metrics=Metrics())
+        sim.ready()
+        sim.enable_profiling(ProfilingSettings(
+            enabled=True, sample_every_dispatches=1,
+        ))
+        sim.crash(np.array([3]))
+        record = sim.run_until_decision(max_rounds=40)
+        assert record is not None
+
+    run()  # warmup: production loop + shadow prefixes compile here
+    js0 = jitwatch.stats()
+    run()  # identical shapes: the steady state
+    js1 = jitwatch.stats()
+    assert js1["compiles"] - js0["compiles"] == 0, (
+        f"profiled steady-state run compiled "
+        f"{js1['compiles'] - js0['compiles']} times"
+    )
+
+
+# ---------------------------------------------------------------------------
+# metric history rings
+# ---------------------------------------------------------------------------
+
+
+def test_history_interval_gating_and_series():
+    m = Metrics()
+    h = MetricsHistory(m, interval_s=1.0, capacity=16)
+    m.incr("rounds", 3)
+    assert h.maybe_snapshot(10.0)
+    m.incr("rounds", 2)
+    assert not h.maybe_snapshot(10.5)  # inside the interval
+    assert h.maybe_snapshot(11.0)
+    assert len(h) == 2
+    assert h.series("rounds") == [(10.0, 3.0), (11.0, 5.0)]
+    m.observe("profile.step_ms", 2.5, plane="sim")
+    h.snapshot(12.0)
+    assert h.series("profile.step_ms{plane=sim}") == [(12.0, 1.0)]  # count
+
+
+def test_history_overflow_downsamples_old_keeps_recent():
+    """The overflow edge: a ring that snapshots forever stays within
+    [3/4*capacity, capacity], keeps snapshots ordered, and never loses the
+    newest entries to decimation (only the oldest half coarsens)."""
+    m = Metrics()
+    h = MetricsHistory(m, interval_s=0.0, capacity=8)
+    for t in range(200):
+        m.incr("rounds")
+        h.snapshot(float(t))
+    assert 6 <= len(h) <= 8
+    ts = [snap["ts_s"] for snap in h.entries()]
+    assert ts == sorted(ts) and len(set(ts)) == len(ts)
+    assert ts[-1] == 199.0 and ts[-2] == 198.0  # recent half: full resolution
+    values = [v for _, v in h.series("rounds")]
+    assert values == sorted(values)  # counters survive decimation monotone
+
+
+def test_history_wire_roundtrip_skips_malformed_lines():
+    m = Metrics()
+    h = MetricsHistory(m, interval_s=0.0, capacity=8)
+    m.incr("rounds")
+    h.snapshot(1.0)
+    m.incr("rounds")
+    h.snapshot(2.0)
+    lines = h.to_wire()
+    assert len(lines) == 2
+    assert h.to_wire(1) == lines[-1:]
+    back = MetricsHistory.from_wire(lines)
+    assert [s["ts_s"] for s in back] == [1.0, 2.0]
+    assert back[1]["counters"]["rounds"] == 2
+    # a truncated scrape never breaks assembly
+    mangled = (lines[0], "{not json", lines[1][: len(lines[1]) // 2])
+    assert [s["ts_s"] for s in MetricsHistory.from_wire(mangled)] == [1.0]
+
+
+def test_exports_survive_concurrent_child_churn_and_absorb():
+    """Satellite (c): churn child registries (attach, record, die -> the GC
+    finalizer queues an absorb) while another thread exports and snapshots
+    the parent. No export may raise, and when the dust settles the absorbed
+    counters are conserved exactly."""
+    parent = Metrics()
+    h = MetricsHistory(parent, interval_s=0.0, capacity=32)
+    children = 150
+    errors = []
+
+    def churn():
+        try:
+            for i in range(children):
+                child = Metrics(parent=parent, plane="churn")
+                child.incr("rounds")
+                child.observe("profile.step_ms", 0.5, plane="sim")
+                del child
+                if i % 10 == 0:
+                    gc.collect()
+        except Exception as exc:  # pragma: no cover - the assertion target
+            errors.append(exc)
+
+    worker = threading.Thread(target=churn)
+    worker.start()
+    try:
+        while worker.is_alive():
+            prometheus_text(parent)
+            json_snapshot(parent)
+            h.snapshot(time.time())
+    finally:
+        worker.join()
+    assert not errors, errors
+    gc.collect()
+    assert parent.get("rounds") == children  # every absorb folded, once
+    final = h.snapshot(time.time())
+    assert final["counters"]["rounds{plane=churn}"] == children
+
+
+# ---------------------------------------------------------------------------
+# the scrape surface on the wire: frozen bytes + old-frame tolerance
+# ---------------------------------------------------------------------------
+
+
+def test_scrape_frame_bytes_golden():
+    """Native-codec scrape frames serialize byte-for-byte to the committed
+    vectors and the committed bytes decode back to identical values."""
+    assert set(GOLDEN["tcp_frames"]) == set(TCP_SCRAPES)
+    for name, (request_no, msg) in TCP_SCRAPES.items():
+        entry = GOLDEN["tcp_frames"][name]
+        assert entry["request_no"] == request_no, name
+        body = encode(request_no, msg)
+        assert body.hex() == entry["body_hex"], name
+        framed = HEADER.pack(len(body)) + body
+        assert framed.hex() == entry["framed_hex"], name
+        got_no, got = decode(bytes.fromhex(entry["body_hex"]))
+        assert (got_no, got) == (request_no, msg), name
+
+
+def test_scrape_grpc_bytes_golden():
+    """The gRPC scrape extension serializes deterministically to the
+    committed bytes (includeHistory field 2, history field 33) and parses
+    back identical through the programmatic schema."""
+    wire = gt.to_wire_request(SCRAPE_REQUEST).SerializeToString(
+        deterministic=True
+    )
+    assert wire.hex() == GOLDEN["grpc"]["ClusterStatusRequest"]
+    parsed = gt.from_wire_request(MSG["RapidRequest"].FromString(wire))
+    assert parsed == SCRAPE_REQUEST
+
+    wire = gt.to_wire_response(SCRAPE_RESPONSE).SerializeToString(
+        deterministic=True
+    )
+    assert wire.hex() == GOLDEN["grpc"]["ClusterStatusResponse"]
+    parsed = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert parsed == SCRAPE_RESPONSE
+    assert parsed.history == HISTORY_LINES
+
+
+def test_pre_profiling_frames_parse_to_defaults():
+    """Rolling upgrade both ways: an old peer's frame (no scrape fields)
+    parses with the defaults, and a scrape-bearing frame parsed by the
+    pre-profiling schema subset keeps everything it knows."""
+    old_req = ClusterStatusRequest(sender=SCRAPE_REQUEST.sender)
+    assert old_req.include_history == 0
+    assert decode(encode(3, old_req)) == (3, old_req)
+
+    old_resp = ClusterStatusResponse(
+        sender=SCRAPE_RESPONSE.sender, configuration_id=1, membership_size=2,
+    )
+    wire = gt.to_wire_response(old_resp).SerializeToString(deterministic=True)
+    back = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert back == old_resp and back.history == ()
+
+
+# ---------------------------------------------------------------------------
+# scrape assembly
+# ---------------------------------------------------------------------------
+
+
+def test_node_series_from_wire_lines():
+    series = node_series(HISTORY_LINES)
+    assert series["rounds"] == [(12.0, 3.0), (13.0, 5.0)]
+    hist = "profile.phase_ms{phase=fd_scan,plane=sim}"
+    assert series[f"{hist}.count"] == [(12.0, 3.0), (13.0, 5.0)]
+    assert series[f"{hist}.sum"] == [(12.0, 1.5), (13.0, 2.25)]
+    gauge = "msg.queue_depth{peer=10.9.1.3:7103}"
+    assert series[gauge] == [(12.0, 128.0)]
+
+
+def test_cluster_timeseries_merges_and_prefers_larger_scrape():
+    plain = ClusterStatusResponse(
+        sender=SCRAPE_REQUEST.sender, configuration_id=1, membership_size=3,
+    )
+    partial = ClusterStatusResponse(
+        sender=SCRAPE_RESPONSE.sender, configuration_id=1, membership_size=3,
+        history=HISTORY_LINES[:1],
+    )
+    cluster = cluster_timeseries([plain, partial, SCRAPE_RESPONSE])
+    assert set(cluster) == {str(plain.sender), str(SCRAPE_RESPONSE.sender)}
+    assert cluster[str(plain.sender)] == {}  # old peer: present, empty
+    # the duplicate node kept the larger scrape (both snapshots)
+    assert cluster[str(SCRAPE_RESPONSE.sender)]["rounds"] == [
+        (12.0, 3.0), (13.0, 5.0),
+    ]
+    merged = merge_by_series(cluster)
+    assert merged["rounds"] == {
+        str(SCRAPE_RESPONSE.sender): [(12.0, 3.0), (13.0, 5.0)],
+    }
+
+
+# ---------------------------------------------------------------------------
+# 3-node cluster scrape -> cluster-wide timeseries (pinned integration)
+# ---------------------------------------------------------------------------
+
+
+def _scrape(h, probe, target, include_history):
+    p = probe.send_message(target, ClusterStatusRequest(
+        sender=probe.address, include_history=include_history,
+    ))
+    assert h.scheduler.run_until(p.done, timeout_ms=60_000)
+    assert p.exception() is None, p.exception()
+    reply = p.peek()
+    assert isinstance(reply, ClusterStatusResponse)
+    return reply
+
+
+def test_three_node_cluster_scrape_assembles_cluster_timeseries():
+    """ISSUE 15 acceptance: with profiling enabled, any scraper folds the
+    members' status responses into a cluster-wide timeseries -- three
+    nodes, each with a multi-point profile.history_snapshots series on the
+    deterministic virtual clock."""
+    settings = Settings(profiling=ProfilingSettings(
+        enabled=True, history_interval_ms=200, history_capacity=16,
+    ))
+    h = ClusterHarness(seed=15, settings=settings)
+    try:
+        h.create_cluster(3)
+        h.wait_and_verify_agreement(3)
+        probe = InProcessClient(
+            Endpoint.from_parts("127.0.0.1", 9999), h.network, h.settings
+        )
+        members = list(h.instances)
+        # every status call ticks the ring; include_history=0 returns none
+        for _ in range(2):
+            for ep in members:
+                tick = _scrape(h, probe, ep, 0)
+                assert tick.history == ()
+            h.scheduler.run_until(lambda: False, timeout_ms=500)
+        replies = [_scrape(h, probe, ep, 8) for ep in members]
+        assert all(len(r.history) >= 2 for r in replies)
+
+        cluster = cluster_timeseries(replies)
+        assert set(cluster) == {str(ep) for ep in members}
+        for node, series in cluster.items():
+            by_base = {}
+            for name, points in series.items():
+                by_base.setdefault(parse_rendered(name)[0], []).extend(points)
+            snaps = sorted(by_base["profile.history_snapshots"])
+            assert len(snaps) >= 2, node
+            counts = [v for _, v in snaps]
+            assert counts == sorted(counts), node  # monotone on virtual time
+        # the transposed comparison view spans every member
+        merged = merge_by_series(cluster)
+        spanning = {
+            parse_rendered(name)[0]: set(nodes)
+            for name, nodes in merged.items()
+        }
+        assert any(
+            base == "profile.history_snapshots" for base in spanning
+        )
+    finally:
+        h.shutdown()
+
+
+def test_scrape_without_profiling_returns_no_history():
+    h = ClusterHarness(seed=16)  # default settings: profiling disabled
+    try:
+        h.create_cluster(2)
+        h.wait_and_verify_agreement(2)
+        probe = InProcessClient(
+            Endpoint.from_parts("127.0.0.1", 9999), h.network, h.settings
+        )
+        reply = _scrape(h, probe, h.addr(0), 8)
+        assert reply.history == ()
+        assert reply.membership_size == 2
+    finally:
+        h.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tools/perfscope.py contract
+# ---------------------------------------------------------------------------
+
+
+def test_perfscope_renders_real_exporter_output(tmp_path, capsys):
+    """End to end: profile a real simulator, dump json_snapshot, and the
+    CLI renders every phase plus the coverage line and writes a loadable
+    Chrome trace."""
+    from tools.perfscope import main as perfscope
+
+    sim, prof = _profiled_sim(128, seed=9)
+    inputs = sim._const_inputs(None)
+    prof.sample(sim.config, sim.state, inputs, False, repeats=2)
+    prof.record_host_transfer(0.05)
+    artifact = tmp_path / "metrics.json"
+    artifact.write_text(json.dumps(json_snapshot(sim.metrics)))
+    trace = tmp_path / "trace.json"
+
+    rc = perfscope(["render", str(artifact), "--trace-out", str(trace)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for phase in (*DEVICE_PHASES, "host_transfer"):
+        assert phase in out
+    assert "device step" in out
+    events = json.loads(trace.read_text())["traceEvents"]
+    assert [e["name"] for e in events] == [
+        "fd_scan", "cut_detector", "consensus_count", "host_transfer",
+    ]
+    assert all(e["ph"] == "X" for e in events)
+
+
+def test_perfscope_diff_flags_regressions():
+    old = {"metric": "m", "value": 100.0, "backend": "cpu",
+           "sweep": [{"n": 64, "warmed_wall_ms": 10.0,
+                      "jit_compiles_steady": 0}]}
+    new = dict(old, value=125.0,
+               sweep=[{"n": 64, "warmed_wall_ms": 10.2,
+                       "jit_compiles_steady": 2}])
+    text, regressions = diff_artifacts(old, new, threshold=0.10)
+    assert "headline: 100.0 -> 125.0" in text
+    assert any("headline" in r for r in regressions)
+    assert any("jit_compiles_steady" in r for r in regressions)
+    _, clean = diff_artifacts(old, dict(old, value=104.0), threshold=0.10)
+    assert clean == []

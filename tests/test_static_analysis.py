@@ -109,21 +109,32 @@ def test_check_rules_prints_full_catalog():
 
 def test_settings_catalog_lint_clean_and_two_sided():
     """The settings-catalog lint passes on today's tree, and its contract
-    holds at runtime too: SETTINGS_CATALOG keys are exactly the
-    AdaptiveFdSettings fields (two-sided -- a knob without bounds or a
-    stale catalog row both fail), with each default inside its bounds."""
+    holds at runtime too: SETTINGS_CATALOG keys are exactly the union of
+    the cataloged settings groups' dataclass fields (check.SETTINGS_GROUPS,
+    two-sided -- a knob without bounds or a stale catalog row both fail),
+    with each group's default inside its bounds."""
     assert check.check_settings_catalog() == []
+    import importlib
     from dataclasses import fields as dc_fields
 
-    from rapid_tpu.settings import SETTINGS_CATALOG, AdaptiveFdSettings
+    from rapid_tpu.settings import SETTINGS_CATALOG
 
-    knobs = {f"adaptive_fd.{f.name}" for f in dc_fields(AdaptiveFdSettings)}
+    settings_mod = importlib.import_module("rapid_tpu.settings")
+    knobs = set()
+    for prefix, cls_name in check.SETTINGS_GROUPS.items():
+        cls = getattr(settings_mod, cls_name)
+        fields = {f"{prefix}.{f.name}" for f in dc_fields(cls)}
+        assert fields <= set(SETTINGS_CATALOG), prefix
+        knobs |= fields
+        defaults = cls()
+        for key in fields:
+            entry = SETTINGS_CATALOG[key]
+            value = getattr(defaults, key.split(".", 1)[1])
+            if isinstance(value, bool):
+                value = int(value)
+            assert entry["min"] <= value <= entry["max"], key
+            assert entry["doc"]
     assert set(SETTINGS_CATALOG) == knobs
-    defaults = AdaptiveFdSettings()
-    for key, entry in SETTINGS_CATALOG.items():
-        value = getattr(defaults, key.split(".", 1)[1])
-        assert entry["min"] <= value <= entry["max"], key
-        assert entry["doc"]
 
 
 def test_default_scan_skips_fixture_corpus():

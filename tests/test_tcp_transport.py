@@ -217,3 +217,41 @@ def test_real_time_tcp_cluster(port_base):
     finally:
         seed.shutdown()
         c1.shutdown()
+
+
+def test_closed_connections_evicted_from_cache(port_base):
+    """Regression (ISSUE 15 satellite): a departed peer's closed connection
+    must leave the outbound cache -- and with it the per-peer queue-depth
+    digest -- instead of leaking a dead _Connection per churned peer. The
+    close callback is identity-checked, so only the closed object itself is
+    evicted (a dial-race loser can never evict the winner)."""
+    import time
+
+    server_addr = Endpoint.from_parts("127.0.0.1", port_base)
+    server = TcpClientServer(server_addr)
+    server.set_membership_service(EchoService())
+    server.start()
+    client = TcpClientServer(Endpoint.from_parts("127.0.0.1", port_base + 1))
+    try:
+        try:
+            p = client.send_message(server_addr, ProbeMessage(sender=client.address))
+            assert p.result(10) == ProbeResponse(NodeStatus.OK)
+            with client._conn_lock:
+                assert server_addr in client._connections
+            digest = client.transport_digest()
+            assert f"msg.queue_depth{{peer={server_addr}}}" in digest
+        finally:
+            server.shutdown()
+        # the peer is gone: the reactor notices EOF and the close callback
+        # drops the cached connection (bounded wait, real sockets)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with client._conn_lock:
+                if server_addr not in client._connections:
+                    break
+            time.sleep(0.01)
+        with client._conn_lock:
+            assert server_addr not in client._connections
+        assert client.transport_digest() == {}
+    finally:
+        client.shutdown()

@@ -67,9 +67,12 @@ RULE_DOCS = {
                    "types, sane windows/probabilities, a known harness",
     "gen-reach": "every fault Rule subclass must be reachable by the search "
                  "generator (GEN_RULES), or new faults stay untested",
-    "settings-catalog": "every adaptive-FD knob must be in SETTINGS_CATALOG "
-                        "with bounds its default satisfies, or operators "
-                        "tune blind",
+    "settings-catalog": "every cataloged settings knob must be in "
+                        "SETTINGS_CATALOG with bounds its default "
+                        "satisfies, or operators tune blind",
+    "metric-emission": "every METRIC_CATALOG name needs an emitting call "
+                       "site and every emission a catalog entry, or the "
+                       "catalog and the dashboards drift apart",
     # tools/check.py -- concurrency hygiene
     "thread-daemon": "a non-daemon thread outlives shutdown and hangs exit; "
                      "mark daemon=True or provably join it",
@@ -621,17 +624,26 @@ def check_generator_reach() -> list[Finding]:
     return findings
 
 
+# SETTINGS_CATALOG namespaces -> the frozen dataclass each one documents.
+# A new cataloged settings group registers here; a key outside every
+# registered namespace is a finding (the group ships without a dataclass).
+SETTINGS_GROUPS = {
+    "adaptive_fd": "AdaptiveFdSettings",
+    "profiling": "ProfilingSettings",
+}
+
+
 def check_settings_catalog() -> list[Finding]:
-    """Settings-catalog lint (the adaptive-FD knob discipline).
+    """Settings-catalog lint (the knob discipline).
 
     rapid_tpu/settings.py keeps SETTINGS_CATALOG, the pure-literal table of
-    every ``adaptive_fd.<knob>`` with its bounds and one-line doc -- the
-    table __post_init__ validates against and statusz/docs cite. Two-sided
-    freshness, same contract as RULE_CATALOG/GEN_RULES: every field of
-    AdaptiveFdSettings must have a catalog entry whose bounds are sane
-    (min <= max) and admit the field's default; every catalog key must name
-    a real field. All by AST walk -- importing settings would pull in the
-    package."""
+    every ``<group>.<knob>`` with its bounds and one-line doc -- the table
+    __post_init__ validates against and statusz/docs cite. Two-sided
+    freshness, same contract as RULE_CATALOG/GEN_RULES: every field of each
+    SETTINGS_GROUPS dataclass must have a catalog entry whose bounds are
+    sane (min <= max) and admit the field's default; every catalog key must
+    name a real field of its group's dataclass. All by AST walk --
+    importing settings would pull in the package."""
     findings: list[Finding] = []
     path = REPO / "rapid_tpu" / "settings.py"
 
@@ -644,11 +656,11 @@ def check_settings_catalog() -> list[Finding]:
         return findings
     catalog, cat_line = lits["SETTINGS_CATALOG"]
 
-    # AdaptiveFdSettings fields with literal defaults, by AST
-    fields: dict = {}
+    # each group dataclass's fields with literal defaults, by AST
+    by_class: dict = {cls: {} for cls in SETTINGS_GROUPS.values()}
     tree = ast.parse(path.read_text(), filename=str(path))
     for node in tree.body:
-        if not isinstance(node, ast.ClassDef) or node.name != "AdaptiveFdSettings":
+        if not isinstance(node, ast.ClassDef) or node.name not in by_class:
             continue
         for stmt in node.body:
             if (
@@ -657,61 +669,129 @@ def check_settings_catalog() -> list[Finding]:
                 and stmt.value is not None
             ):
                 try:
-                    fields[stmt.target.id] = (
+                    by_class[node.name][stmt.target.id] = (
                         ast.literal_eval(stmt.value), stmt.lineno
                     )
                 except ValueError:
                     pass
 
-    if not fields:
-        findings.append(Finding(
-            path, 0, "settings-catalog",
-            "AdaptiveFdSettings not found or has no literal-defaulted fields",
-        ))
-        return findings
-
-    for name, (default, lineno) in sorted(fields.items()):
-        key = f"adaptive_fd.{name}"
-        entry = catalog.get(key)
-        if entry is None:
+    for group, cls in sorted(SETTINGS_GROUPS.items()):
+        fields = by_class[cls]
+        if not fields:
             findings.append(Finding(
-                path, lineno, "settings-catalog",
-                f"AdaptiveFdSettings.{name} missing from SETTINGS_CATALOG: "
-                "the knob ships without bounds or doc",
+                path, 0, "settings-catalog",
+                f"{cls} not found or has no literal-defaulted fields",
             ))
             continue
-        if not ({"min", "max", "doc"} <= set(entry)):
-            findings.append(Finding(
-                path, cat_line, "settings-catalog",
-                f"SETTINGS_CATALOG[{key!r}] must carry min/max/doc",
-            ))
-            continue
-        lo, hi = entry["min"], entry["max"]
-        if lo > hi:
-            findings.append(Finding(
-                path, cat_line, "settings-catalog",
-                f"SETTINGS_CATALOG[{key!r}] bounds inverted: {lo} > {hi}",
-            ))
-        default_n = float(default) if isinstance(default, bool) else default
-        if not (lo <= default_n <= hi):
-            findings.append(Finding(
-                path, lineno, "settings-catalog",
-                f"AdaptiveFdSettings.{name} default {default!r} outside "
-                f"its own catalog bounds [{lo}, {hi}]",
-            ))
+        for name, (default, lineno) in sorted(fields.items()):
+            key = f"{group}.{name}"
+            entry = catalog.get(key)
+            if entry is None:
+                findings.append(Finding(
+                    path, lineno, "settings-catalog",
+                    f"{cls}.{name} missing from SETTINGS_CATALOG: "
+                    "the knob ships without bounds or doc",
+                ))
+                continue
+            if not ({"min", "max", "doc"} <= set(entry)):
+                findings.append(Finding(
+                    path, cat_line, "settings-catalog",
+                    f"SETTINGS_CATALOG[{key!r}] must carry min/max/doc",
+                ))
+                continue
+            lo, hi = entry["min"], entry["max"]
+            if lo > hi:
+                findings.append(Finding(
+                    path, cat_line, "settings-catalog",
+                    f"SETTINGS_CATALOG[{key!r}] bounds inverted: {lo} > {hi}",
+                ))
+            default_n = float(default) if isinstance(default, bool) else default
+            if not (lo <= default_n <= hi):
+                findings.append(Finding(
+                    path, lineno, "settings-catalog",
+                    f"{cls}.{name} default {default!r} outside "
+                    f"its own catalog bounds [{lo}, {hi}]",
+                ))
     for key in sorted(catalog):
-        if not key.startswith("adaptive_fd."):
+        group = key.split(".", 1)[0]
+        cls = SETTINGS_GROUPS.get(group)
+        if cls is None:
             findings.append(Finding(
                 path, cat_line, "settings-catalog",
-                f"SETTINGS_CATALOG key {key!r} outside the adaptive_fd. "
-                "namespace this catalog covers",
+                f"SETTINGS_CATALOG key {key!r} outside the namespaces this "
+                f"catalog covers ({', '.join(sorted(SETTINGS_GROUPS))})",
             ))
             continue
-        if key.split(".", 1)[1] not in fields:
+        if key.split(".", 1)[1] not in by_class[cls]:
             findings.append(Finding(
                 path, cat_line, "settings-catalog",
-                f"SETTINGS_CATALOG lists {key!r} but AdaptiveFdSettings "
+                f"SETTINGS_CATALOG lists {key!r} but {cls} "
                 "has no such field",
+            ))
+    return findings
+
+
+def check_metric_emission() -> list[Finding]:
+    """Catalog-emission lint (the two-sided metric-name discipline).
+
+    The per-file ``unknown-metric`` rule covers one direction at each call
+    site: a literal emission must use a cataloged name. This check closes
+    the loop repo-wide, the same shape as the settings-catalog lint: every
+    METRIC_CATALOG name must have at least one emitting call site
+    (.incr/.observe/.set_gauge) somewhere in rapid_tpu/ -- a cataloged name
+    nobody emits is a stale doc operators will grep dashboards for in vain
+    -- and every literal emission must be cataloged or belong to a
+    METRIC_PREFIXES dynamic family. Unlike the per-file rule this scan
+    includes observability.py itself (StableViewTimer and MetricsHistory
+    emit there) and scenarios.py (the nemesis harness emits its
+    zone-detection histogram from outside the package)."""
+    findings: list[Finding] = []
+    obs_path = REPO / "rapid_tpu" / "observability.py"
+    emitted: dict = {}  # name -> (path, lineno) of first literal emission
+    fstring_heads: list = []  # literal heads of f-string emissions
+
+    for path in iter_py_files([REPO / "rapid_tpu", REPO / "scenarios.py"]):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            continue  # the syntax rule already owns this finding
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("incr", "observe", "set_gauge")
+                and node.args
+            ):
+                continue
+            # a conditional pick between literals counts for each branch
+            # (faults.py: "nemesis_reordered" if ... else "nemesis_delayed")
+            args = [node.args[0]]
+            if isinstance(node.args[0], ast.IfExp):
+                args = [node.args[0].body, node.args[0].orelse]
+            for arg in args:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    emitted.setdefault(arg.value, (path, node.lineno))
+                elif isinstance(arg, ast.JoinedStr) and arg.values and isinstance(
+                    arg.values[0], ast.Constant
+                ):
+                    fstring_heads.append(str(arg.values[0].value))
+
+    for name in sorted(METRIC_CATALOG):
+        if name in emitted:
+            continue
+        if any(name.startswith(head) for head in fstring_heads):
+            continue  # covered by a dynamic family emission
+        findings.append(Finding(
+            obs_path, 0, "metric-emission",
+            f"METRIC_CATALOG lists {name!r} but no call site in rapid_tpu/ "
+            "emits it",
+        ))
+    for name, (path, lineno) in sorted(emitted.items()):
+        if name not in METRIC_CATALOG and not name.startswith(METRIC_PREFIXES):
+            findings.append(Finding(
+                path, lineno, "metric-emission",
+                f"emitted metric {name!r} is not in "
+                "observability.METRIC_CATALOG",
             ))
     return findings
 
@@ -898,6 +978,7 @@ def run(paths: "list[str] | None" = None) -> list[Finding]:
     findings.extend(check_fault_rules())
     findings.extend(check_generator_reach())
     findings.extend(check_settings_catalog())
+    findings.extend(check_metric_emission())
     findings.extend(check_plan_corpus())
     return findings
 

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""perfscope: render, diff, and regression-gate rapid-tpu profiling data.
+
+Three subcommands over the profiling plane's artifacts:
+
+``render`` -- per-phase device attribution as a flamegraph-style breakdown.
+Input is any JSON file carrying ``profile.phase_ms{phase=...}`` histograms:
+an ``observability.json_snapshot()`` dump, a ``tools/statusz.py --json``
+line (the last scraped history snapshot is used), or raw
+``MetricsHistory.to_wire`` lines. ``--trace-out`` additionally writes a
+Chrome-trace (chrome://tracing / Perfetto) file with one slice per phase,
+scaled to the measured mean, so the breakdown is inspectable next to any
+device trace.
+
+``diff`` -- compare two bench JSON artifacts (the single line bench.py
+prints): headline wall, per-size sweep walls, and compile counts, with a
+regression threshold (rc 3 when the new artifact is slower beyond it).
+
+``check`` -- gate one bench artifact against BASELINE.json's north-star
+budget (rc 3 on breach), the CI-shaped form of the same comparison.
+
+    python tools/perfscope.py render metrics.json
+    python tools/perfscope.py diff old_bench.json new_bench.json
+    python tools/perfscope.py check bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = __file__.rsplit("/", 2)[0]
+if _REPO not in sys.path:  # runnable as a script from anywhere in the tree
+    sys.path.insert(0, _REPO)
+
+# phase order matches the pipeline: profiling/phases.py PHASES
+PHASE_ORDER = ("fd_scan", "cut_detector", "consensus_count", "host_transfer")
+BAR_WIDTH = 24
+DEFAULT_THRESHOLD = 0.10  # 10% slower = regression
+NORTH_STAR_BUDGET_MS = 5000.0  # BASELINE.json: "converging ... in <5s"
+
+
+def parse_rendered(name: str) -> Tuple[str, Dict[str, str]]:
+    """``name{k=v,...}`` -> (base name, labels). The inverse of
+    observability._render for the label values profiling emits."""
+    if "{" not in name or not name.endswith("}"):
+        return name, {}
+    base, raw = name[:-1].split("{", 1)
+    labels: Dict[str, str] = {}
+    for part in raw.split(","):
+        if "=" in part:
+            key, value = part.split("=", 1)
+            labels[key] = value
+    return base, labels
+
+
+def _hist_count_sum(value: object) -> Optional[Tuple[float, float]]:
+    """(count, sum) from either exporter dialect: json_snapshot's
+    {"count","sum",...} dict or the history ring's [count, sum] pair."""
+    if isinstance(value, dict) and "count" in value and "sum" in value:
+        return float(value["count"]), float(value["sum"])
+    if isinstance(value, (list, tuple)) and len(value) == 2:
+        return float(value[0]), float(value[1])
+    return None
+
+
+def extract_phases(doc: object) -> Tuple[Dict[str, Tuple[float, float]], Optional[Tuple[float, float]]]:
+    """Pull ``profile.phase_ms`` per-phase (count, sum) and the
+    ``profile.step_ms`` (count, sum) out of whatever profiling artifact the
+    caller loaded (see module docstring for the accepted shapes)."""
+    hists: Dict[str, object] = {}
+    if isinstance(doc, dict):
+        if isinstance(doc.get("histograms"), dict):  # json_snapshot dump
+            hists = doc["histograms"]
+        elif isinstance(doc.get("history"), list) and doc["history"]:
+            last = doc["history"][-1]  # statusz --json: newest snapshot
+            if isinstance(last, dict) and isinstance(
+                last.get("histograms"), dict
+            ):
+                hists = last["histograms"]
+    elif isinstance(doc, list) and doc:  # raw history snapshot list
+        last = doc[-1]
+        if isinstance(last, dict) and isinstance(last.get("histograms"), dict):
+            hists = last["histograms"]
+    phases: Dict[str, Tuple[float, float]] = {}
+    step: Optional[Tuple[float, float]] = None
+    for rendered, value in hists.items():
+        base, labels = parse_rendered(str(rendered))
+        pair = _hist_count_sum(value)
+        if pair is None:
+            continue
+        if base == "profile.phase_ms" and "phase" in labels:
+            prev = phases.get(labels["phase"], (0.0, 0.0))
+            phases[labels["phase"]] = (prev[0] + pair[0], prev[1] + pair[1])
+        elif base == "profile.step_ms":
+            prev = step if step is not None else (0.0, 0.0)
+            step = (prev[0] + pair[0], prev[1] + pair[1])
+    return phases, step
+
+
+def load_profile_doc(path: str) -> object:
+    """A profiling artifact: one JSON document, or JSON lines (a scraped
+    history carriage / several statusz lines -- the last parseable line
+    wins, matching 'newest snapshot')."""
+    text = open(path).read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        docs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                docs.append(json.loads(line))
+            except ValueError:
+                continue
+        return docs
+
+
+def render_breakdown(phases: Dict[str, Tuple[float, float]],
+                     step: Optional[Tuple[float, float]]) -> str:
+    """The flamegraph-style per-phase breakdown: one bar per phase, widths
+    proportional to total attributed wall time."""
+    rows = [
+        (phase, phases[phase])
+        for phase in PHASE_ORDER
+        if phase in phases
+    ] + sorted(
+        (phase, pair) for phase, pair in phases.items()
+        if phase not in PHASE_ORDER
+    )
+    total_ms = sum(pair[1] for _, pair in rows)
+    lines = ["per-phase device attribution:"]
+    if not rows or total_ms <= 0:
+        lines.append("  (no profile.phase_ms samples -- profiling off?)")
+        return "\n".join(lines)
+    width = max(len(name) for name, _ in rows)
+    for name, (count, total) in rows:
+        frac = total / total_ms
+        bar = "#" * max(1, round(frac * BAR_WIDTH))
+        mean = total / count if count else 0.0
+        lines.append(
+            f"  {name:<{width}}  {bar:<{BAR_WIDTH}}  {frac * 100:5.1f}%"
+            f"  mean {mean:.3f}ms  n={int(count)}"
+        )
+    if step is not None and step[0] > 0:
+        step_mean = step[1] / step[0]
+        device_ms = sum(
+            pair[1] for name, pair in rows if name != "host_transfer"
+        )
+        device_n = max(
+            (pair[0] for name, pair in rows if name != "host_transfer"),
+            default=0.0,
+        )
+        device_mean = device_ms / device_n if device_n else 0.0
+        coverage = (device_mean / step_mean * 100.0) if step_mean else 0.0
+        lines.append(
+            f"  device step: mean {step_mean:.3f}ms (profile.step_ms,"
+            f" n={int(step[0])}); device phases cover {coverage:.1f}%"
+        )
+    return "\n".join(lines)
+
+
+def chrome_trace_events(phases: Dict[str, Tuple[float, float]]) -> Dict[str, object]:
+    """One synthetic 'mean dispatch' frame as Chrome-trace complete events:
+    the device phases stacked sequentially (they really are sequential
+    prefixes of one step), host_transfer after them."""
+    events: List[Dict[str, object]] = []
+    cursor_us = 0.0
+    for phase in PHASE_ORDER:
+        pair = phases.get(phase)
+        if pair is None or pair[0] <= 0:
+            continue
+        mean_us = pair[1] / pair[0] * 1000.0
+        events.append({
+            "name": phase, "ph": "X", "pid": 0, "tid": 0,
+            "ts": cursor_us, "dur": mean_us,
+            "cat": "profile", "args": {"samples": int(pair[0])},
+        })
+        cursor_us += mean_us
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# --------------------------------------------------------------------------- #
+# bench artifact diffing
+# --------------------------------------------------------------------------- #
+
+
+def load_bench_artifact(path: str) -> dict:
+    """The bench's single JSON line (tolerating surrounding log lines: the
+    first line that parses as a dict with a 'metric' key wins)."""
+    for line in open(path).read().splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "metric" in doc:
+            return doc
+    raise ValueError(f"{path}: no bench JSON artifact line found")
+
+
+def diff_artifacts(old: dict, new: dict,
+                   threshold: float = DEFAULT_THRESHOLD) -> Tuple[str, List[str]]:
+    """Human-readable diff of two bench artifacts plus the list of
+    regression descriptions (new slower than old beyond ``threshold``)."""
+    lines: List[str] = []
+    regressions: List[str] = []
+
+    def compare(label: str, old_v, new_v) -> None:
+        if old_v is None or new_v is None:
+            lines.append(f"  {label}: {old_v} -> {new_v}")
+            return
+        delta = new_v - old_v
+        pct = (delta / old_v * 100.0) if old_v else 0.0
+        lines.append(
+            f"  {label}: {old_v:.1f} -> {new_v:.1f} ms"
+            f" ({delta:+.1f}, {pct:+.1f}%)"
+        )
+        if old_v > 0 and new_v > old_v * (1.0 + threshold):
+            regressions.append(f"{label}: {old_v:.1f} -> {new_v:.1f} ms")
+
+    lines.append(
+        f"bench diff ({old.get('backend')}/"
+        f"{old.get('device_kind')} -> {new.get('backend')}/"
+        f"{new.get('device_kind')}):"
+    )
+    compare("headline", old.get("value"), new.get("value"))
+    old_sweep = {
+        e["n"]: e for e in old.get("sweep", ())
+        if isinstance(e, dict) and "n" in e
+    }
+    new_sweep = {
+        e["n"]: e for e in new.get("sweep", ())
+        if isinstance(e, dict) and "n" in e
+    }
+    for n in sorted(set(old_sweep) | set(new_sweep)):
+        a, b = old_sweep.get(n), new_sweep.get(n)
+        compare(
+            f"sweep n={n}",
+            a.get("warmed_wall_ms") if a else None,
+            b.get("warmed_wall_ms") if b else None,
+        )
+        compiles_a = a.get("jit_compiles_steady") if a else None
+        compiles_b = b.get("jit_compiles_steady") if b else None
+        if compiles_b not in (None, 0) and compiles_b != compiles_a:
+            regressions.append(
+                f"sweep n={n}: jit_compiles_steady {compiles_a} -> "
+                f"{compiles_b} (steady-state recompile)"
+            )
+    return "\n".join(lines), regressions
+
+
+# --------------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------------- #
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render/diff/gate rapid-tpu profiling artifacts"
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_render = sub.add_parser("render", help="per-phase attribution breakdown")
+    p_render.add_argument("artifact", help="json_snapshot / statusz --json / "
+                          "history-lines file")
+    p_render.add_argument("--trace-out", default=None,
+                          help="also write a Chrome-trace JSON of the phases")
+
+    p_diff = sub.add_parser("diff", help="diff two bench JSON artifacts")
+    p_diff.add_argument("old")
+    p_diff.add_argument("new")
+    p_diff.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                        help="regression threshold as a fraction "
+                        f"(default {DEFAULT_THRESHOLD})")
+
+    p_check = sub.add_parser(
+        "check", help="gate one bench artifact against BASELINE.json"
+    )
+    p_check.add_argument("artifact")
+    p_check.add_argument("--budget-ms", type=float, default=NORTH_STAR_BUDGET_MS,
+                         help="headline budget (default: the BASELINE.json "
+                         "north-star 5000ms)")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "render":
+        phases, step = extract_phases(load_profile_doc(args.artifact))
+        print(render_breakdown(phases, step))
+        if args.trace_out:
+            with open(args.trace_out, "w") as fh:
+                json.dump(chrome_trace_events(phases), fh)
+            print(f"wrote Chrome trace to {args.trace_out}", file=sys.stderr)
+        return 0 if phases else 2
+
+    if args.cmd == "diff":
+        text, regressions = diff_artifacts(
+            load_bench_artifact(args.old), load_bench_artifact(args.new),
+            threshold=args.threshold,
+        )
+        print(text)
+        for reg in regressions:
+            print(f"REGRESSION: {reg}", file=sys.stderr)
+        return 3 if regressions else 0
+
+    # check
+    doc = load_bench_artifact(args.artifact)
+    value = doc.get("value")
+    if value is None:
+        print(f"{args.artifact}: no headline value (outage artifact?)",
+              file=sys.stderr)
+        return 2
+    verdict = "within" if value <= args.budget_ms else "OVER"
+    print(
+        f"headline {value:.1f} ms vs budget {args.budget_ms:.0f} ms "
+        f"({value / args.budget_ms * 100.0:.1f}%): {verdict}"
+    )
+    return 3 if value > args.budget_ms else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

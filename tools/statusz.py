@@ -11,6 +11,7 @@ itself the finding.
 
     python tools/statusz.py 127.0.0.1:1234 127.0.0.1:1235
     python tools/statusz.py --json --journal 10 127.0.0.1:1234
+    python tools/statusz.py --history 32 127.0.0.1:1234 127.0.0.1:1235
 """
 
 from __future__ import annotations
@@ -26,14 +27,19 @@ if _REPO not in sys.path:  # runnable as a script from anywhere in the tree
 
 from rapid_tpu import Endpoint, Settings  # noqa: E402
 from rapid_tpu.messaging.tcp import TcpClientServer  # noqa: E402
+from rapid_tpu.profiling import cluster_timeseries, merge_by_series  # noqa: E402
 from rapid_tpu.types import ClusterStatusRequest, ClusterStatusResponse  # noqa: E402
 
 
 def fetch_status(
-    client: TcpClientServer, target: Endpoint, timeout_s: float = 5.0
+    client: TcpClientServer, target: Endpoint, timeout_s: float = 5.0,
+    include_history: int = 0,
 ) -> ClusterStatusResponse:
     reply = client.send_message(
-        target, ClusterStatusRequest(sender=client.address)
+        target,
+        ClusterStatusRequest(
+            sender=client.address, include_history=include_history
+        ),
     ).result(timeout_s)
     if not isinstance(reply, ClusterStatusResponse):
         raise RuntimeError(
@@ -192,7 +198,31 @@ def to_json(status: ClusterStatusResponse) -> dict:
         },
         "metrics": dict(zip(status.metric_names, status.metric_values)),
         "journal": [json.loads(line) for line in status.journal],
+        "history": [json.loads(line) for line in status.history],
     }
+
+
+def render_timeseries(statuses: List[ClusterStatusResponse],
+                      max_series: int = 12) -> str:
+    """The cluster-wide timeseries view assembled from every scraped
+    member's history ring: one line per (series, node) with span, point
+    count and last value -- the operator's "what moved, where" summary."""
+    by_series = merge_by_series(cluster_timeseries(statuses))
+    lines = ["cluster timeseries:"]
+    if not by_series:
+        lines.append("  (no history scraped -- profiling off or old peers)")
+        return "\n".join(lines)
+    for name in sorted(by_series)[:max_series]:
+        for node, points in sorted(by_series[name].items()):
+            first_ts, _ = points[0]
+            last_ts, last = points[-1]
+            lines.append(
+                f"  {name} @{node}: n={len(points)}"
+                f" span={last_ts - first_ts:.1f}s last={last:g}"
+            )
+    if len(by_series) > max_series:
+        lines.append(f"  ... and {len(by_series) - max_series} more series")
+    return "\n".join(lines)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -205,6 +235,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="emit one JSON object per target")
     parser.add_argument("--journal", type=int, default=5,
                         help="journal tail lines to show (text mode)")
+    parser.add_argument("--history", type=int, default=0,
+                        help="metric history snapshots to scrape per node; "
+                        "also renders the assembled cluster timeseries")
     args = parser.parse_args(argv)
     # client half only: no start() means no listening socket is ever bound
     client = TcpClientServer(Endpoint(b"127.0.0.1", 0), Settings())
@@ -215,15 +248,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     fingerprints: dict = {}
     # partition id -> set of serving leaders reported by its replicas
     leaders: dict = {}
+    statuses: List[ClusterStatusResponse] = []
     try:
         for raw in args.targets:
             target = Endpoint.from_string(raw)
             try:
-                status = fetch_status(client, target, args.timeout)
+                # only the history-bearing form passes the extra argument:
+                # the plain poll keeps the pre-profiling 3-arg call shape
+                # (monkeypatched in the handoff/serving statusz tests)
+                if args.history:
+                    status = fetch_status(
+                        client, target, args.timeout,
+                        include_history=args.history,
+                    )
+                else:
+                    status = fetch_status(client, target, args.timeout)
             except Exception as exc:  # noqa: BLE001 -- report and keep polling
                 print(f"{raw}: unreachable ({exc})", file=sys.stderr)
                 rc = 1
                 continue
+            statuses.append(status)
             configs.add(status.configuration_id)
             if status.placement_partitions:
                 placements.add(status.placement_version)
@@ -239,6 +283,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(json.dumps(to_json(status), sort_keys=True))
             else:
                 print(render(status, journal_lines=args.journal))
+        if args.history and not args.as_json and statuses:
+            print(render_timeseries(statuses))
     finally:
         client.shutdown()
     if len(configs) > 1:
