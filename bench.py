@@ -79,6 +79,7 @@ WATCHDOG_S = 20 * 60
 _PROGRESS: dict = {
     "headline": None, "backend": None, "sweep": [], "wan": None,
     "serving": None, "messaging": None, "gray_detection": None,
+    "recovery": None,
 }
 
 # jitwatch compile accounting of the most recent warmed_run (warmup vs
@@ -131,6 +132,17 @@ GRAY_WINDOWS = {
     # two windows while the adaptive streak concludes inside the first
     "gray_flapping": ((3_000, 9_000), (15_000, 21_000), (27_000, 33_000)),
 }
+
+# Recovery dimension: cold-start replay wall time of the durability plane's
+# log-over-snapshot recovery (rapid_tpu/durability), on a grid of log length
+# x snapshot recency. The replayed-record count at each point is exact and
+# deterministic per seed (records % snapshot_every, or the full log when
+# snapshots are off) and asserted, as is byte-identical recovered content;
+# the wall number rides the JSON line as recovery_time_ms.
+RECOVERY_LOG_RECORDS = (256, 1024)
+RECOVERY_SNAPSHOT_EVERY = (0, 256)   # 0 = never snapshot: full-log replay
+RECOVERY_PARTITIONS = 32
+RECOVERY_VALUE_BYTES = 512
 
 MESSAGING_PAIR_MSGS = 2_000
 MESSAGING_STORM_NODES = 16
@@ -280,6 +292,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "serving_qps": _PROGRESS["serving"],
                 "messaging_throughput": _PROGRESS["messaging"],
                 "gray_detection_ms": _PROGRESS["gray_detection"],
+                "recovery_time_ms": _PROGRESS["recovery"],
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "placement_partitions_moved": _placement_hist(),
                 "handoff_session_bytes": _handoff_hist(),
@@ -567,6 +580,17 @@ def run_sweep(backend: str, seed: int) -> list:
         _PROGRESS["gray_detection"] = {"error": f"{type(exc).__name__}: {exc}"}
         print(f"bench.py: gray-detection dimension failed: {exc}",
               file=sys.stderr, flush=True)
+    # recovery dimension: durability-plane cold-start replay; a wrong
+    # replayed-record count or non-identical recovered content is a
+    # correctness bug and crashes, anything else keeps the artifact
+    try:
+        run_recovery_dimension(seed)
+    except AssertionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 -- keep the artifact
+        _PROGRESS["recovery"] = {"error": f"{type(exc).__name__}: {exc}"}
+        print(f"bench.py: recovery dimension failed: {exc}",
+              file=sys.stderr, flush=True)
     return out
 
 
@@ -772,6 +796,76 @@ def run_gray_detection_dimension(seed: int) -> dict:
             "speedup": round(speedup, 2),
         }
     _PROGRESS["gray_detection"] = entry
+    return entry
+
+
+def run_recovery_dimension(seed: int) -> dict:
+    """Cold-start recovery curve of the durability plane: seeded workloads
+    of RECOVERY_LOG_RECORDS appends against a DurablePartitionStore at each
+    snapshot cadence in RECOVERY_SNAPSHOT_EVERY, crashed abruptly (torn
+    handle, no clean close) and reopened while the constructor replays
+    log-over-snapshot. The replayed-record count is exact -- records since
+    the last auto-checkpoint -- and the recovered content must be
+    byte-identical to a shadow map of everything written; both are asserted.
+    The wall number (recovery_ms per point) is the artifact."""
+    import tempfile
+
+    from rapid_tpu.durability import FSYNC_NEVER, DurablePartitionStore
+
+    points = []
+    for every in RECOVERY_SNAPSHOT_EVERY:
+        for records in RECOVERY_LOG_RECORDS:
+            rng = np.random.default_rng(seed * 7919 + records * 31 + every)
+            with tempfile.TemporaryDirectory(
+                prefix="rapid-bench-recovery-"
+            ) as directory:
+                store = DurablePartitionStore(
+                    directory, fsync_policy=FSYNC_NEVER,
+                    snapshot_every_records=every,
+                )
+                shadow = {}
+                for i in range(records):
+                    p = int(rng.integers(RECOVERY_PARTITIONS))
+                    value = b"%08d-" % i + bytes(
+                        rng.integers(0, 256, RECOVERY_VALUE_BYTES, dtype=np.uint8)
+                    )
+                    store.put(p, value)
+                    shadow[p] = value
+                store.crash()  # power loss: no flush, no snapshot marker
+                t0 = time.perf_counter()
+                reopened = DurablePartitionStore(
+                    directory, fsync_policy=FSYNC_NEVER,
+                    snapshot_every_records=every,
+                )
+                wall_ms = (time.perf_counter() - t0) * 1000.0
+                stats = reopened.durability_stats()
+                expected = records % every if every else records
+                assert stats["replayed_records"] == expected, (
+                    f"recovery dimension: replayed {stats['replayed_records']}"
+                    f" records, expected {expected} "
+                    f"(log={records}, snapshot_every={every})"
+                )
+                recovered = {
+                    p: reopened.get(p) for p in reopened.partitions()
+                }
+                assert recovered == shadow, (
+                    "recovery dimension: recovered content diverged from "
+                    "the written state"
+                )
+                reopened.close()
+                points.append({
+                    "log_records": records,
+                    "snapshot_every": every,
+                    "replayed_records": int(stats["replayed_records"]),
+                    "segments": int(stats["segments"]),
+                    "recovery_ms": round(wall_ms, 2),
+                })
+    entry = {
+        "partitions": RECOVERY_PARTITIONS,
+        "value_bytes": RECOVERY_VALUE_BYTES,
+        "points": points,
+    }
+    _PROGRESS["recovery"] = entry
     return entry
 
 

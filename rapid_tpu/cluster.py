@@ -187,6 +187,18 @@ class Cluster:
             self._has_shutdown = True
         self._server.shutdown()
         self._membership_service.shutdown()
+        # Graceful-stop durability barrier: flush the WAL and write a
+        # snapshot + marker so the next boot recovers with zero replayed
+        # records. Without this, a clean shutdown left the tail in the log
+        # and every restart paid a full replay -- and under FSYNC_NEVER the
+        # page cache could still hold acked writes when the process exited
+        # (pinned in tests/test_advice_regressions.py). Duck-typed so the
+        # in-memory store (no checkpoint()) is untouched.
+        engine = self._membership_service.handoff_engine()
+        if engine is not None:
+            checkpoint = getattr(engine.store, "checkpoint", None)
+            if checkpoint is not None:
+                checkpoint()
         self._resources.shutdown()
 
     def _check_running(self) -> None:
@@ -217,6 +229,7 @@ class ClusterBuilder:
         self._handoff_store: Optional[PartitionStore] = None
         self._serving = False
         self._tier_resolver: Optional[Callable[[Endpoint], str]] = None
+        self._durability_dir: Optional[str] = None
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -319,6 +332,36 @@ class ClusterBuilder:
         self._serving = True
         return self
 
+    def use_durability(self, directory: str) -> "ClusterBuilder":
+        """Enable the durability plane: mount a write-ahead-logged
+        DurablePartitionStore rooted at ``directory`` under the handoff
+        seam (durability/). Construction is recovery -- a restarted node
+        reopens with the state it acknowledged, reuses its persisted
+        NodeId to rejoin, and catches up via verified handoff pulls.
+        Gated on ``settings.durability.enabled`` (the kill switch): when
+        off, the directory is ignored and the node runs the exact
+        pre-durability in-memory path."""
+        self._durability_dir = directory
+        return self
+
+    def _durable_store(self):
+        """Build (and recover) the durable store when the plane is on;
+        mounts it as the handoff store so every downstream plane
+        (placement sizes, handoff pulls, serving persistence) rides it."""
+        if self._durability_dir is None or not self._settings.durability.enabled:
+            return None
+        from .durability.store import DurablePartitionStore
+
+        knobs = self._settings.durability
+        store = DurablePartitionStore(
+            self._durability_dir,
+            segment_bytes=knobs.segment_bytes,
+            fsync_policy=knobs.fsync_policy,
+            snapshot_every_records=knobs.snapshot_every_records,
+        )
+        self._handoff_store = store
+        return store
+
     def set_broadcaster_factory(self, factory) -> "ClusterBuilder":
         """Swap the dissemination strategy: ``factory(client, rng)`` returns
         the IBroadcaster this node's service uses (default:
@@ -399,7 +442,12 @@ class ClusterBuilder:
     def start(self) -> Cluster:
         """Bootstrap a seed node (Cluster.java:255-280)."""
         resources, client, server, rng = self._prepare()
-        node_id = NodeId.random(rng)
+        durable = self._durable_store()
+        # restart-aware identity: a seed that persisted its NodeId boots
+        # with the same identity it had before the restart
+        node_id = durable.node_id if durable is not None else None
+        if node_id is None:
+            node_id = NodeId.random(rng)
         view = MembershipView(K, node_ids=[node_id], endpoints=[self._listen_address])
         cut_detector = MultiNodeCutDetector(K, H, L)
         metadata_map = (
@@ -427,6 +475,9 @@ class ClusterBuilder:
             handoff_store=self._handoff_store,
             serving=self._serving,
         )
+        if durable is not None:
+            durable.set_identity(node_id)
+            durable.set_config_id(view.get_current_configuration_id())
         server.set_membership_service(service)
         server.start()
         return Cluster(server, service, resources, self._listen_address)
@@ -444,7 +495,20 @@ class ClusterBuilder:
         # (Cluster.java:312, GrpcServer.java:83-95).
         server.start()
         result: Promise = Promise()
-        state = {"node_id": NodeId.random(rng), "attempt": 0}
+        durable = self._durable_store()
+        # Restart-aware rejoin: reuse the persisted NodeId. A returning
+        # host still present in the ring then gets HOSTNAME_ALREADY_IN_RING
+        # in phase 1 and SAFE_TO_JOIN from observers that recognize the
+        # (host, identity) pair -- the fast identity-preserving path; a
+        # fresh random id on a still-present hostname would loop on
+        # CONFIG_CHANGED until eviction. The identifier history is
+        # append-only, so after eviction the old id is burned and the
+        # UUID_ALREADY_IN_RING redraw below takes over.
+        persisted = durable.node_id if durable is not None else None
+        state = {
+            "node_id": persisted if persisted is not None else NodeId.random(rng),
+            "attempt": 0,
+        }
         join_metrics = self._metrics if self._metrics is not None else JOIN_METRICS
         # the flight recorder outlives individual join attempts: created here
         # so retry exhaustion is journaled even when no service ever exists,
@@ -567,6 +631,9 @@ class ClusterBuilder:
                 handoff_store=self._handoff_store,
                 serving=self._serving,
             )
+            if durable is not None:
+                durable.set_identity(state["node_id"])
+                durable.set_config_id(response.configuration_id)
             server.set_membership_service(service)
             result.set_result(
                 Cluster(server, service, resources, self._listen_address)

@@ -217,6 +217,42 @@ class WireVersionRule(Rule):
     version: int = 2
 
 
+@dataclass(frozen=True)
+class RestartNodeRule(Rule):
+    """Process restart: the matched destination is dead for the span of
+    each window (killed at its start, restarted -- with WAL recovery --
+    at its end). The windows ARE the down periods, so they must all be
+    closed: an open-ended window is a crash-stop, which PartitionRule and
+    the fabric's eviction machinery already model. While down the node
+    neither answers nor sends; at the window's end the harness recovers
+    its durable store (log-over-snapshot) and re-pulls whatever it missed
+    through verified handoff catch-up."""
+
+
+@dataclass(frozen=True)
+class TornWriteRule(Rule):
+    """Storage fault: the matched destination's WAL tail is torn while it
+    is down -- ``drop_bytes`` truncated off the last segment, or
+    (``corrupt``) a byte inside the final record flipped so its CRC fails
+    -- modeling a crash mid-append or a half-flushed page. Applied by the
+    recovery harness at restart (the message plane is untouched): recovery
+    must truncate at the first bad record and converge via catch-up."""
+
+    drop_bytes: int = 3
+    corrupt: bool = False
+
+
+@dataclass(frozen=True)
+class DiskStallRule(Rule):
+    """Gray storage failure: every fsync on the matched destination takes
+    ``stall_ms`` extra -- a dying disk, a saturated EBS volume. The rule
+    matches the ``Put`` wire (builder-enforced) so the serving plane's
+    quorum writes feel it while probes stay unaffected: the node looks
+    healthy to every FD while its write path quietly drags."""
+
+    stall_ms: int = 0
+
+
 # Device-plane behavior of every Rule subclass; tools/check.py lints that
 # this catalog and the set of Rule subclasses in this module stay in sync.
 #   compiled  -- mapped onto the Simulator's fault arrays by apply_plan_at
@@ -233,6 +269,9 @@ RULE_CATALOG = {
     "ReorderRule": "absorbed",     # intra-round reordering only
     "ClockSkewRule": "absorbed",   # bounded drift never flips a round
     "WireVersionRule": "absorbed", # wire bytes are not modeled on device
+    "RestartNodeRule": "compiled", # down window -> partition-equivalent cut
+    "TornWriteRule": "absorbed",   # storage-level; no device storage model
+    "DiskStallRule": "absorbed",   # Put-path latency; probes unaffected
 }
 
 
@@ -423,6 +462,48 @@ class FaultPlan:
             version=version,
         ))
 
+    def restart_node(self, node: Endpoint,
+                     windows: Tuple[Window, ...]) -> "FaultPlan":
+        """Kill ``node`` at each window's start and restart it (with
+        recovery) at its end. Windows must be closed -- an open-ended one
+        is a crash-stop, which partition_one_way already models."""
+        if not windows:
+            raise ValueError("restart_node needs at least one down window")
+        if any(end is None for _start, end in windows):
+            raise ValueError(
+                "restart_node windows must be closed (a restart implies a "
+                "return); use partition_one_way for a crash-stop"
+            )
+        return self._add(RestartNodeRule(
+            match=self._match(None, node, None), at=EGRESS, windows=windows,
+        ))
+
+    def torn_write(self, node: Endpoint,
+                   windows: Tuple[Window, ...] = _ALWAYS,
+                   drop_bytes: int = 3, corrupt: bool = False) -> "FaultPlan":
+        """Tear ``node``'s WAL tail during recovery from any restart that
+        overlaps a window: truncate ``drop_bytes`` off the last segment,
+        or flip a byte in its final record when ``corrupt``."""
+        if drop_bytes < 1:
+            raise ValueError(f"drop_bytes must be >= 1, got {drop_bytes}")
+        return self._add(TornWriteRule(
+            match=self._match(None, node, None), at=EGRESS, windows=windows,
+            drop_bytes=drop_bytes, corrupt=bool(corrupt),
+        ))
+
+    def disk_stall(self, node: Endpoint, stall_ms: int,
+                   windows: Tuple[Window, ...] = _ALWAYS) -> "FaultPlan":
+        """Every fsync on ``node`` takes ``stall_ms`` extra; surfaces on
+        the Put wire (quorum writes drag) while probes stay healthy."""
+        from .types import Put
+
+        if stall_ms < 1:
+            raise ValueError(f"stall_ms must be >= 1, got {stall_ms}")
+        return self._add(DiskStallRule(
+            match=self._match(None, node, (Put,)), at=EGRESS,
+            windows=windows, stall_ms=stall_ms,
+        ))
+
     def to_json(self) -> dict:
         """JSON-able dict of the whole plan: rules (with windows and link
         matches), seed, topology + endpoint slots. ``from_json`` is the
@@ -534,6 +615,11 @@ def _rule_to_json(rule: Rule) -> dict:
         spec["rate"] = rule.rate
     elif isinstance(rule, WireVersionRule):
         spec["version"] = rule.version
+    elif isinstance(rule, TornWriteRule):
+        spec["drop_bytes"] = rule.drop_bytes
+        spec["corrupt"] = rule.corrupt
+    elif isinstance(rule, DiskStallRule):
+        spec["stall_ms"] = rule.stall_ms
     return spec
 
 
@@ -590,6 +676,20 @@ def _build_rule(plan: FaultPlan, spec: dict) -> None:
         if src is None:
             raise ValueError("WireVersionRule needs a src node")
         plan.wire_version(src, int(spec["version"]), windows=windows)
+    elif kind == "RestartNodeRule":
+        if dst is None:
+            raise ValueError("RestartNodeRule needs a dst node")
+        plan.restart_node(dst, windows=windows)
+    elif kind == "TornWriteRule":
+        if dst is None:
+            raise ValueError("TornWriteRule needs a dst node")
+        plan.torn_write(dst, windows=windows,
+                        drop_bytes=int(spec.get("drop_bytes", 3)),
+                        corrupt=bool(spec.get("corrupt", False)))
+    elif kind == "DiskStallRule":
+        if dst is None:
+            raise ValueError("DiskStallRule needs a dst node")
+        plan.disk_stall(dst, int(spec["stall_ms"]), windows=windows)
     else:
         raise ValueError(f"unknown rule type {kind!r}")
 
@@ -736,7 +836,10 @@ class Nemesis:
                 continue
             if not rule.active_at(t):
                 continue
-            if isinstance(rule, (PartitionRule, FlipFlopRule)):
+            if isinstance(rule, (PartitionRule, FlipFlopRule,
+                                 RestartNodeRule)):
+                # a down-window restart victim is, to the message plane, a
+                # one-way cut; its recovery semantics live in the harness
                 out.drop = True
             elif isinstance(rule, DropRule):
                 if self._draw(idx, src_s, dst_s) < rule.probability:
@@ -759,6 +862,10 @@ class Nemesis:
                     out.reordered = True
             elif isinstance(rule, SlowNodeRule):
                 out.slow_ms = max(out.slow_ms, rule.response_delay_ms)
+            elif isinstance(rule, DiskStallRule):
+                # the match restricts this to the Put wire: the stalled
+                # fsync surfaces as a late quorum-write answer
+                out.slow_ms = max(out.slow_ms, rule.stall_ms)
             elif isinstance(rule, WireVersionRule):
                 out.wire_version = rule.version
             # ClockSkewRule is consulted via scheduler_for, not per message
@@ -986,6 +1093,11 @@ def _device_rules(plan: FaultPlan, round_ms: int) -> List[Tuple[int, Rule]]:
             # idempotent / intra-round / byte-level: invisible to the round
             # model (the device plane never serializes wire frames)
             continue
+        if isinstance(rule, (TornWriteRule, DiskStallRule)):
+            # storage-level faults: the device plane models the probe
+            # fabric, not stable storage -- torn tails and fsync stalls are
+            # applied by the recovery harness / serving mirror instead
+            continue
         if isinstance(rule, ClockSkewRule):
             if not 0.5 <= rule.rate <= 2.0:
                 raise UnsupportedDeviceFault(
@@ -1075,9 +1187,11 @@ def apply_plan_at(sim, plan: FaultPlan, t_ms: int,
             targets = [slots[rule.match.dst]]
         else:
             targets = [s for s in range(sim.config.capacity) if sim.active[s]]
-        if isinstance(rule, (PartitionRule, FlipFlopRule, SlowNodeRule)):
+        if isinstance(rule, (PartitionRule, FlipFlopRule, SlowNodeRule,
+                             RestartNodeRule)):
             # a node answering slower than the probe deadline is, to every
             # observer, a node whose probes all fail: partition-equivalent
+            # (a restart victim's down window reads the same way)
             cut.extend(targets)
         elif isinstance(rule, DropRule):  # incl. LossyLinkRule
             sim.ingress_loss(np.asarray(targets), rule.probability)

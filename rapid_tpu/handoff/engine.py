@@ -169,6 +169,17 @@ class HandoffEngine:
             return
         if self.store.get(msg.partition) is None:
             return
+        # Durability barrier BEFORE the release: the source copy is the
+        # last line of defense for this partition until the recipient's
+        # copy is stable, so a durable store must not discard it while its
+        # own WAL still holds unfsynced records -- a crash straight after
+        # the delete would otherwise recover to a state that neither holds
+        # the partition nor can prove who does (pinned in
+        # tests/test_advice_regressions.py). Duck-typed: the in-memory
+        # store has no sync() and is untouched.
+        sync = getattr(self.store, "sync", None)
+        if sync is not None:
+            sync()
         self.store.delete(msg.partition)
         self.metrics.incr("handoff.releases")
         if self._recorder is not None:
@@ -393,6 +404,12 @@ class HandoffEngine:
         if self._tracer is not None and session.span is not None:
             session.span.attrs["bytes"] = len(data)
             self._tracer.end(session.span, virtual_ms=self._now())
+        # the ack below authorizes the source to discard its copy, so this
+        # recipient's copy must be durable before the ack leaves: sync the
+        # store (no-op on the in-memory reference store) ahead of the send
+        sync = getattr(self.store, "sync", None)
+        if sync is not None:
+            sync()
         ack = HandoffAck(
             sender=self.address, session_id=plan.session_id,
             partition=plan.partition, fingerprint=fingerprint,

@@ -377,6 +377,9 @@ def to_wire_response(msg) :
         s.fdTierThreshold.extend(msg.fd_tier_threshold)
         s.fdTierFlushMs.extend(msg.fd_tier_flush_ms)
         s.history.extend(msg.history)
+        s.durabilitySegments = msg.durability_segments
+        s.durabilitySnapshotVersion = msg.durability_snapshot_version
+        s.durabilityReplayed = msg.durability_replayed
     elif isinstance(msg, T.PutAck):
         a = resp.putAck
         a.sender.CopyFrom(_ep(msg.sender))
@@ -458,6 +461,9 @@ def from_wire_response(resp):
             fd_tier_threshold=tuple(int(v) for v in m.fdTierThreshold),
             fd_tier_flush_ms=tuple(int(v) for v in m.fdTierFlushMs),
             history=tuple(str(line) for line in m.history),
+            durability_segments=int(m.durabilitySegments),
+            durability_snapshot_version=int(m.durabilitySnapshotVersion),
+            durability_replayed=int(m.durabilityReplayed),
         )
     if which == "putAck":
         m = resp.putAck

@@ -157,6 +157,11 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         # profiling plane exposure: the metric history-ring tail as JSON
         # lines (one snapshot per line, MetricsHistory.to_wire)
         ("history", "string", 33, True),
+        # durability plane exposure: WAL segment count, last snapshot
+        # version, and records replayed by the most recent recovery
+        ("durabilitySegments", "int64", 34, False),
+        ("durabilitySnapshotVersion", "int64", 35, False),
+        ("durabilityReplayed", "int64", 36, False),
     ],
     "HandoffRequest": [
         ("sender", "M:Endpoint", 1, False),

@@ -160,6 +160,13 @@ METRIC_CATALOG = frozenset({
     "profile.step_ms",     # shadow-measured full device step (histogram)
     "profile.samples",     # shadow attribution samples taken
     "profile.history_snapshots",  # metric history-ring snapshots recorded
+    # durability plane (durability/)
+    "durability.appends",           # WAL records appended (puts + deletes)
+    "durability.fsyncs",            # physical fsync barriers issued
+    "durability.snapshots",         # checkpoints written (snapshot + marker)
+    "durability.segments",          # live WAL segment count (gauge)
+    "durability.replayed_records",  # log records replayed by last recovery
+    "durability.torn_truncations",  # torn tails truncated at a bad record
 })
 
 # Dynamic name families: an f-string call site is legal iff its literal head
@@ -204,6 +211,8 @@ EVENT_CATALOG = frozenset({
     "handoff_release",   # source released a partition after a verified ack
     "serving_leader_change",  # a partition's leader moved with the view
     "serving_sync",      # churned partition re-synced from replica snapshots
+    "durability_recovered",   # store reopened: snapshot loaded + log replayed
+    "durability_checkpoint",  # snapshot + marker written, old segments culled
 })
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).

@@ -23,6 +23,7 @@ INVARIANTS = (
     "config-parity",
     "fingerprint-agreement",
     "gray-collateral",
+    "durability",
 )
 
 
@@ -132,6 +133,8 @@ def _check_key_linearizable(key: bytes, ops: Sequence[ClientOp]) -> None:
         by_version[put.version] = put
     for a in acked:
         for b in acked:
+            if a is b:
+                continue  # a 0-ms local-apply ack must not conflict with itself
             if a.complete_ms <= b.invoke_ms and a.version >= b.version:
                 raise InvariantViolation(
                     "linearizability",
@@ -208,6 +211,49 @@ def check_gray_collateral(
             f"healthy nodes evicted under a pure gray plan: "
             f"{', '.join(collateral)} (faulted: {sorted(faulted_set)})",
         )
+
+
+def check_durability(
+    acked: Mapping[bytes, int],
+    durable: Mapping[bytes, int],
+    recovery_replicas: Iterable[Tuple[int, str, object]] = (),
+) -> None:
+    """Restart-survival invariant (ISSUE PR 16): every acked write outlives
+    every restart, and a recovered node converges with its replica row.
+
+    ``acked`` maps key -> highest version any client received an OK ack
+    for; ``durable`` maps key -> highest version found in stable storage
+    across the live replicas after the run quiesces. A key whose durable
+    version trails its acked version is a lost acked write. Optional
+    ``recovery_replicas`` is ``(partition, node, fingerprint)`` restricted
+    to rows holding a recovered node; any fingerprint split there means
+    recovery replayed to a state the row does not agree with."""
+    for key in sorted(acked):
+        floor = int(acked[key])
+        held = int(durable.get(key, 0))
+        if held < floor:
+            raise InvariantViolation(
+                "durability",
+                f"lost acked write on {key!r}: version {floor} was acked "
+                f"but stable storage holds {held if held else 'nothing'}",
+            )
+    by_partition: Dict[int, Dict[object, List[str]]] = {}
+    for partition, node, fingerprint in recovery_replicas:
+        by_partition.setdefault(int(partition), {}).setdefault(
+            fingerprint, []
+        ).append(node)
+    for partition in sorted(by_partition):
+        holders = by_partition[partition]
+        if len(holders) > 1:
+            detail = "; ".join(
+                f"{fp!r} on {', '.join(sorted(nodes))}"
+                for fp, nodes in sorted(holders.items(), key=lambda kv: repr(kv[0]))
+            )
+            raise InvariantViolation(
+                "durability",
+                f"recovered replica row diverged on partition {partition}: "
+                f"{detail}",
+            )
 
 
 def check_view_agreement(views: Mapping[str, object]) -> None:

@@ -37,7 +37,9 @@ from ..faults import (
     FlipFlopRule,
     Nemesis,
     PartitionRule,
+    RestartNodeRule,
     SlowNodeRule,
+    TornWriteRule,
 )
 from ..handoff.store import InMemoryPartitionStore
 from ..observability import FlightRecorder, Metrics
@@ -86,6 +88,8 @@ class ServingFabric:
         self.nemesis.arm(epoch_ms=0)
         self.endpoints = fabric_endpoints(n)
         self.live: Set[Endpoint] = set(self.endpoints)
+        self.down: Set[Endpoint] = set()
+        self.recovered: List[Endpoint] = []
         self.config = PlacementConfig(
             partitions=partitions, replicas=replicas, seed=config_seed
         )
@@ -113,6 +117,31 @@ class ServingFabric:
         for ep in self.endpoints:
             self.engines[ep].update_map(self.map)
         self.history: List[ClientOp] = []
+        # restart plane: a RestartNodeRule window is a crash-and-recover, not
+        # an eviction -- the store survives, the identity is retained, and
+        # recovery catches the node up through the replica row (the fabric
+        # analogue of WAL replay + verified handoff pull). TornWriteRule
+        # marks the victim's local copies untrustworthy past the last
+        # snapshot, forcing the catch-up pull.
+        self.torn: Set[Endpoint] = {
+            r.match.dst for r in plan.rules
+            if isinstance(r, TornWriteRule) and r.match.dst in self.stores
+        }
+        for rule in plan.rules:
+            if not isinstance(rule, RestartNodeRule):
+                continue
+            victim = rule.match.dst
+            if victim not in self.stores:
+                continue
+            for start, end in rule.windows:
+                if end is None:
+                    continue  # builder enforces closed; tolerate mutations
+                self.scheduler.schedule(
+                    start, lambda ep=victim: self._crash(ep)
+                )
+                self.scheduler.schedule(
+                    end, lambda ep=victim: self._recover(ep)
+                )
         for when_ms, ep in self._eviction_schedule(plan):
             self.scheduler.schedule(
                 when_ms, lambda victim=ep: self._evict(victim)
@@ -209,11 +238,74 @@ class ServingFabric:
         self.recorder.record("kicked", node=str(victim), epoch=self.epoch)
         self.map = new
 
+    # -- restart plane ----------------------------------------------------- #
+
+    def _crash(self, ep: Endpoint) -> None:
+        if ep not in self.live:
+            return  # already evicted: nothing left to restart
+        self.down.add(ep)
+        self.recorder.record("fd_signal", node=str(ep), verdict="restart")
+
+    def _recover(self, ep: Endpoint) -> None:
+        if ep not in self.down:
+            return
+        self.down.discard(ep)
+        from ..serving.kv import decode_kv, encode_kv
+
+        torn = ep in self.torn
+        if torn:
+            self.metrics.incr("durability.torn_truncations")
+        replayed = 0
+        for p, row in enumerate(self.map.assignments):
+            if ep not in row:
+                continue
+            # max-merge across the live row plus the survivor's own copy
+            # (unless torn): any acked write reached a majority, so at least
+            # one live replica still holds it, and the merged blob written
+            # back everywhere is what fingerprint convergence asserts
+            merged: dict = {}
+            holders = [
+                peer for peer in row
+                if peer in self.live and peer not in self.down
+            ]
+            for holder in holders:
+                blob = self.stores[holder].get(p)
+                if holder == ep and torn:
+                    continue  # torn tail: local copy is not trustworthy
+                for key, (version, value) in decode_kv(blob).items():
+                    cur = merged.get(key)
+                    if cur is None or version > cur[0]:
+                        merged[key] = (version, value)
+            blob = encode_kv(merged)
+            for holder in holders:
+                if self.stores[holder].get(p) != blob:
+                    self.stores[holder].put(p, blob)
+                    if holder == ep:
+                        replayed += 1
+        self.engines[ep].update_map(self.map)  # may have moved while down
+        self.recovered.append(ep)
+        if replayed:
+            self.metrics.incr("durability.replayed_records", replayed)
+        self.recorder.record(
+            "durability_recovered", node=str(ep), replayed=replayed,
+        )
+
     # -- nemesis-routed transport ----------------------------------------- #
 
     def _send(self, src: Endpoint, dst: Endpoint, msg) -> Promise:
-        d = self.nemesis.decide(src, dst, msg, EGRESS)
         kind = type(msg).__name__
+        if src in self.down or dst in self.down:
+            # a restarting process neither sends nor answers: the sender
+            # sees the same deadline a dropped message produces
+            out: Promise = Promise()
+            self.scheduler.schedule(
+                DROP_TIMEOUT_MS,
+                lambda: out.try_set_exception(
+                    TimeoutError(f"{dst} is restarting")
+                ),
+            )
+            return out
+        d = self.nemesis.decide(src, dst, msg, EGRESS)
         if d.drop:
             self.metrics.incr("nemesis_dropped", at="egress", msg=kind)
             out: Promise = Promise()
@@ -254,7 +346,7 @@ class ServingFabric:
                  out: Promise) -> None:
         def dispatch() -> None:
             engine = self.engines.get(dst)
-            if engine is None:
+            if engine is None or dst in self.down:
                 out.try_set_exception(TimeoutError(f"no such node {dst}"))
                 return
             reply = (
@@ -297,6 +389,8 @@ class ServingFabric:
 
     def _issue(self, op: str, client: Endpoint, key: bytes,
                value: bytes) -> None:
+        if client in self.down:
+            return  # co-located client restarts with its node: no op issued
         engine = self.engines[client]
         invoke_ms = self.scheduler.now_ms()
         promise = (
@@ -333,6 +427,38 @@ class ServingFabric:
             str(ep): getattr(self.engines[ep]._map, "version", None)  # noqa: SLF001
             for ep in sorted(self.live)
         }
+
+    def durable_versions(self) -> Dict[bytes, int]:
+        """Ground truth for the durability invariant: per key, the highest
+        version any live, up replica holds in stable storage."""
+        from ..serving.kv import decode_kv
+
+        out: Dict[bytes, int] = {}
+        for p, row in enumerate(self.map.assignments):
+            for ep in row:
+                if ep not in self.live or ep in self.down:
+                    continue
+                blob = self.stores[ep].get(p)
+                if blob is None:
+                    continue
+                for key, (version, _value) in decode_kv(blob).items():
+                    if version > out.get(key, 0):
+                        out[key] = version
+        return out
+
+    def recovery_fingerprints(self) -> List[Tuple[int, str, object]]:
+        """``(partition, node, fingerprint)`` over every row that contains a
+        recovered node -- the durability checker's convergence witness."""
+        recovered = set(self.recovered)
+        out: List[Tuple[int, str, object]] = []
+        for p, row in enumerate(self.map.assignments):
+            if not any(ep in recovered for ep in row):
+                continue
+            for ep in row:
+                if ep not in self.live or ep in self.down:
+                    continue
+                out.append((p, str(ep), self.stores[ep].fingerprint(p)))
+        return out
 
 
 def _settle(src: Promise, dst: Promise) -> None:

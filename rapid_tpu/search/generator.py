@@ -27,13 +27,16 @@ from ..faults import FaultPlan
 GEN_RULES = (
     "ClockSkewRule",
     "DelayRule",
+    "DiskStallRule",
     "DropRule",
     "DuplicateRule",
     "FlipFlopRule",
     "LossyLinkRule",
     "PartitionRule",
     "ReorderRule",
+    "RestartNodeRule",
     "SlowNodeRule",
+    "TornWriteRule",
     "WireVersionRule",
 )
 
@@ -216,6 +219,22 @@ class PlanGenerator:
                               windows=[[0, None]])
             spec["offset_ms"] = rnd.choice([-200, 0, 200])
             spec["rate"] = rnd.choice([0.75, 1.0, 1.25])
+        elif kind == "RestartNodeRule":
+            # closed down windows short enough that the fabric's recovery
+            # path (not its eviction machinery) is what gets exercised
+            start = rnd.randrange(0, max(1, self.horizon_ms // 2))
+            down = rnd.choice([150, 300, 600])
+            spec = self._base(kind, rnd, dst=self._node(rnd),
+                              windows=[[start, start + down]])
+        elif kind == "TornWriteRule":
+            spec = self._base(kind, rnd, dst=self._node(rnd),
+                              windows=[[0, None]])
+            spec["drop_bytes"] = rnd.choice([1, 3, 9])
+            spec["corrupt"] = rnd.random() < 0.5
+        elif kind == "DiskStallRule":
+            spec = self._base(kind, rnd, dst=self._node(rnd),
+                              msg_types=["Put"])
+            spec["stall_ms"] = rnd.choice([10, 40, 120])
         else:  # WireVersionRule
             spec = self._base(kind, rnd, src=self._node(rnd))
             spec["version"] = rnd.choice([1, 3])
@@ -226,7 +245,8 @@ class PlanGenerator:
         # Put replication (the runner routes these to enable_serving)
         if rnd.random() < 0.4:
             kind = rnd.choice(
-                ("DropRule", "DuplicateRule", "ReorderRule", "DelayRule")
+                ("DropRule", "DuplicateRule", "ReorderRule", "DelayRule",
+                 "DiskStallRule")
             )
             spec = self._base(kind, rnd, msg_types=["Put"],
                               windows=[[0, None]])
@@ -237,6 +257,9 @@ class PlanGenerator:
             elif kind == "ReorderRule":
                 spec["probability"] = rnd.choice([0.3, 0.6])
                 spec["max_extra_ms"] = rnd.choice([20, 50])
+            elif kind == "DiskStallRule":
+                spec["dst"] = self._node(rnd)
+                spec["stall_ms"] = rnd.choice([5, 20])
             else:
                 spec["base_ms"] = rnd.choice([2, 5])
                 spec["jitter_ms"] = rnd.randrange(0, 4)
@@ -246,7 +269,8 @@ class PlanGenerator:
         # delays)
         kind = rnd.choice(
             ("DropRule", "PartitionRule", "FlipFlopRule", "LossyLinkRule",
-             "SlowNodeRule", "ClockSkewRule", "DelayRule")
+             "SlowNodeRule", "ClockSkewRule", "DelayRule",
+             "RestartNodeRule")
         )
         dst = self._node(rnd)
         if kind == "DropRule":
@@ -267,6 +291,13 @@ class PlanGenerator:
             spec = self._base(kind, rnd, src=dst, windows=[[0, None]])
             spec["offset_ms"] = rnd.choice([-500, 0, 500])
             spec["rate"] = rnd.choice([0.8, 1.0, 1.25])
+        elif kind == "RestartNodeRule":
+            # down spans on the sim's detection timescale: long enough to
+            # exercise the membership reaction, always closed
+            start = rnd.randrange(0, max(1, self.horizon_ms // 2))
+            down = rnd.choice([2000, 4000, 8000])
+            spec = self._base(kind, rnd, dst=dst,
+                              windows=[[start, start + down]])
         else:  # DelayRule: must stay under the FD round to compile
             spec = self._base(kind, rnd, dst=dst)
             spec["base_ms"] = rnd.choice([10, 40])

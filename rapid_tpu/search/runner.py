@@ -30,6 +30,7 @@ from .checkers import (
     ClientOp,
     InvariantViolation,
     check_config_parity,
+    check_durability,
     check_fingerprint_agreement,
     check_gray_collateral,
     check_leader_agreement,
@@ -121,6 +122,24 @@ def run_engine_probe(spec: dict) -> ProbeResult:
         lambda: check_leader_agreement(fabric.live_digests()),
         lambda: check_view_agreement(fabric.map_versions()),
     ]
+    from ..faults import RestartNodeRule, TornWriteRule
+
+    if any(isinstance(r, (RestartNodeRule, TornWriteRule)) for r in plan.rules):
+        # restart-bearing plans additionally carry the durability oracle:
+        # acked writes must survive every crash-and-recover, and each
+        # recovered node's row must hold converged fingerprints
+        acked_versions: dict = {}
+        for o in history:
+            if o.op == "put" and o.status == 0:  # PutAck.STATUS_OK
+                if o.version > acked_versions.get(o.key, 0):
+                    acked_versions[o.key] = o.version
+        checks.append(
+            lambda: check_durability(
+                acked_versions,
+                fabric.durable_versions(),
+                fabric.recovery_fingerprints(),
+            )
+        )
     pure_gray, victims = _gray_plan_victims(plan)
     if pure_gray and victims is not None:
         evicted = [
@@ -160,11 +179,17 @@ def run_engine_probe(spec: dict) -> ProbeResult:
 # -- sim harness ---------------------------------------------------------- #
 
 def _is_serving_rule(rule_spec: dict) -> bool:
-    return rule_spec.get("msg_types") == ["Put"]
+    # Put-wire matches and storage-plane stalls both land on the serving
+    # mirror's nemesis; everything else is the device plane's problem
+    return (
+        rule_spec.get("msg_types") == ["Put"]
+        or rule_spec.get("type") == "DiskStallRule"
+    )
 
 
 def run_sim_probe(spec: dict) -> ProbeResult:
     from ..faults import (
+        RestartNodeRule,
         UnsupportedDeviceFault,
         _boundaries,
         _device_rules,
@@ -202,6 +227,15 @@ def run_sim_probe(spec: dict) -> ProbeResult:
     sim.enable_placement(**SIM_PLACEMENT)
     sim.enable_handoff(chunk_size=1024)
     sim.enable_serving(request_ms=1, fault_plan=serving_plan)
+    seated = endpoint_slots(sim)
+    restart_victims = sorted({
+        seated[r.match.dst] for r in device_plan.rules
+        if isinstance(r, RestartNodeRule) and r.match.dst in seated
+    })
+    if restart_victims:
+        # restart-bearing plans run the durability mirror so each victim's
+        # replay debt is billed on the virtual clock at recovery
+        sim.enable_durability(replay_record_ms=1)
 
     rnd = random.Random(int(plan_spec.get("seed", 0)) * 2_000_003 + 29)
     keys = [b"sk-%02d" % i for i in range(spec.get("keys", 8))]
@@ -260,6 +294,14 @@ def run_sim_probe(spec: dict) -> ProbeResult:
     # become history entries the linearizability checker judges
     sim.clear_link_faults()
     sim.run_until_decision(max_rounds=40, batch=8)
+    if restart_victims:
+        # the compiled down-windows have closed; now take each victim
+        # through an actual crash-and-replay so the recovery path (and its
+        # virtual-time bill) lands in the same probe history
+        info["replayed_records"] = sum(
+            sim.restart_slot(slot) for slot in restart_victims
+        )
+        sim.run_until_decision(max_rounds=8, batch=4)
     do_ops(max(1, ops // 4))
     for key in sorted(sim.serving_acked):
         invoke = sim.virtual_ms
@@ -276,6 +318,15 @@ def run_sim_probe(spec: dict) -> ProbeResult:
         lambda: check_linearizable_history(history),
         lambda: check_config_parity(stamped, sim.configuration_id()),
     ]
+    if restart_victims:
+        acked_versions = {
+            key: version for key, (version, _v) in sim.serving_acked.items()
+        }
+        checks.append(
+            lambda: check_durability(
+                acked_versions, _sim_durable_versions(sim)
+            )
+        )
     if not serving_specs:
         # with lossy Put replication a minority replica may legitimately
         # lag until the next reconcile; fingerprints must agree only when
@@ -321,6 +372,26 @@ def run_sim_probe(spec: dict) -> ProbeResult:
             "view_changes": len(sim.view_changes),
         },
     )
+
+
+def _sim_durable_versions(sim) -> dict:
+    """Per key, the highest version held in any live replica's store -- the
+    sim-probe ground truth for the durability invariant."""
+    from ..serving.kv import decode_kv
+
+    assign = sim.placement.assign
+    out: dict = {}
+    for p in range(assign.shape[0]):
+        for slot in assign[p]:
+            slot = int(slot)
+            if slot < 0 or not sim.alive[slot]:
+                continue
+            for key, (version, _value) in decode_kv(
+                sim.handoff_stores[slot].get(p)
+            ).items():
+                if version > out.get(key, 0):
+                    out[key] = version
+    return out
 
 
 def _sim_fingerprints(sim) -> List[Tuple[int, str, object]]:

@@ -235,6 +235,13 @@ class MembershipService:
                 metrics=self.metrics, tracer=self.tracer,
                 recorder=self.recorder,
             )
+            # durability plane: a durable store recovered before this node
+            # had telemetry -- attach the registries now so recovery's
+            # replay/truncation counters and the "durability_recovered"
+            # journal line land on this node's observability plane
+            bind = getattr(handoff_store, "bind_telemetry", None)
+            if bind is not None:
+                bind(self.metrics, self.recorder)
 
         # Serving plane: a replicated Get/Put KV store routed by the
         # placement map, persisting into the handoff plane's store so
@@ -432,6 +439,20 @@ class MembershipService:
                         self._handoff.store.fingerprint, handoff_partitions
                     )
                 )
+        # durability plane digest (all zero on an in-memory store): the
+        # restart-health numbers statusz renders next to the fingerprint
+        # cross-check
+        durability_segments = durability_snapshot_version = 0
+        durability_replayed = 0
+        if self._handoff is not None:
+            durability_stats = getattr(
+                self._handoff.store, "durability_stats", None
+            )
+            if durability_stats is not None:
+                stats = durability_stats()
+                durability_segments = int(stats["segments"])
+                durability_snapshot_version = int(stats["snapshot_version"])
+                durability_replayed = int(stats["replayed_records"])
         serving_gets = serving_puts = serving_put_acks = 0
         serving_partitions: Tuple[int, ...] = ()
         serving_leaders: Tuple[str, ...] = ()
@@ -517,6 +538,9 @@ class MembershipService:
             fd_tier_threshold=fd_tier_threshold,
             fd_tier_flush_ms=fd_tier_flush_ms,
             history=history,
+            durability_segments=durability_segments,
+            durability_snapshot_version=durability_snapshot_version,
+            durability_replayed=durability_replayed,
         )
 
     # ------------------------------------------------------------------ #
@@ -975,6 +999,12 @@ class MembershipService:
             "view_install", configuration_id=configuration_id,
             size=self._view.membership_size,
         )
+        # restart-aware rejoin seam: persist the installed configuration id
+        # so a returning node knows which configuration it last belonged to
+        if self._handoff is not None:
+            persist_config = getattr(self._handoff.store, "set_config_id", None)
+            if persist_config is not None:
+                persist_config(configuration_id)
         self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, status_changes)
         self._update_placement(configuration_id)
         self._stable_view.view_installed()

@@ -84,6 +84,26 @@ SETTINGS_CATALOG = {
         "doc": "overhead guard: instrumented warmed decision loop must stay "
                "within this percentage of the raw one",
     },
+    "durability.enabled": {
+        "min": 0, "max": 1,
+        "doc": "kill switch: False keeps the in-memory store and the exact "
+               "pre-durability decision loop",
+    },
+    "durability.fsync_policy": {
+        "min": 0, "max": 2,
+        "doc": "0 = never fsync (page cache only), 1 = fsync on explicit "
+               "sync/checkpoint barriers, 2 = fsync every append",
+    },
+    "durability.segment_bytes": {
+        "min": 4096, "max": 1073741824,
+        "doc": "WAL segment rotation threshold; retention deletes whole "
+               "segments below the last snapshot marker",
+    },
+    "durability.snapshot_every_records": {
+        "min": 0, "max": 1048576,
+        "doc": "auto-checkpoint after this many log records since the last "
+               "snapshot (0 disables auto-checkpointing)",
+    },
 }
 
 
@@ -161,6 +181,35 @@ class ProfilingSettings:
             )
 
 
+@dataclass(frozen=True)
+class DurabilitySettings:
+    """Knobs for the durability plane (durability/). Defaults are
+    conservative: durability is off (``enabled=False`` keeps the in-memory
+    store and the exact pre-durability decision loop) and, when on, fsync
+    batching amortizes the stable-storage write path the way real Paxos
+    deployments do. Bounds live in SETTINGS_CATALOG (linted by
+    tools/check.py); the fsync policy is int-coded (0=never, 1=batch,
+    2=always) so the catalog can bound it."""
+
+    enabled: bool = False
+    fsync_policy: int = 1
+    segment_bytes: int = 1048576
+    snapshot_every_records: int = 4096
+
+    def __post_init__(self) -> None:
+        for key, value in (
+            ("enabled", int(self.enabled)),
+            ("fsync_policy", self.fsync_policy),
+            ("segment_bytes", self.segment_bytes),
+            ("snapshot_every_records", self.snapshot_every_records),
+        ):
+            bounds = SETTINGS_CATALOG[f"durability.{key}"]
+            assert bounds["min"] <= value <= bounds["max"], (
+                f"durability.{key}={value!r} outside "
+                f"[{bounds['min']}, {bounds['max']}]"
+            )
+
+
 @dataclass
 class Settings:
     # Transport timeouts/retries (GrpcClient.java:55-59)
@@ -221,6 +270,12 @@ class Settings:
     # by default; the enabled flag is the kill switch back to the raw,
     # uninstrumented dispatch loop.
     profiling: ProfilingSettings = field(default_factory=ProfilingSettings)
+
+    # Durability plane (durability/): per-node write-ahead log + snapshot
+    # crash recovery mounted under the handoff PartitionStore seam. Off by
+    # default; the enabled flag is the kill switch back to the in-memory
+    # store and the untouched decision loop.
+    durability: DurabilitySettings = field(default_factory=DurabilitySettings)
 
     def __post_init__(self) -> None:
         assert self.fd_policy in ("cumulative", "windowed"), (

@@ -259,6 +259,11 @@ class Simulator:  # guarded-by: sim-loop
         self._serving_cache: dict = {}  # (slot, partition) -> decoded KV map
         self._serving_acked: dict = {}  # key -> (version, value) at ack time
         self._serving_eps: dict = {}
+        # durability plane (opt-in via enable_durability; requires serving):
+        # per-slot WAL-record counts so restart replay bills virtual time
+        self._durability_enabled = False
+        self._durability_replay_ms = 1
+        self._durable_pending: dict = {}  # slot -> records since checkpoint
         # membership-invariant element hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
@@ -844,6 +849,57 @@ class Simulator:  # guarded-by: sim-loop
         from ..serving.kv import encode_kv
 
         self._handoff_stores[slot].put(p, encode_kv(kv))
+        if self._durability_enabled:
+            # one persisted blob == one WAL append on the live plane; the
+            # count is what a post-crash replay has to re-apply
+            self._durable_pending[slot] = self._durable_pending.get(slot, 0) + 1
+
+    # -- durability mirror -------------------------------------------------- #
+
+    def enable_durability(self, replay_record_ms: int = 1) -> None:
+        """Attach the durability mirror: every serving persist counts as one
+        WAL append, and :meth:`restart_slot` bills the log-over-snapshot
+        replay on the virtual clock (``replay_record_ms`` per un-checkpointed
+        record) -- the sim analogue of ``DurablePartitionStore`` recovery."""
+        if not self._serving_enabled:
+            raise RuntimeError("enable_serving must run before enable_durability")
+        self._durability_replay_ms = int(replay_record_ms)
+        self._durable_pending = {}
+        self._durability_enabled = True
+
+    def checkpoint_slot(self, slot: int) -> None:
+        """Snapshot the slot's store: replay debt drops to zero, exactly as
+        ``DurablePartitionStore.checkpoint`` truncates the log."""
+        if not self._durability_enabled:
+            raise RuntimeError("durability is not enabled on this simulator")
+        self._durable_pending[slot] = 0
+        self.metrics.incr("durability.snapshots")
+        self.recorder.record("durability_checkpoint", node=f"slot{int(slot)}")
+
+    def durable_pending(self, slot: int) -> int:
+        """Records a restart of ``slot`` would replay (un-checkpointed)."""
+        return self._durable_pending.get(int(slot), 0)
+
+    def restart_slot(self, slot: int, down_ms: int = 0) -> int:
+        """Crash-and-recover ``slot`` with its store intact: the node is dead
+        for ``down_ms`` of virtual time, then replays its WAL debt at
+        ``replay_record_ms`` per record before answering again. Returns the
+        replayed-record count. The identity is retained -- a restart is not
+        a leave, so no identifier churn and no view change is implied (the
+        FD may still evict if ``down_ms`` outlasts detection)."""
+        if not self._durability_enabled:
+            raise RuntimeError("durability is not enabled on this simulator")
+        slot = int(slot)
+        self.crash(np.asarray([slot]))
+        replayed = self._durable_pending.get(slot, 0)
+        self.virtual_ms += int(down_ms) + replayed * self._durability_replay_ms
+        if replayed:
+            self.metrics.incr("durability.replayed_records", replayed)
+        self.recorder.record(
+            "durability_recovered", node=f"slot{slot}", replayed=replayed,
+        )
+        self.revive(np.asarray([slot]))
+        return replayed
 
     def _serving_reconcile(self, old_assign) -> None:
         """Anti-entropy at the view-change boundary, BEFORE handoff runs:
@@ -919,7 +975,9 @@ class Simulator:  # guarded-by: sim-loop
                         self._serving_ep(slot), self._serving_ep(leader),
                         msg, "egress",
                     )
-                    self.virtual_ms += decision.delay_ms
+                    # slow_ms covers disk_stall rules: the replica answers,
+                    # but only after the stalled fsync returns
+                    self.virtual_ms += decision.delay_ms + decision.slow_ms
                     if decision.drop:
                         continue
                 skv = kv if slot == leader else self._serving_kv(slot, p)

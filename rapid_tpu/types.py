@@ -386,6 +386,13 @@ class ClusterStatusResponse:
     # sorted-key JSON lines (MetricsHistory.to_wire), the carriage a
     # scraper folds into a cluster-wide timeseries (profiling/scrape.py)
     history: Tuple[str, ...] = ()
+    # durability plane (0/absent when durability is not enabled): live WAL
+    # segment count, last snapshot version, and how many log records the
+    # most recent recovery replayed -- the restart-health digest statusz
+    # renders next to the handoff fingerprint cross-check
+    durability_segments: int = 0
+    durability_snapshot_version: int = 0
+    durability_replayed: int = 0
 
 
 @dataclass(frozen=True)

@@ -38,6 +38,10 @@ The gray-failure quartet (ISSUE 6) rides the same registry:
 - rolling-upgrade: a mixed wire-version cluster (half the nodes encode with
   reserved ``__``-prefixed extension keys / thinned optional fields)
   converging through a join + removal wave under probe loss.
+
+The durability plane (PR 16) adds rolling-restart: every node in sequence
+crashes abruptly and rejoins with its WAL directory under serving load --
+old identities retained, zero lost acked writes, zero spurious evictions.
 """
 
 import json
@@ -729,6 +733,106 @@ def scenario_serving_sawtooth(seed=31, n=16, wave=4, waves=3, ops=80):
     }
 
 
+def scenario_rolling_restart(seed=37, n=4, ops_per_wave=12):
+    """Rolling restart under serving load (PR 16's durability oracle): every
+    node, in sequence, crashes abruptly (WAL torn mid-flight, no clean
+    shutdown) and rejoins with the SAME durability directory before the
+    failure detector concludes -- the persisted NodeId drives the
+    HOSTNAME_ALREADY_IN_RING rejoin fast path, recovery replays
+    log-over-snapshot, and the verified handoff pull catches the replica
+    up. The oracle: every node keeps its original identity across its
+    restart, ZERO acked writes are lost over the whole wave, and no rejoin
+    leaves anyone else evicted (a restart is not a membership event)."""
+    import os
+    import shutil
+    import tempfile
+
+    from rapid_tpu.settings import DurabilitySettings, Settings
+    sys.path.insert(0, "tests")
+    from harness import ClusterHarness
+
+    t0 = time.perf_counter()
+    root = tempfile.mkdtemp(prefix="rapid-rolling-restart-")
+    settings = Settings(
+        durability=DurabilitySettings(enabled=True, fsync_policy=0)
+    )
+    h = ClusterHarness(seed=seed, settings=settings)
+    placement = {"partitions": 16, "replicas": 3, "seed": 7}
+    dirs = {i: os.path.join(root, f"node{i}") for i in range(n)}
+    try:
+        h.start_seed(0, placement=placement, serving=True,
+                     durability=dirs[0])
+        for i in range(1, n):
+            h.join(i, placement=placement, serving=True, durability=dirs[i])
+        h.wait_and_verify_agreement(n)
+        identities = {
+            i: h.instances[h.addr(i)].get_partition_store().node_id
+            for i in range(n)
+        }
+        all_addrs = {h.addr(i) for i in range(n)}
+        acked: dict = {}
+        write_seq = 0
+
+        def drive(client, count: int) -> None:
+            nonlocal write_seq
+            for _ in range(count):
+                key = b"roll-%02d" % (write_seq % 24)
+                value = b"w-%d" % write_seq
+                write_seq += 1
+                p = client.serving_put(key, value)
+                ok = h.scheduler.run_until(p.done, timeout_ms=60_000)
+                if ok and p.peek().status == 0:
+                    acked[key] = value
+
+        identity_ok = True
+        replayed_total = 0
+        spurious = 0
+        drive(h.instances[h.addr(0)], ops_per_wave)
+        for i in range(n):
+            survivor = h.addr((i + 1) % n)
+            victim = h.instances[h.addr(i)]
+            victim.get_partition_store().crash()  # power loss, not clean stop
+            h.fail_nodes([h.addr(i)])
+            h.blacklist.discard(h.addr(i))  # back before the FD concludes
+            revived = h.join(i, seed_index=(i + 1) % n, placement=placement,
+                             serving=True, durability=dirs[i])
+            h.wait_and_verify_agreement(n)
+            store = revived.get_partition_store()
+            identity_ok &= store.node_id == identities[i]
+            replayed_total += store.durability_stats()["replayed_records"]
+            if set(h.instances[survivor].get_memberlist()) != all_addrs:
+                spurious += 1
+            drive(h.instances[survivor], ops_per_wave)
+        # every acked write must read back through a survivor (newer
+        # versions are fine -- later writes win; NOT_FOUND is a loss)
+        lost = 0
+        reader = h.instances[h.addr(0)]
+        for key in sorted(acked):
+            p = reader.serving_get(key)
+            h.scheduler.run_until(p.done, timeout_ms=60_000)
+            ack = p.peek()
+            if ack.status != 0 or ack.version == 0:
+                lost += 1
+        virtual_ms = h.scheduler.now_ms()
+        h.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "config": (
+            f"rolling restart: {n} nodes each crash + rejoin with their "
+            f"WAL dir under serving load (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": virtual_ms,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "cut_ok": bool(identity_ok and lost == 0 and spurious == 0),
+        "identities_retained": bool(identity_ok),
+        "lost_acked_writes": lost,
+        "spurious_view_changes": spurious,
+        "replayed_records": int(replayed_total),
+    }
+
+
 def scenario_pinned_plan(path, seed=None):
     """Replay one pinned nemesis-search corpus file (a probe spec JSON
     written by ``tools/hunt.py --pin``): build the FaultPlan back through
@@ -784,6 +888,7 @@ register("gray-flapping", scenario_gray_flapping, seed=17)
 register("clock-skew", scenario_clock_skew, seed=13)
 register("rolling-upgrade", scenario_rolling_upgrade, seed=21)
 register("serving-sawtooth", scenario_serving_sawtooth, seed=31)
+register("rolling-restart", scenario_rolling_restart, seed=37)
 # 10x the north-star scale (VERDICT r4 item 3): every failure class the
 # paper holds stable, at 1M, with cut parity AND the from-scratch
 # configuration-id cross-check
@@ -798,7 +903,7 @@ BATTERY = [
     "cross-plane-10", "crash-1k", "crash-10k", "one-way-loss-50k",
     "flip-flop-join-100k", "nemesis-smoke", "wan-zone-loss",
     "gray-slow-node", "gray-flapping", "clock-skew", "rolling-upgrade",
-    "serving-sawtooth",
+    "serving-sawtooth", "rolling-restart",
 ]
 SCALE_1M = ["crash-1m", "one-way-loss-1m", "flip-flop-join-1m"]
 

@@ -67,7 +67,8 @@ class ClusterHarness:
                  subscriptions=None,
                  placement: Optional[Dict[str, int]] = None,
                  handoff=None,
-                 serving: bool = False) -> ClusterBuilder:
+                 serving: bool = False,
+                 durability: Optional[str] = None) -> ClusterBuilder:
         server = InProcessServer(addr, self.network)
         self.servers[addr] = server
         client = InProcessClient(addr, self.network, self.settings)
@@ -104,6 +105,10 @@ class ClusterHarness:
             builder.use_handoff(store)
         if serving:
             builder.use_serving()
+        if durability is not None:
+            # per-node WAL directory; effective only when the harness's
+            # Settings enable the durability plane (the kill switch)
+            builder.use_durability(durability)
         for event, cb in subscriptions or []:
             builder.add_subscription(event, cb)
         return builder

@@ -466,7 +466,8 @@ def test_cluster_shutdown_runs_teardown_exactly_once_under_races():
             shutdown=lambda: calls.__setitem__("server", calls["server"] + 1)
         ),
         _membership_service=SimpleNamespace(
-            shutdown=lambda: calls.__setitem__("service", calls["service"] + 1)
+            shutdown=lambda: calls.__setitem__("service", calls["service"] + 1),
+            handoff_engine=lambda: None,
         ),
         _resources=SimpleNamespace(
             shutdown=lambda: calls.__setitem__(
@@ -640,3 +641,92 @@ def test_rtt_variance_seeds_from_first_k_samples_not_a_point_estimate():
     assert fd.rtt_var_ms() == pytest.approx(
         0.75 * 112.5 + 0.25 * abs(100 - srtt_before)
     )
+
+
+# ---------------------------------------------------------------------------
+# PR 16: durability-plane findings, each test fails on the pre-fix code
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_shutdown_checkpoints_the_wal_before_resources_die():
+    """durability: a clean shutdown left the WAL tail unflushed (and, under
+    FSYNC_NEVER, possibly only in the page cache) with no snapshot marker,
+    so every restart after a GRACEFUL stop paid a full log replay. shutdown()
+    must run the store's checkpoint() -- flush + snapshot + marker -- after
+    the membership service stops mutating the store but before the shared
+    resources are torn down. The in-memory store (no checkpoint()) must be
+    left untouched by the same duck-typed seam."""
+    order = []
+
+    class _CheckpointingStore:
+        def checkpoint(self):
+            order.append("checkpoint")
+
+    def _fake(store):
+        engine = SimpleNamespace(store=store)
+        return SimpleNamespace(
+            _shutdown_lock=make_lock("test.Cluster._shutdown_lock16"),
+            _has_shutdown=False,
+            _server=SimpleNamespace(shutdown=lambda: order.append("server")),
+            _membership_service=SimpleNamespace(
+                shutdown=lambda: order.append("service"),
+                handoff_engine=lambda: engine,
+            ),
+            _resources=SimpleNamespace(
+                shutdown=lambda: order.append("resources")
+            ),
+        )
+
+    Cluster.shutdown(_fake(_CheckpointingStore()))
+    assert order == ["server", "service", "checkpoint", "resources"]
+
+    order.clear()
+    Cluster.shutdown(_fake(object()))  # in-memory store: no checkpoint()
+    assert order == ["server", "service", "resources"]
+
+
+def test_handoff_release_syncs_the_wal_before_discarding_the_partition():
+    """durability: handle_ack released the source copy the moment the
+    recipient verified, but with a durable store the put that the ack
+    authorizes discarding may still sit in an unfsynced WAL tail on the
+    recipient -- and the SOURCE's own unsynced records could vanish with
+    the deleted partition. The release path must call store.sync() before
+    store.delete(), and must not touch either when the member is still a
+    replica. The in-memory store (no sync()) rides the same duck-typed
+    seam untouched."""
+    from rapid_tpu.handoff.engine import HandoffEngine
+    from rapid_tpu.types import HandoffAck
+
+    order = []
+
+    class _DurableStore:
+        def get(self, partition):
+            return b"payload"
+
+        def sync(self):
+            order.append("sync")
+
+        def delete(self, partition):
+            order.append(("delete", partition))
+
+    engine = HandoffEngine(
+        _DurableStore(), ME, client=None, scheduler=None,
+    )
+    ack = HandoffAck(sender=PEER, session_id=7, partition=3, fingerprint=0)
+    engine.handle_ack(ack, still_replica=False)
+    assert order == ["sync", ("delete", 3)]  # durable BEFORE discarded
+
+    order.clear()
+    engine.handle_ack(ack, still_replica=True)
+    assert order == []  # still a replica: nothing flushed, nothing dropped
+
+    class _MemoryStore:
+        def get(self, partition):
+            return b"payload"
+
+        def delete(self, partition):
+            order.append(("delete", partition))
+
+    engine = HandoffEngine(_MemoryStore(), ME, client=None, scheduler=None)
+    engine.handle_ack(ack, still_replica=False)
+    assert order == [("delete", 3)]  # no sync() seam: plain release

@@ -408,6 +408,60 @@ def test_gray_detection_dimension_json_contract(monkeypatch, capsys):
     assert parsed["gray_detection_ms"] == entry
 
 
+def test_recovery_dimension_json_contract(monkeypatch, capsys):
+    """The recovery_time_ms entry of the one JSON line carries, for every
+    (log length, snapshot cadence) grid point, the exact replayed-record
+    count and the cold-start replay wall time -- the harness plots the
+    log-over-snapshot recovery curve straight from the artifact. Run at a
+    reduced scale so the contract check stays cheap."""
+    monkeypatch.setattr(bench, "RECOVERY_LOG_RECORDS", (32, 96))
+    monkeypatch.setattr(bench, "RECOVERY_SNAPSHOT_EVERY", (0, 32))
+    monkeypatch.setattr(bench, "RECOVERY_VALUE_BYTES", 64)
+    entry = bench.run_recovery_dimension(seed=3)
+    assert entry["partitions"] == bench.RECOVERY_PARTITIONS
+    by_grid = {
+        (p["log_records"], p["snapshot_every"]): p for p in entry["points"]
+    }
+    assert set(by_grid) == {(32, 0), (96, 0), (32, 32), (96, 32)}
+    for (records, every), point in by_grid.items():
+        # replay is exact and deterministic: records since the last
+        # auto-checkpoint (the dimension itself asserts content parity)
+        assert point["replayed_records"] == (records % every if every else records)
+        assert point["segments"] >= 1
+        assert point["recovery_ms"] >= 0
+    assert by_grid[(96, 0)]["replayed_records"] == 96   # full-log replay
+    assert by_grid[(32, 32)]["replayed_records"] == 0   # snapshot absorbed it
+    # and the emitter folds the entry into the artifact line verbatim
+    bench._emit_json({"value": 120.0, "virtual_ms": 11_100}, "cpu", [])
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert parsed["recovery_time_ms"] == entry
+
+
+def test_durability_kill_switch_default_off_keeps_memory_path(tmp_path):
+    """DurabilitySettings defaults enabled=False (the kill switch): a
+    builder handed a durability directory must not mount the WAL store or
+    write a single byte under it -- the node runs the exact pre-durability
+    in-memory path, so the switch carries zero overhead when off. Flipping
+    it on mounts (and recovers) the store in the same directory."""
+    from rapid_tpu.cluster import ClusterBuilder
+    from rapid_tpu.settings import DurabilitySettings, Settings
+    from rapid_tpu.types import Endpoint
+
+    directory = tmp_path / "wal"
+    directory.mkdir()
+    builder = ClusterBuilder(Endpoint.from_parts("127.0.0.1", 1234))
+    builder.use_durability(str(directory))
+    assert builder._durable_store() is None       # switch off: no plane
+    assert list(directory.iterdir()) == []        # and no WAL side effects
+
+    builder.use_settings(Settings(durability=DurabilitySettings(enabled=True)))
+    store = builder._durable_store()
+    assert store is not None
+    assert any(directory.iterdir())               # recovery mounted the WAL
+    assert builder._handoff_store is store        # downstream planes ride it
+    store.close()
+
+
 def test_messaging_reactor_coalesces_vs_threaded_baseline(monkeypatch):
     """The A/B the refactor exists for, guarded at reduced scale: the
     threaded baseline pays exactly one write syscall per message by

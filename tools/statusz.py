@@ -85,6 +85,14 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
             f" leads={sum(1 for lead in status.serving_leaders if lead == str(status.sender))}"
             f"/{len(status.serving_partitions)}"
         )
+    # durability digest: restart health -- how much log a crash would
+    # replay (zero right after a checkpoint) and which snapshot anchors it
+    if status.durability_segments or status.durability_snapshot_version:
+        lines.append(
+            f"  durability: segments={status.durability_segments}"
+            f" snapshot={status.durability_snapshot_version}"
+            f" replayed={status.durability_replayed}"
+        )
     # failure-detector digest: the node's worst monitored edges (already
     # sorted suspicion desc, RTT desc by the service), the gray-failure
     # signature an operator checks before any eviction shows up
@@ -166,6 +174,9 @@ def to_json(status: ClusterStatusResponse) -> dict:
                 status.handoff_partitions, status.handoff_fingerprints
             )
         },
+        "durability_segments": status.durability_segments,
+        "durability_snapshot_version": status.durability_snapshot_version,
+        "durability_replayed": status.durability_replayed,
         "serving_gets": status.serving_gets,
         "serving_puts": status.serving_puts,
         "serving_put_acks": status.serving_put_acks,
