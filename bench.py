@@ -87,9 +87,13 @@ _PROGRESS: dict = {
 # the headline, so every JSON data point carries its own compile story.
 _LAST_JIT_STATS: dict = {}
 
-# Serving dimension: closed-loop Get/Put load against the serving-plane
+# Serving dimension: open-loop Get/Put load against the serving-plane
 # mirror (replicated KV over placement + handoff), measured through a view
-# change. Three windows -- steady state, the churn window between the crash
+# change. Arrivals are scheduled by rate (slo/sli.py OpenLoopGenerator:
+# seeded expovariate inter-arrivals, zipfian keys, a simulated client
+# population) independently of completions, so measured latency includes
+# queueing delay -- the coordinated-omission fix over the old closed-loop
+# driver. Three windows -- steady state, the churn window between the crash
 # and the decided view (dead leaders cost redirect hops + quorum reads),
 # and post-view -- each reporting throughput + p50/p99 + the full latency
 # histogram on virtual time, so the numbers are deterministic per seed.
@@ -98,6 +102,12 @@ SERVING_PARTITIONS = 256
 SERVING_KEYS = 64
 SERVING_OPS = {"steady": 300, "view_change_window": 150, "post_view": 150}
 SERVING_PUT_FRACTION = 0.2
+SERVING_RATE_PER_S = 600.0     # ~0.6x capacity steady, >1x during redirects
+SERVING_ZIPF_S = 1.1
+SERVING_CLIENTS = 1_000_000
+# burn windows compressed onto bench-scale virtual time: fast pair
+# 5m/1h -> 300ms/3.6s, so a churn window of queueing shows up in-run
+SERVING_SLO_WINDOW_SCALE = 0.001
 
 # WAN dimension: stable-view latency vs inter-region round-trip time. Two
 # regions, 2k nodes, a 1% crash in the mix; the topology compiles to
@@ -662,51 +672,61 @@ def _latency_window(latencies: list) -> dict:
 
 
 def run_serving_dimension(seed: int) -> dict:
-    """The serving curve: a closed-loop client (next op issued only after
-    the previous acks) drives Get/Put traffic against the simulator's
-    serving plane across three windows -- steady state, the churn window
-    between a crash and the decided view, and post-view. Latency is the
-    virtual-ms span of each logical op including client retries, so the
-    entire dimension is deterministic per seed. Zero-lost-acked-writes is
-    asserted after the view change: every write the oracle recorded as
-    acknowledged must read back at >= its acked version."""
+    """The serving curve: an open-loop arrival stream (rate-scheduled,
+    zipfian keys, completions never gate arrivals) drives Get/Put traffic
+    against the simulator's serving plane across three windows -- steady
+    state, the churn window between a crash and the decided view, and
+    post-view. Latency is the virtual-ms span from *scheduled arrival* to
+    completion, so queueing delay during churn is measured instead of
+    silently omitted, and the entire dimension is deterministic per seed.
+    The SLO plane rides the same stream; its summary (availability, p99,
+    goodput, burn-rate peaks) folds into the JSON entry.
+    Zero-lost-acked-writes is asserted after the view change: every write
+    the oracle recorded as acknowledged must read back at >= its acked
+    version."""
+    from rapid_tpu.settings import SLOSettings
     from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.slo import OpenLoopGenerator
 
     rng = np.random.default_rng(seed)
     sim = Simulator(SERVING_N_NODES, seed=seed)
     sim.enable_placement(partitions=SERVING_PARTITIONS)
     sim.enable_handoff()
     sim.enable_serving()
+    plane = sim.enable_slo(SLOSettings(
+        enabled=True, window_scale=SERVING_SLO_WINDOW_SCALE,
+    ))
     keys = [b"bench-key-%04d" % i for i in range(SERVING_KEYS)]
     for i, key in enumerate(keys):  # preload, unmeasured
         ack = sim.serving_put(key, b"seed-%d" % i)
         assert ack.status == ack.STATUS_OK, "preload write failed to ack"
+    gen = OpenLoopGenerator(
+        SERVING_RATE_PER_S, keys, put_fraction=SERVING_PUT_FRACTION,
+        seed=seed, zipf_s=SERVING_ZIPF_S, clients=SERVING_CLIENTS,
+    )
 
-    def drive(n_ops: int) -> list:
-        latencies = []
-        for _ in range(n_ops):
-            key = keys[int(rng.integers(len(keys)))]
-            is_put = rng.random() < SERVING_PUT_FRACTION
-            t0 = sim.virtual_ms
-            for _attempt in range(8):  # closed loop: retry until acked
-                if is_put:
-                    ack = sim.serving_put(key, b"v-%d" % sim.virtual_ms)
-                else:
-                    ack = sim.serving_get(key)
-                if ack.status != ack.STATUS_RETRY:
-                    break
-            latencies.append(float(sim.virtual_ms - t0))
-        return latencies
+    def drive(n_ops: int) -> "tuple[list, float]":
+        # rebase forward so time the *harness* spent (preload, the decision
+        # loop) is not billed to the clients as queueing delay
+        gen.rebase(sim.virtual_ms)
+        t0 = sim.virtual_ms
+        results = sim.serving_drive_open_loop(gen.arrivals(n_ops))
+        elapsed = float(max(sim.virtual_ms - t0, 1))
+        return [lat for _a, _s, lat in results], elapsed
 
-    windows = {}
-    windows["steady"] = drive(SERVING_OPS["steady"])
+    windows, elapsed_ms = {}, {}
+    windows["steady"], elapsed_ms["steady"] = drive(SERVING_OPS["steady"])
     victim = int(rng.integers(1, SERVING_N_NODES))
     sim.crash(np.array([victim]))
-    windows["view_change_window"] = drive(SERVING_OPS["view_change_window"])
+    windows["view_change_window"], elapsed_ms["view_change_window"] = drive(
+        SERVING_OPS["view_change_window"]
+    )
     record = sim.run_until_decision(max_rounds=64, batch=16)
     assert record is not None, "serving dimension: no view decision"
     assert set(record.cut) == {victim}, "serving dimension: cut parity"
-    windows["post_view"] = drive(SERVING_OPS["post_view"])
+    windows["post_view"], elapsed_ms["post_view"] = drive(
+        SERVING_OPS["post_view"]
+    )
 
     lost = 0
     for key, (version, value) in sim.serving_acked.items():
@@ -719,22 +739,23 @@ def run_serving_dimension(seed: int) -> dict:
         "n": SERVING_N_NODES,
         "partitions": SERVING_PARTITIONS,
         "put_fraction": SERVING_PUT_FRACTION,
+        "offered_rate_per_s": SERVING_RATE_PER_S,
         "lost_acked_writes": 0,
         "virtual_ms": sim.virtual_ms,
     }
     total_ops, total_ms = 0, 0.0
     for name, latencies in windows.items():
         stats = _latency_window(latencies)
-        stats["qps"] = (
-            round(1000.0 * len(latencies) / sum(latencies), 1)
-            if sum(latencies) else None
+        stats["qps"] = round(
+            1000.0 * len(latencies) / elapsed_ms[name], 1
         )
         entry[name] = stats
         total_ops += len(latencies)
-        total_ms += sum(latencies)
+        total_ms += elapsed_ms[name]
     entry["throughput_qps"] = (
         round(1000.0 * total_ops / total_ms, 1) if total_ms else None
     )
+    entry["slo"] = plane.summary(sim.virtual_ms)
     _PROGRESS["serving"] = entry
     return entry
 

@@ -380,6 +380,10 @@ def to_wire_response(msg) :
         s.durabilitySegments = msg.durability_segments
         s.durabilitySnapshotVersion = msg.durability_snapshot_version
         s.durabilityReplayed = msg.durability_replayed
+        s.sloNames.extend(msg.slo_names)
+        s.sloBurnMilli.extend(msg.slo_burn_milli)
+        s.sloFiring.extend(msg.slo_firing)
+        s.sloAttributedTrace.extend(msg.slo_attributed_trace)
     elif isinstance(msg, T.PutAck):
         a = resp.putAck
         a.sender.CopyFrom(_ep(msg.sender))
@@ -464,6 +468,10 @@ def from_wire_response(resp):
             durability_segments=int(m.durabilitySegments),
             durability_snapshot_version=int(m.durabilitySnapshotVersion),
             durability_replayed=int(m.durabilityReplayed),
+            slo_names=tuple(str(s) for s in m.sloNames),
+            slo_burn_milli=tuple(int(v) for v in m.sloBurnMilli),
+            slo_firing=tuple(int(v) for v in m.sloFiring),
+            slo_attributed_trace=tuple(int(v) for v in m.sloAttributedTrace),
         )
     if which == "putAck":
         m = resp.putAck

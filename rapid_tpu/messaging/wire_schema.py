@@ -162,6 +162,14 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("durabilitySegments", "int64", 34, False),
         ("durabilitySnapshotVersion", "int64", 35, False),
         ("durabilityReplayed", "int64", 36, False),
+        # SLO plane exposure: per-alert ("slo:window" name, short-window
+        # burn rate in thousandths, firing flag, attributed churn trace
+        # id) as parallel arrays (integer milli units: no proto3 floats
+        # in this schema)
+        ("sloNames", "string", 37, True),
+        ("sloBurnMilli", "int64", 38, True),
+        ("sloFiring", "int64", 39, True),
+        ("sloAttributedTrace", "int64", 40, True),
     ],
     "HandoffRequest": [
         ("sender", "M:Endpoint", 1, False),

@@ -167,6 +167,14 @@ METRIC_CATALOG = frozenset({
     "durability.segments",          # live WAL segment count (gauge)
     "durability.replayed_records",  # log records replayed by last recovery
     "durability.torn_truncations",  # torn tails truncated at a bad record
+    # SLO plane (slo/)
+    "slo.requests",        # requests scored by the SLI tracker
+    "slo.offered",         # open-loop arrivals offered to the serving path
+    "slo.availability",    # windowed good/total ratio x1000 (gauge per SLO)
+    "slo.burn_rate",       # short-window burn rate (gauge per SLO+window)
+    "slo.firing",          # burn alerts currently firing (gauge)
+    "slo.alerts_fired",    # burn-alert fire transitions
+    "slo.alerts_cleared",  # burn-alert clear transitions (recovery)
 })
 
 # Dynamic name families: an f-string call site is legal iff its literal head
@@ -213,6 +221,8 @@ EVENT_CATALOG = frozenset({
     "serving_sync",      # churned partition re-synced from replica snapshots
     "durability_recovered",   # store reopened: snapshot loaded + log replayed
     "durability_checkpoint",  # snapshot + marker written, old segments culled
+    "slo_alert_fired",   # multi-window burn-rate alert started firing
+    "slo_alert_cleared",  # burn rates fell back under the clear threshold
 })
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).
@@ -621,6 +631,11 @@ class MetricsHistory:
         self._lock = make_lock("MetricsHistory._lock")
         self._snaps: List[Dict[str, object]] = []
         self._last_ts: Optional[float] = None
+        # per-instance monotonic snapshot stamp: strictly increasing within
+        # one ring's lifetime, restarting at 1 when a restarted node builds
+        # a fresh ring -- the reset signal profiling/scrape.py splits
+        # series on (a restarted node's clock may replay earlier ts_s)
+        self._seq = itertools.count(1)
 
     def __len__(self) -> int:
         with self._lock:
@@ -658,6 +673,7 @@ class MetricsHistory:
                     prev[1] += value.sum
         snap: Dict[str, object] = {
             "ts_s": now,
+            "seq": next(self._seq),
             "counters": counters,
             "gauges": gauges,
             "histograms": hists,

@@ -21,7 +21,7 @@ Three pieces ride the existing telemetry substrate (observability.py):
 
 from ..observability import MetricsHistory
 from .phases import DEVICE_PHASES, PHASES, PhaseProfiler
-from .scrape import cluster_timeseries, merge_by_series
+from .scrape import cluster_timeseries, merge_by_series, node_segments
 
 __all__ = [
     "DEVICE_PHASES",
@@ -30,4 +30,5 @@ __all__ = [
     "MetricsHistory",
     "cluster_timeseries",
     "merge_by_series",
+    "node_segments",
 ]

@@ -17,16 +17,40 @@ from ..observability import MetricsHistory
 ClusterSeries = Dict[str, Dict[str, List[Tuple[float, float]]]]
 
 
-def node_series(history_lines: Iterable[str]) -> Dict[str, List[Tuple[float, float]]]:
-    """One node's scraped history lines -> series name -> sorted points.
-    Counters and gauges map to their values; each histogram contributes
-    ``<name>.count`` and ``<name>.sum`` series."""
+def node_segments(
+    history_lines: Iterable[str],
+) -> List[Dict[str, List[Tuple[float, float]]]]:
+    """One node's scraped history lines -> one series map per process
+    incarnation. A restart hands the node a fresh ring whose ``seq`` stamp
+    restarts at 1 (and, under virtual time, whose clock may restart too);
+    a seq -- or, for seq-less old lines, timestamp -- regression therefore
+    marks a segment boundary. Points are sorted within a segment only:
+    sorting across segments would interleave the incarnations into one
+    zig-zag series."""
+    segments: List[Dict[str, List[Tuple[float, float]]]] = []
     series: Dict[str, List[Tuple[float, float]]] = {}
+    prev_seq: float = float("-inf")
+    prev_ts: float = float("-inf")
     for snap in MetricsHistory.from_wire(tuple(history_lines)):
         try:
             ts = float(snap.get("ts_s", 0.0))
         except (TypeError, ValueError):
             continue
+        raw_seq = snap.get("seq")
+        try:
+            seq = float(raw_seq) if raw_seq is not None else None
+        except (TypeError, ValueError):
+            seq = None
+        reset = (seq is not None and seq <= prev_seq) or (
+            seq is None and ts < prev_ts
+        )
+        if reset and series:
+            segments.append(
+                {name: sorted(points) for name, points in series.items()}
+            )
+            series = {}
+        prev_seq = seq if seq is not None else float("-inf")
+        prev_ts = ts
         for table in ("counters", "gauges"):
             rows = snap.get(table)
             if not isinstance(rows, dict):
@@ -49,7 +73,23 @@ def node_series(history_lines: Iterable[str]) -> Dict[str, List[Tuple[float, flo
                     )
                 except (TypeError, ValueError):
                     continue
-    return {name: sorted(points) for name, points in series.items()}
+    if series:
+        segments.append(
+            {name: sorted(points) for name, points in series.items()}
+        )
+    return segments
+
+
+def node_series(history_lines: Iterable[str]) -> Dict[str, List[Tuple[float, float]]]:
+    """One node's scraped history lines -> series name -> points, segments
+    concatenated in incarnation order (see ``node_segments``). Counters and
+    gauges map to their values; each histogram contributes ``<name>.count``
+    and ``<name>.sum`` series."""
+    series: Dict[str, List[Tuple[float, float]]] = {}
+    for segment in node_segments(history_lines):
+        for name, points in segment.items():
+            series.setdefault(name, []).extend(points)
+    return series
 
 
 def cluster_timeseries(statuses: Iterable[object]) -> ClusterSeries:

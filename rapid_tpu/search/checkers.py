@@ -24,6 +24,7 @@ INVARIANTS = (
     "fingerprint-agreement",
     "gray-collateral",
     "durability",
+    "metastable-recovery",
 )
 
 
@@ -254,6 +255,75 @@ def check_durability(
                 f"recovered replica row diverged on partition {partition}: "
                 f"{detail}",
             )
+
+
+def goodput_samples(
+    history: Sequence[ClientOp], bucket_ms: int = 256,
+) -> List[Tuple[int, int, int]]:
+    """Fold a completed-op history into ``(bucket_start_ms, offered, good)``
+    samples on a fixed-width time grid -- the goodput SLI derived from the
+    probe's own client history (invoke time counts the op as offered; an
+    OK completion, or NOT_FOUND for a read, counts it as good)."""
+    buckets: Dict[int, List[int]] = {}
+    for o in history:
+        start = (int(o.invoke_ms) // int(bucket_ms)) * int(bucket_ms)
+        row = buckets.setdefault(start, [0, 0])
+        row[0] += 1
+        if o.status == PutAck.STATUS_OK or (
+            o.op == "get" and o.status == PutAck.STATUS_NOT_FOUND
+        ):
+            row[1] += 1
+    return sorted((b, row[0], row[1]) for b, row in buckets.items())
+
+
+def check_metastable_recovery(
+    history: Sequence[ClientOp],
+    *,
+    faulted_from_ms: int,
+    healed_at_ms: int,
+    min_ops: int = 8,
+    margin: float = 0.25,
+    baseline_floor: float = 0.9,
+) -> None:
+    """Metastability invariant: once the injected faults have cleared and
+    offered load is back to its baseline shape, the goodput SLI must
+    return to (near) its pre-fault baseline. A system that stays degraded
+    after the trigger is gone -- retry storms, stuck redirect loops, a
+    leader map that never repoints -- is in a metastable failure state,
+    the class of outage the SLO plane's burn alerts exist to catch.
+
+    ``faulted_from_ms`` is when the first fault window opened (ops invoked
+    strictly before it form the baseline); ``healed_at_ms`` is when the
+    caller knows every fault had cleared AND recovery had a settle period
+    (ops invoked at/after it form the tail). Conservative by design: with
+    fewer than ``min_ops`` in either segment, or a baseline already below
+    ``baseline_floor`` goodput, the check is vacuous -- it judges
+    *recovery*, not the outage itself."""
+
+    def ratio(ops: List[ClientOp]) -> float:
+        good = sum(
+            1 for o in ops
+            if o.status == PutAck.STATUS_OK
+            or (o.op == "get" and o.status == PutAck.STATUS_NOT_FOUND)
+        )
+        return good / len(ops)
+
+    baseline = [o for o in history if o.invoke_ms < faulted_from_ms]
+    tail = [o for o in history if o.invoke_ms >= healed_at_ms]
+    if len(baseline) < min_ops or len(tail) < min_ops:
+        return
+    base_ratio = ratio(baseline)
+    if base_ratio < baseline_floor:
+        return
+    tail_ratio = ratio(tail)
+    if tail_ratio < base_ratio - margin:
+        raise InvariantViolation(
+            "metastable-recovery",
+            f"goodput stuck at {tail_ratio:.3f} after faults cleared at "
+            f"{healed_at_ms}ms (baseline {base_ratio:.3f} before "
+            f"{faulted_from_ms}ms, margin {margin}): the system did not "
+            f"recover once offered load returned to baseline",
+        )
 
 
 def check_view_agreement(views: Mapping[str, object]) -> None:

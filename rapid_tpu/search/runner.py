@@ -35,6 +35,7 @@ from .checkers import (
     check_gray_collateral,
     check_leader_agreement,
     check_linearizable_history,
+    check_metastable_recovery,
     check_view_agreement,
 )
 from .coverage import (
@@ -89,6 +90,24 @@ def _gray_plan_victims(plan: FaultPlan):
             return True, None
         victims.add(dst)
     return True, victims
+
+
+def _plan_fault_span(plan: FaultPlan):
+    """``(first_open_ms, last_clear_ms)`` across every rule window of the
+    plan, or None when the plan has no rules or any window is open-ended
+    (a fault that never heals supports no recovery claim, so the
+    metastable-recovery check must stay vacuous)."""
+    starts: List[int] = []
+    ends: List[int] = []
+    for rule in plan.rules:
+        for start, end in rule.windows:
+            if end is None:
+                return None
+            starts.append(int(start))
+            ends.append(int(end))
+    if not starts:
+        return None
+    return min(starts), max(ends)
 
 
 def _collect(checks) -> List[dict]:
@@ -151,6 +170,21 @@ def run_engine_probe(spec: dict) -> ProbeResult:
         checks.append(
             lambda: check_gray_collateral(
                 {str(v) for v in victims}, evicted
+            )
+        )
+    span = _plan_fault_span(plan)
+    horizon = spec.get("horizon_ms", 4000)
+    if span is not None and span[1] < horizon:
+        # every fault heals inside the horizon: the back half of the
+        # post-heal window must see goodput return to the pre-fault
+        # baseline (metastability check; vacuous when either segment is
+        # too thin -- see checkers.check_metastable_recovery)
+        faulted_from, healed_at = span[0], span[1] + (horizon - span[1]) // 2
+        checks.append(
+            lambda: check_metastable_recovery(
+                history,
+                faulted_from_ms=faulted_from,
+                healed_at_ms=healed_at,
             )
         )
     violations = _collect(checks)
@@ -302,6 +336,9 @@ def run_sim_probe(spec: dict) -> ProbeResult:
             sim.restart_slot(slot) for slot in restart_victims
         )
         sim.run_until_decision(max_rounds=8, batch=4)
+    # everything from here on is post-heal, post-restart, post-settle: the
+    # tail the metastable-recovery check holds to the pre-fault baseline
+    healed_ms = sim.virtual_ms
     do_ops(max(1, ops // 4))
     for key in sorted(sim.serving_acked):
         invoke = sim.virtual_ms
@@ -349,6 +386,28 @@ def run_sim_probe(spec: dict) -> ProbeResult:
         ]
         checks.append(
             lambda: check_gray_collateral(victim_labels, evicted_labels)
+        )
+    spans = [
+        span for span in (
+            _plan_fault_span(device_plan),
+            _plan_fault_span(serving_plan) if serving_plan is not None
+            else None,
+        ) if span is not None
+    ]
+    if spans and (serving_plan is None or len(spans) == 2):
+        # every window across both plan halves is bounded: the post-heal
+        # tail must see goodput back at the pre-fault baseline.
+        # Serving-nemesis windows run on their own arm epoch (slightly
+        # before the workload epoch), so folding them onto the workload
+        # epoch only widens the baseline exclusion -- conservative.
+        faulted_from = epoch + min(s[0] for s in spans)
+        healed_at = max(healed_ms, epoch + max(s[1] for s in spans))
+        checks.append(
+            lambda: check_metastable_recovery(
+                history,
+                faulted_from_ms=faulted_from,
+                healed_at_ms=healed_at,
+            )
         )
     violations = _collect(checks)
     snapshot = {name: sim.metrics.get(name) for name in COVERAGE_METRICS}

@@ -53,6 +53,7 @@ from .runtime.resources import SharedResources
 from .runtime.scheduler import ScheduledTask
 from .serving.engine import ServingEngine
 from .settings import Settings
+from .slo.burn import SloPlane
 from .types import (
     AlertMessage,
     BatchedAlertMessage,
@@ -189,6 +190,15 @@ class MembershipService:
                 self.metrics,
                 interval_s=settings.profiling.history_interval_ms / 1000.0,
                 capacity=settings.profiling.history_capacity,
+            )
+        # SLO plane: online SLIs + multi-window burn-rate alerts over the
+        # serving path, fed from _handle_serving on the scheduler clock and
+        # digested into the status RPC (settings.slo is the kill switch;
+        # None reproduces the exact pre-SLO path)
+        self._slo: Optional[SloPlane] = None
+        if settings.slo.enabled:
+            self._slo = SloPlane(
+                settings.slo, metrics=self.metrics, recorder=self.recorder
             )
         # the trace context of the churn this node is currently working on:
         # minted by the local fd_signal root or adopted from the first
@@ -346,6 +356,25 @@ class MembershipService:
                 request_id=getattr(msg, "request_id", 0),
             ))
         future: Promise = Promise()
+        if self._slo is not None:
+            # offered load counts at arrival; the good/latency sample lands
+            # when the (possibly asynchronous) answer completes, measured on
+            # the same scheduler clock so queueing delay is included
+            start_ms = self._scheduler.now_ms()
+            self._slo.record_offered(start_ms)
+            is_get = isinstance(msg, Get)
+
+            def observe(p: Promise) -> None:
+                now_ms = self._scheduler.now_ms()
+                ack = None if p.exception() is not None else p.result()
+                status = getattr(ack, "status", None)
+                if is_get:
+                    ok = status in (PutAck.STATUS_OK, PutAck.STATUS_NOT_FOUND)
+                else:
+                    ok = status == PutAck.STATUS_OK
+                self._slo.record(now_ms, ok, float(now_ms - start_ms))
+
+            future.add_callback(observe)
 
         def task() -> None:
             if isinstance(msg, Get):
@@ -500,6 +529,18 @@ class MembershipService:
             fd_tier_interval_ms = tuple(int(t[1]) for t in tiers)
             fd_tier_threshold = tuple(int(t[2]) for t in tiers)
             fd_tier_flush_ms = tuple(int(t[3]) for t in tiers)
+        # SLO plane digest: the status scrape doubles as an alert-evaluation
+        # tick (forced past the rate limit so a quiet node still clears),
+        # and firing alerts are attributed against this node's own journal
+        slo_names: Tuple[str, ...] = ()
+        slo_burn_milli: Tuple[int, ...] = ()
+        slo_firing: Tuple[int, ...] = ()
+        slo_attributed_trace: Tuple[int, ...] = ()
+        if self._slo is not None:
+            self._slo.tick(self._scheduler.now_ms(), force=True)
+            self._slo.attribute(self.recorder.tail(64))
+            (slo_names, slo_burn_milli, slo_firing,
+             slo_attributed_trace) = self._slo.status_digest()
         return ClusterStatusResponse(
             sender=self._my_addr,
             configuration_id=self._view.get_current_configuration_id(),
@@ -541,6 +582,10 @@ class MembershipService:
             durability_segments=durability_segments,
             durability_snapshot_version=durability_snapshot_version,
             durability_replayed=durability_replayed,
+            slo_names=slo_names,
+            slo_burn_milli=slo_burn_milli,
+            slo_firing=slo_firing,
+            slo_attributed_trace=slo_attributed_trace,
         )
 
     # ------------------------------------------------------------------ #

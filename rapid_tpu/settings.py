@@ -104,6 +104,33 @@ SETTINGS_CATALOG = {
         "doc": "auto-checkpoint after this many log records since the last "
                "snapshot (0 disables auto-checkpointing)",
     },
+    "slo.enabled": {
+        "min": 0, "max": 1,
+        "doc": "kill switch: False attaches no SLO plane and reproduces the "
+               "exact pre-SLO serving path",
+    },
+    "slo.bucket_ms": {
+        "min": 1, "max": 3600000,
+        "doc": "SLI aggregation time-bucket width; burn windows are sums of "
+               "whole buckets, so this bounds alert-edge resolution",
+    },
+    "slo.window_scale": {
+        "min": 0.000001, "max": 1000.0,
+        "doc": "multiplier on the declared burn windows (1.0 = wall-scale "
+               "SRE windows; small values shrink 5m/1h/6h/3d onto short "
+               "virtual-time runs without changing the burn arithmetic)",
+    },
+    "slo.max_buckets": {
+        "min": 16, "max": 1048576,
+        "doc": "SLI ring capacity in time buckets; the oldest buckets are "
+               "evicted beyond this, bounding memory for any run length",
+    },
+    "slo.clear_fraction": {
+        "min": 0.1, "max": 1.0,
+        "doc": "alert hysteresis: a firing burn alert clears only when both "
+               "window burn rates drop below clear_fraction x the fire "
+               "threshold (1.0 disables the hysteresis band)",
+    },
 }
 
 
@@ -210,6 +237,38 @@ class DurabilitySettings:
             )
 
 
+@dataclass(frozen=True)
+class SLOSettings:
+    """Knobs for the SLO plane (slo/). Defaults are conservative: the plane
+    is off (``enabled=False`` attaches nothing to the serving path) and,
+    when on, SLIs aggregate into fixed-width time buckets whose windowed
+    sums drive the multi-window burn-rate alerts. ``window_scale`` maps the
+    wall-scale SRE windows (5m/1h fast, 6h/3d slow) onto virtual-time runs;
+    the burn arithmetic is scale-invariant so alerts fire at the same
+    error-budget consumption either way. Bounds live in SETTINGS_CATALOG
+    (linted by tools/check.py)."""
+
+    enabled: bool = False
+    bucket_ms: int = 1000
+    window_scale: float = 1.0
+    max_buckets: int = 4096
+    clear_fraction: float = 0.9
+
+    def __post_init__(self) -> None:
+        for key, value in (
+            ("enabled", int(self.enabled)),
+            ("bucket_ms", self.bucket_ms),
+            ("window_scale", self.window_scale),
+            ("max_buckets", self.max_buckets),
+            ("clear_fraction", self.clear_fraction),
+        ):
+            bounds = SETTINGS_CATALOG[f"slo.{key}"]
+            assert bounds["min"] <= value <= bounds["max"], (
+                f"slo.{key}={value!r} outside "
+                f"[{bounds['min']}, {bounds['max']}]"
+            )
+
+
 @dataclass
 class Settings:
     # Transport timeouts/retries (GrpcClient.java:55-59)
@@ -276,6 +335,12 @@ class Settings:
     # default; the enabled flag is the kill switch back to the in-memory
     # store and the untouched decision loop.
     durability: DurabilitySettings = field(default_factory=DurabilitySettings)
+
+    # SLO plane (slo/): online SLIs over the serving path, multi-window
+    # burn-rate alerts over declared objectives, and churn-episode
+    # attribution. Off by default; the enabled flag is the kill switch
+    # back to the exact pre-SLO serving path.
+    slo: SLOSettings = field(default_factory=SLOSettings)
 
     def __post_init__(self) -> None:
         assert self.fd_policy in ("cumulative", "windowed"), (

@@ -264,6 +264,9 @@ class Simulator:  # guarded-by: sim-loop
         self._durability_enabled = False
         self._durability_replay_ms = 1
         self._durable_pending: dict = {}  # slot -> records since checkpoint
+        # SLO plane (opt-in via enable_slo; None is the kill-switch-off
+        # path: serving requests run the exact pre-SLO code)
+        self._slo = None
         # membership-invariant element hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
@@ -379,13 +382,18 @@ class Simulator:  # guarded-by: sim-loop
         from injection to view install regardless of plane."""
         signal = self.tracer.event("fd_signal", virtual_ms=self.virtual_ms,
                                    **attrs)
-        self.recorder.record("fd_signal", **attrs)
         if self._churn_ctx is None:
             self._churn_ctx = TraceContext(
                 trace_id=signal.trace_id or signal.span_id,
                 parent_span_id=signal.span_id,
                 origin="sim",
             )
+        # the journal entry carries the episode's trace id so attribution
+        # (slo/attrib.py) can reconstruct injection -> install from the
+        # journal alone, without the span ring
+        self.recorder.record(
+            "fd_signal", trace_id=self._churn_ctx.trace_id, **attrs
+        )
 
     def crash(self, node_ids: np.ndarray) -> None:
         """Crash-stop burst: nodes stop responding to probes and stop voting."""
@@ -853,6 +861,66 @@ class Simulator:  # guarded-by: sim-loop
             # one persisted blob == one WAL append on the live plane; the
             # count is what a post-crash replay has to re-apply
             self._durable_pending[slot] = self._durable_pending.get(slot, 0) + 1
+
+    # -- SLO plane ----------------------------------------------------------- #
+
+    def enable_slo(self, settings=None, catalog=None, windows=None):
+        """Attach the SLO plane (slo/): online SLIs over the serving path,
+        multi-window burn-rate alerts, and churn-episode attribution
+        against this simulator's journal. ``settings.enabled`` is the kill
+        switch: when False this is a no-op returning None and every
+        serving request runs the exact pre-SLO path. Returns the SloPlane
+        (or None when disabled)."""
+        from ..settings import SLOSettings
+        from ..slo import SloPlane
+
+        if settings is None:
+            settings = SLOSettings(enabled=True)
+        if not settings.enabled:
+            self._slo = None
+            return None
+        self._slo = SloPlane(
+            settings, metrics=self.metrics, recorder=self.recorder,
+            catalog=catalog, windows=windows,
+        )
+        return self._slo
+
+    def slo_plane(self):
+        """The live SLO plane (None unless enable_slo attached one)."""
+        return self._slo
+
+    def serving_drive_open_loop(self, arrivals):
+        """Drive the serving mirror with an open-loop arrival stream
+        (slo/sli.py OpenLoopGenerator): each arrival is scheduled on the
+        virtual clock independently of completions. When the server is
+        idle the clock advances to the arrival; when it is behind, the
+        request queues and its measured latency (completion minus
+        *scheduled arrival*) includes the queueing delay -- the
+        coordinated-omission fix the closed-loop driver lacked. Feeds the
+        SLO plane when one is attached. Returns
+        ``[(arrival, status, latency_ms), ...]``."""
+        from ..types import PutAck
+
+        if not self._serving_enabled:
+            raise RuntimeError("serving is not enabled on this simulator")
+        results = []
+        for a in arrivals:
+            at = int(a.at_ms)
+            if self.virtual_ms < at:
+                self.virtual_ms = at  # idle server: wait for the client
+            if self._slo is not None:
+                self._slo.record_offered(at)
+            if a.op == "put":
+                ack = self.serving_put(a.key, a.value)
+            else:
+                ack = self.serving_get(a.key)
+            latency_ms = float(self.virtual_ms - at)
+            ok = ack.status in (PutAck.STATUS_OK, PutAck.STATUS_NOT_FOUND) \
+                if a.op == "get" else ack.status == PutAck.STATUS_OK
+            if self._slo is not None:
+                self._slo.record(self.virtual_ms, ok, latency_ms)
+            results.append((a, int(ack.status), latency_ms))
+        return results
 
     # -- durability mirror -------------------------------------------------- #
 
@@ -1868,8 +1936,15 @@ class Simulator:  # guarded-by: sim-loop
             "view_install",
             configuration_id=record.configuration_id,
             size=record.membership_size,
+            trace_id=vc_span.trace_id,
+            removed=len(record.removed),
+            added=len(record.added),
         )
         self._churn_ctx = None  # next churn episode roots a fresh trace
+        if self._slo is not None:
+            # the install may have jumped the virtual clock: re-evaluate the
+            # burn windows at the new now before the next request lands
+            self._slo.tick(self.virtual_ms)
         return record
 
     # ------------------------------------------------------------------ #

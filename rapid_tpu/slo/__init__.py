@@ -1,0 +1,53 @@
+"""SLO plane: online SLIs, multi-window burn-rate alerts, and
+churn-episode attribution for the serving path.
+
+Three layers, measurement to explanation:
+
+* sli.py -- streaming SLI computation: windowed availability with
+  explicit good-event predicates, latency percentiles from mergeable
+  fixed-bucket histograms, goodput-vs-offered-load, and the open-loop
+  arrival-rate load generator (zipfian keys, millions of simulated
+  clients) that feeds them.
+* burn.py -- declared SLO targets (SLO_CATALOG) evaluated by
+  multi-window multi-burn-rate alerting (fast 5m/1h + slow 6h/3d pairs,
+  scaled onto virtual time), composed into SloPlane behind the
+  ``slo.enabled`` kill switch.
+* attrib.py -- episode attribution: the flight-recorder journal names
+  the view-change / recovery episode a burn window overlaps, so alerts
+  read "p99 burning, attributed to view-change episode <trace-id>".
+"""
+
+from .attrib import Episode, attribute_burn, describe, episodes_from_journal
+from .burn import (
+    BURN_WINDOWS,
+    SLI_CATALOG,
+    SLO_CATALOG,
+    BurnAlert,
+    BurnRateEngine,
+    SloPlane,
+)
+from .sli import (
+    Arrival,
+    OpenLoopGenerator,
+    SliTracker,
+    WindowStats,
+    histogram_quantile,
+)
+
+__all__ = [
+    "BURN_WINDOWS",
+    "SLI_CATALOG",
+    "SLO_CATALOG",
+    "Arrival",
+    "BurnAlert",
+    "BurnRateEngine",
+    "Episode",
+    "OpenLoopGenerator",
+    "SliTracker",
+    "SloPlane",
+    "WindowStats",
+    "attribute_burn",
+    "describe",
+    "episodes_from_journal",
+    "histogram_quantile",
+]

@@ -393,6 +393,15 @@ class ClusterStatusResponse:
     durability_segments: int = 0
     durability_snapshot_version: int = 0
     durability_replayed: int = 0
+    # SLO plane (empty unless slo is enabled): parallel per-alert arrays --
+    # "slo:window" alert names, the current short-window burn rate in
+    # thousandths, firing flags, and the attributed churn episode's trace
+    # id (0 = unattributed) -- enough for an operator tool to render
+    # "p99 burning, attributed to view-change episode <trace-id>"
+    slo_names: Tuple[str, ...] = ()
+    slo_burn_milli: Tuple[int, ...] = ()
+    slo_firing: Tuple[int, ...] = ()
+    slo_attributed_trace: Tuple[int, ...] = ()
 
 
 @dataclass(frozen=True)

@@ -833,6 +833,130 @@ def scenario_rolling_restart(seed=37, n=4, ops_per_wave=12):
     }
 
 
+def scenario_overload_recover(seed=43, n=16, rate_base=400.0,
+                              rate_burst=2500.0):
+    """Overload-then-recover through a leader eviction (the SLO plane's
+    end-to-end demonstration): an open-loop arrival stream runs at a
+    sustainable baseline rate, then bursts past serving capacity while the
+    busiest partition leader crashes -- queueing delay (measured from
+    *scheduled* arrival, so nothing is coordinated-omitted) burns the
+    latency SLO and the fast-pair burn alert fires mid-churn. The decided
+    view plus the rate dropping back to baseline must (a) let the
+    fast-window alerts clear, (b) leave every fired alert attributed to
+    the view-change episode's trace id, and (c) pass the
+    metastable-recovery checker on the scenario's own client history."""
+    from rapid_tpu.search.checkers import (
+        ClientOp,
+        InvariantViolation,
+        check_metastable_recovery,
+    )
+    from rapid_tpu.settings import SLOSettings
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.slo import OpenLoopGenerator, describe
+
+    t0 = time.perf_counter()
+    sim = Simulator(n, seed=seed)
+    sim.enable_placement(partitions=64, replicas=3)
+    sim.enable_handoff(chunk_ms=1)
+    sim.enable_serving()
+    # burn windows compressed onto virtual time: fast pair 5m/1h ->
+    # 300ms/3.6s, so the whole fire->attribute->clear cycle fits one run
+    plane = sim.enable_slo(SLOSettings(enabled=True, window_scale=0.001))
+    keys = [b"ovr-%03d" % i for i in range(32)]
+    for i, key in enumerate(keys):
+        ack = sim.serving_put(key, b"seed-%d" % i)
+        assert ack.status == ack.STATUS_OK
+    history: "list[ClientOp]" = []
+
+    def drive(gen: OpenLoopGenerator, n_ops: int) -> None:
+        gen.rebase(sim.virtual_ms)
+        for a, status, lat in sim.serving_drive_open_loop(
+            gen.arrivals(n_ops)
+        ):
+            history.append(ClientOp(
+                client=f"c{a.client}", op=a.op, key=a.key, value=a.value,
+                version=0, status=int(status),
+                invoke_ms=int(a.at_ms), complete_ms=int(a.at_ms + lat),
+            ))
+
+    base = OpenLoopGenerator(
+        rate_base, keys, put_fraction=0.2, seed=seed,
+    )
+    drive(base, 480)  # ~1.2s virtual of healthy baseline
+    false_alerts = plane.firing_count()
+
+    # overload + leader crash: the busiest leader slot goes down while the
+    # arrival rate jumps past capacity -- redirects and quorum reads slow
+    # service exactly when the queue is growing fastest
+    faulted_from = sim.virtual_ms
+    leaders = sim.placement.assign[:, 0].astype(int)
+    victim = int(np.argmax(np.bincount(leaders[leaders > 0])))
+    sim.crash(np.array([victim]))
+    burst = OpenLoopGenerator(
+        rate_burst, keys, put_fraction=0.2, seed=seed + 1,
+    )
+    drive(burst, 1200)
+    fired_during_churn = plane.firing_count()
+    rec = sim.run_until_decision(max_rounds=64, batch=16)
+    assert rec is not None, "overload-recover: no view decision"
+    assert set(int(c) for c in rec.cut) == {victim}, (
+        "overload-recover: cut parity"
+    )
+
+    # recovery: baseline rate until the fast pair's long window (3.6s
+    # scaled) has fully drained the churn's error mass
+    healed_at = sim.virtual_ms
+    drive(base, 1700)
+    plane.tick(sim.virtual_ms, force=True)
+    plane.attribute(sim.recorder.tail(4096))
+
+    installs = [
+        e for e in sim.recorder.tail(4096)
+        if e["kind"] == "view_install" and e["detail"].get("trace_id")
+    ]
+    expected_trace = int(installs[-1]["detail"]["trace_id"]) if installs else 0
+    fired = [a for a in plane.alerts() if a.fired_count > 0]
+    attributed_ok = bool(fired) and all(
+        a.attributed is not None
+        and a.attributed.kind == "view-change"
+        and int(a.attributed.trace_id) == expected_trace
+        for a in fired
+    )
+    fast_cleared = all(
+        not a.firing for a in plane.alerts() if a.window == "fast"
+    )
+    try:
+        check_metastable_recovery(
+            history, faulted_from_ms=faulted_from, healed_at_ms=healed_at,
+        )
+        recovered = True
+    except InvariantViolation:
+        recovered = False
+
+    wall = time.perf_counter() - t0
+    return {
+        "config": (
+            f"overload-recover: {n} nodes, open-loop "
+            f"{rate_base:.0f}->{rate_burst:.0f}/s burst through a leader "
+            f"crash (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": sim.virtual_ms,
+        "wall_s": round(wall, 3),
+        "cut_ok": bool(
+            false_alerts == 0 and fired_during_churn > 0
+            and fast_cleared and attributed_ok and recovered
+        ),
+        "alerts_fired_during_churn": fired_during_churn,
+        "fast_alerts_cleared": fast_cleared,
+        "attributed": [
+            {"alert": a.name, "episode": describe(a.attributed)}
+            for a in fired
+        ],
+        "metastable_recovery_ok": recovered,
+    }
+
+
 def scenario_pinned_plan(path, seed=None):
     """Replay one pinned nemesis-search corpus file (a probe spec JSON
     written by ``tools/hunt.py --pin``): build the FaultPlan back through
@@ -889,6 +1013,7 @@ register("clock-skew", scenario_clock_skew, seed=13)
 register("rolling-upgrade", scenario_rolling_upgrade, seed=21)
 register("serving-sawtooth", scenario_serving_sawtooth, seed=31)
 register("rolling-restart", scenario_rolling_restart, seed=37)
+register("overload-recover", scenario_overload_recover, seed=43)
 # 10x the north-star scale (VERDICT r4 item 3): every failure class the
 # paper holds stable, at 1M, with cut parity AND the from-scratch
 # configuration-id cross-check
@@ -903,7 +1028,7 @@ BATTERY = [
     "cross-plane-10", "crash-1k", "crash-10k", "one-way-loss-50k",
     "flip-flop-join-100k", "nemesis-smoke", "wan-zone-loss",
     "gray-slow-node", "gray-flapping", "clock-skew", "rolling-upgrade",
-    "serving-sawtooth", "rolling-restart",
+    "serving-sawtooth", "rolling-restart", "overload-recover",
 ]
 SCALE_1M = ["crash-1m", "one-way-loss-1m", "flip-flop-join-1m"]
 

@@ -20,12 +20,14 @@ SCRAPER = Endpoint.from_parts("10.9.1.1", 7101)
 MEMBER = Endpoint.from_parts("10.9.1.2", 7102)
 
 # exactly what MetricsHistory.to_wire produces: one sorted-key JSON object
-# per line with ts_s / counters / gauges / histograms ([count, sum]) tables
+# per line with ts_s / seq / counters / gauges / histograms ([count, sum])
+# tables; ``seq`` is the per-incarnation monotonic stamp the scrape
+# assembler uses to split series across restarts
 HISTORY_LINES = (
     '{"counters": {"rounds": 3.0}, "gauges": {"msg.queue_depth{peer=10.9.1.3:7103}": 128.0}, '
-    '"histograms": {"profile.phase_ms{phase=fd_scan,plane=sim}": [3, 1.5]}, "ts_s": 12.0}',
+    '"histograms": {"profile.phase_ms{phase=fd_scan,plane=sim}": [3, 1.5]}, "seq": 1, "ts_s": 12.0}',
     '{"counters": {"rounds": 5.0}, "gauges": {}, '
-    '"histograms": {"profile.phase_ms{phase=fd_scan,plane=sim}": [5, 2.25]}, "ts_s": 13.0}',
+    '"histograms": {"profile.phase_ms{phase=fd_scan,plane=sim}": [5, 2.25]}, "seq": 2, "ts_s": 13.0}',
 )
 
 SCRAPE_REQUEST = ClusterStatusRequest(sender=SCRAPER, include_history=16)
@@ -41,6 +43,21 @@ SCRAPE_RESPONSE = ClusterStatusResponse(
     history=HISTORY_LINES,
 )
 
+# an SLO-plane-bearing status: the four parallel alert tuples the SLO PR
+# appended (proto fields 37-40) -- one healthy alert and one firing alert
+# attributed to view-change trace 7, pinning burn-milli integer scaling
+SLO_RESPONSE = ClusterStatusResponse(
+    sender=MEMBER,
+    configuration_id=-6148914691236517206,
+    membership_size=3,
+    reports_tracked=1,
+    consensus_votes=2,
+    slo_names=("serving.availability:fast", "serving.latency:fast"),
+    slo_burn_milli=(150, 42100),
+    slo_firing=(0, 1),
+    slo_attributed_trace=(0, 7),
+)
+
 # named (request_no, message) pairs pinned on the native msgpack wire
 TCP_SCRAPES = {
     "request_with_history": (11, SCRAPE_REQUEST),
@@ -48,4 +65,5 @@ TCP_SCRAPES = {
     # (old peers' frames simply omit what their dataclass defaults fill)
     "request_plain": (12, ClusterStatusRequest(sender=SCRAPER)),
     "response_with_history": (13, SCRAPE_RESPONSE),
+    "response_with_slo": (14, SLO_RESPONSE),
 }

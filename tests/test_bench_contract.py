@@ -343,12 +343,54 @@ def test_serving_dimension_json_contract(monkeypatch, capsys):
         counts = list(hist.values())
         assert counts == sorted(counts)  # cumulative buckets
         assert hist["inf"] == ops
+    # the SLO plane rode the same open-loop stream: its summary is part of
+    # the artifact (availability, p99, goodput, per-window burn peaks)
+    assert entry["offered_rate_per_s"] == bench.SERVING_RATE_PER_S
+    slo = entry["slo"]
+    assert set(slo) == {"serving.availability", "serving.latency"}
+    for name, summary in slo.items():
+        assert 0.0 <= summary["availability"] <= 1.0
+        assert 0.0 <= summary["goodput_ratio"] <= 1.0
+        assert summary["peak_burn"] >= 0.0
+        assert set(summary["alerts"]) == {"fast", "slow"}
+        for alert in summary["alerts"].values():
+            assert alert["burn_short"] >= 0.0
+            assert alert["burn_long"] >= 0.0
     # and the emitter folds the entry into the artifact line verbatim
     bench._emit_json(
         {"value": 120.0, "virtual_ms": 11_100}, "cpu", []
     )
     parsed = json.loads(capsys.readouterr().out.strip().splitlines()[0])
     assert parsed["serving_qps"] == entry
+
+
+def test_open_loop_generator_deterministic():
+    """The open-loop arrival stream is a pure function of its seed: same
+    seed -> identical schedule (timestamps, ops, keys, clients), different
+    seed -> a different one. The serving dimension's determinism per seed
+    rests on this."""
+    from rapid_tpu.slo import OpenLoopGenerator
+
+    keys = [b"k-%02d" % i for i in range(8)]
+
+    def stream(seed):
+        gen = OpenLoopGenerator(500.0, keys, put_fraction=0.3, seed=seed)
+        return [(a.at_ms, a.op, a.key, a.client) for a in gen.arrivals(200)]
+
+    first = stream(7)
+    assert first == stream(7)
+    assert first != stream(8)
+    # open loop: arrival times are monotone and rate-scheduled, never
+    # gated on completions (no completion signal even exists here)
+    times = [t for t, _op, _k, _c in first]
+    assert times == sorted(times)
+    assert any(op == "put" for _t, op, _k, _c in first)
+    assert any(op == "get" for _t, op, _k, _c in first)
+    # zipfian keys: the hottest key strictly dominates the coldest
+    from collections import Counter
+
+    freq = Counter(k for _t, _op, k, _c in first)
+    assert freq[keys[0]] > freq.get(keys[-1], 0)
 
 
 def _reduced_messaging_scale(monkeypatch):

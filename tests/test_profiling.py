@@ -38,6 +38,7 @@ from golden.scrape_fixtures import (
     HISTORY_LINES,
     SCRAPE_REQUEST,
     SCRAPE_RESPONSE,
+    SLO_RESPONSE,
     TCP_SCRAPES,
 )
 from harness import ClusterHarness
@@ -59,7 +60,7 @@ from rapid_tpu.profiling import (
     cluster_timeseries,
     merge_by_series,
 )
-from rapid_tpu.profiling.scrape import node_series
+from rapid_tpu.profiling.scrape import node_segments, node_series
 from rapid_tpu.settings import ProfilingSettings
 from rapid_tpu.types import ClusterStatusRequest, ClusterStatusResponse
 from tools.perfscope import diff_artifacts, extract_phases, parse_rendered
@@ -364,6 +365,16 @@ def test_scrape_grpc_bytes_golden():
     assert parsed == SCRAPE_RESPONSE
     assert parsed.history == HISTORY_LINES
 
+    # the SLO alert digest (fields 37-40) rides the same response
+    wire = gt.to_wire_response(SLO_RESPONSE).SerializeToString(
+        deterministic=True
+    )
+    assert wire.hex() == GOLDEN["grpc"]["ClusterStatusResponse_slo"]
+    parsed = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert parsed == SLO_RESPONSE
+    assert parsed.slo_burn_milli == (150, 42100)
+    assert parsed.slo_firing == (0, 1)
+
 
 def test_pre_profiling_frames_parse_to_defaults():
     """Rolling upgrade both ways: an old peer's frame (no scrape fields)
@@ -379,6 +390,8 @@ def test_pre_profiling_frames_parse_to_defaults():
     wire = gt.to_wire_response(old_resp).SerializeToString(deterministic=True)
     back = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
     assert back == old_resp and back.history == ()
+    # pre-SLO peers' frames fill the alert digest with its empty defaults
+    assert back.slo_names == () and back.slo_firing == ()
 
 
 # ---------------------------------------------------------------------------
@@ -481,6 +494,100 @@ def test_three_node_cluster_scrape_assembles_cluster_timeseries():
         h.shutdown()
 
 
+def test_node_series_does_not_interleave_restarted_incarnations():
+    """A virtual-clock member restarts at t=0: the new incarnation's
+    timestamps sort BELOW the old ones. The per-incarnation seq stamp
+    keeps the assembled series in incarnation order where the old global
+    ts sort zig-zagged the two incarnations into one broken series."""
+    lines = (
+        '{"counters": {"rounds": 10.0}, "gauges": {}, "histograms": {}, '
+        '"seq": 1, "ts_s": 50.0}',
+        '{"counters": {"rounds": 20.0}, "gauges": {}, "histograms": {}, '
+        '"seq": 2, "ts_s": 60.0}',
+        # restart: the virtual clock AND the seq stamp both start over
+        '{"counters": {"rounds": 1.0}, "gauges": {}, "histograms": {}, '
+        '"seq": 1, "ts_s": 5.0}',
+        '{"counters": {"rounds": 2.0}, "gauges": {}, "histograms": {}, '
+        '"seq": 2, "ts_s": 15.0}',
+    )
+    segments = node_segments(lines)
+    assert [seg["rounds"] for seg in segments] == [
+        [(50.0, 10.0), (60.0, 20.0)],
+        [(5.0, 1.0), (15.0, 2.0)],
+    ]
+    series = node_series(lines)
+    assert series["rounds"] == [
+        (50.0, 10.0), (60.0, 20.0), (5.0, 1.0), (15.0, 2.0),
+    ]
+    # old peers' seq-less lines still split on the ts regression alone
+    legacy = tuple(
+        json.dumps(
+            {k: v for k, v in json.loads(line).items() if k != "seq"},
+            sort_keys=True,
+        )
+        for line in lines
+    )
+    assert len(node_segments(legacy)) == 2
+
+
+def test_scrape_split_across_restarted_cluster_member():
+    """A scraper accumulating one member's history lines across that
+    member's restart: the fresh ring restarts the seq stamp at 1, so
+    node_segments splits at the incarnation boundary and node_series keeps
+    the concatenation in incarnation order (the restarted node's counters
+    visibly begin again instead of merging into a zig-zag)."""
+    settings = Settings(profiling=ProfilingSettings(
+        enabled=True, history_interval_ms=200, history_capacity=16,
+    ))
+    h = ClusterHarness(seed=17, settings=settings)
+    try:
+        h.create_cluster(3)
+        h.wait_and_verify_agreement(3)
+        probe = InProcessClient(
+            Endpoint.from_parts("127.0.0.1", 9998), h.network, h.settings
+        )
+        target = h.addr(2)
+        for _ in range(3):
+            _scrape(h, probe, target, 0)  # status calls tick the ring
+            h.scheduler.run_until(lambda: False, timeout_ms=500)
+        before = _scrape(h, probe, target, 8).history
+        assert len(before) >= 2
+
+        h.fail_nodes([target])
+        h.wait_and_verify_agreement(2)  # the FD evicts the dead seat
+        h.blacklist.discard(target)
+        h.join(2, seed_index=0)  # same endpoint, fresh incarnation
+        h.wait_and_verify_agreement(3)
+        for _ in range(3):
+            _scrape(h, probe, target, 0)
+            h.scheduler.run_until(lambda: False, timeout_ms=500)
+        after = _scrape(h, probe, target, 8).history
+        assert len(after) >= 2
+
+        carriage = before + after  # the scraper's accumulated lines
+        segments = node_segments(carriage)
+        assert len(segments) == 2  # one per incarnation
+
+        def snap_points(seg):
+            key = next(
+                k for k in seg
+                if parse_rendered(k)[0] == "profile.history_snapshots"
+            )
+            return key, seg[key]
+
+        key, first = snap_points(segments[0])
+        _, second = snap_points(segments[1])
+        for points in (first, second):
+            counts = [v for _, v in points]
+            assert counts == sorted(counts)  # monotone inside incarnation
+        # the ring really restarted: the counter began again
+        assert second[0][1] <= first[-1][1]
+        # and the flat series preserves incarnation order end to end
+        assert node_series(carriage)[key] == first + second
+    finally:
+        h.shutdown()
+
+
 def test_scrape_without_profiling_returns_no_history():
     h = ClusterHarness(seed=16)  # default settings: profiling disabled
     try:
@@ -541,3 +648,86 @@ def test_perfscope_diff_flags_regressions():
     assert any("jit_compiles_steady" in r for r in regressions)
     _, clean = diff_artifacts(old, dict(old, value=104.0), threshold=0.10)
     assert clean == []
+
+
+def _check_artifact() -> dict:
+    """A healthy bench artifact carrying every DIMENSION_BUDGETS path."""
+    return {
+        "metric": "decision_wall_ms", "value": 1200.0,
+        "serving_qps": {
+            "steady": {"p99_ms": 4.0},
+            "lost_acked_writes": 0,
+            "throughput_qps": 550.0,
+            "slo": {
+                "serving.availability": {
+                    "availability": 1.0, "goodput_ratio": 1.0,
+                },
+                "serving.latency": {
+                    "alerts": {"fast": {"firing": False}},
+                },
+            },
+        },
+        "messaging_throughput": {
+            "broadcast_storm": {"messages_per_s": 9000.0},
+        },
+        "gray_detection_ms": {
+            "gray_slow_node": {"speedup": 4.2},
+            "gray_flapping": {"speedup": 2.4},
+        },
+    }
+
+
+def test_perfscope_check_budgets_pure():
+    """check_budgets gates the headline plus every dimension path the
+    artifact carries, skipping absent dimensions instead of failing."""
+    from tools.perfscope import DIMENSION_BUDGETS, check_budgets
+
+    doc = _check_artifact()
+    lines, breaches = check_budgets(doc)
+    assert breaches == []
+    # every budget row found its leaf: headline + all table rows reported
+    assert len(lines) == 1 + len(DIMENSION_BUDGETS)
+    assert all("within" in line for line in lines)
+
+    # one breach per broken leaf, each naming its dimension
+    doc["serving_qps"]["steady"]["p99_ms"] = 80.0
+    doc["serving_qps"]["slo"]["serving.latency"]["alerts"]["fast"][
+        "firing"] = True
+    doc["gray_detection_ms"]["gray_flapping"]["speedup"] = 1.1
+    _, breaches = check_budgets(doc)
+    assert len(breaches) == 3
+    assert {b.split(":")[0] for b in breaches} == {"serving", "slo", "gray"}
+
+    # headline over budget is a breach too
+    _, breaches = check_budgets(_check_artifact(), budget_ms=1000.0)
+    assert breaches == ["headline 1200.0 ms > 1000 ms"]
+
+    # partial artifact (dimension never ran): its rows are skipped
+    partial = {"metric": "m", "value": 100.0}
+    lines, breaches = check_budgets(partial)
+    assert breaches == [] and len(lines) == 1
+
+
+def test_perfscope_check_cli_exit_codes(tmp_path, capsys):
+    """CLI contract: rc 0 within budgets, rc 3 on any dimension breach
+    (with a BUDGET BREACH line on stderr), rc 2 when the artifact has no
+    headline value at all."""
+    from tools.perfscope import main as perfscope
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_check_artifact()))
+    assert perfscope(["check", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "headline" in out and "serving_qps.slo" in out
+
+    bad_doc = _check_artifact()
+    bad_doc["serving_qps"]["lost_acked_writes"] = 3
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(bad_doc))
+    assert perfscope(["check", str(bad)]) == 3
+    err = capsys.readouterr().err
+    assert "BUDGET BREACH" in err and "lost_acked_writes" in err
+
+    outage = tmp_path / "outage.json"
+    outage.write_text(json.dumps({"metric": "m", "error": "boom"}))
+    assert perfscope(["check", str(outage)]) == 2

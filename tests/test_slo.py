@@ -1,0 +1,560 @@
+"""The SLO plane: online SLIs, multi-window burn-rate alerts, and
+churn-episode attribution (ISSUE 17).
+
+What is pinned here:
+
+- burn-rate arithmetic at window edges: exact burn values the moment a
+  bucket enters/leaves a trailing window, the both-windows fire rule, and
+  clear hysteresis (fire -> hover -> clear);
+- virtual-vs-wall parity: the same request pattern fed through a
+  wall-scale plane and a ``window_scale`` compressed plane produces the
+  same burns and the same alert transitions -- the arithmetic is
+  scale-invariant by construction;
+- the SLI primitives: histogram_quantile merge semantics, SliTracker
+  window edges, the open-loop generator's rebase contract;
+- attribution: journal tails fold into episodes, burn windows attribute
+  to the largest-overlap episode, and describe() renders the operator
+  line;
+- check_metastable_recovery: vacuous by design on thin/degraded
+  baselines, and a seeded kill-test proving it bites on a history that
+  never recovers after the faults clear;
+- the wire: the four SLO digest fields round-trip both transports and a
+  kill-switch-off node reports none of them;
+- the service: a live cluster with ``settings.slo.enabled`` answers
+  status probes with the alert digest; default settings reproduce the
+  exact pre-SLO (empty) surface.
+"""
+
+import json
+
+import pytest
+
+from harness import ClusterHarness
+
+from rapid_tpu import Endpoint, InMemoryPartitionStore, Settings
+from rapid_tpu.messaging import grpc_transport as gt
+from rapid_tpu.messaging.codec import decode, encode
+from rapid_tpu.messaging.inprocess import InProcessClient
+from rapid_tpu.messaging.wire_schema import MSG
+from rapid_tpu.search.checkers import (
+    ClientOp,
+    InvariantViolation,
+    check_metastable_recovery,
+    goodput_samples,
+)
+from rapid_tpu.settings import SLOSettings
+from rapid_tpu.slo import (
+    BURN_WINDOWS,
+    SLI_CATALOG,
+    SLO_CATALOG,
+    BurnRateEngine,
+    OpenLoopGenerator,
+    SliTracker,
+    SloPlane,
+    attribute_burn,
+    describe,
+    episodes_from_journal,
+    histogram_quantile,
+)
+from rapid_tpu.types import ClusterStatusRequest, ClusterStatusResponse, PutAck
+
+# ---------------------------------------------------------------------------
+# burn-rate arithmetic at window edges
+# ---------------------------------------------------------------------------
+
+# one tiny window pair so every edge is hand-computable: short 1 s,
+# long 2 s, fire at 2x budget burn
+EDGE_WINDOWS = {"w": {"short_s": 1, "long_s": 2, "burn": 2.0}}
+EDGE_SPEC = {"sli": "availability", "objective": 0.9, "windows": ("w",)}
+
+
+def _edge_engine(clear_fraction=0.5):
+    tracker = SliTracker(bucket_ms=100, predicates=("availability",))
+    engine = BurnRateEngine(
+        "edge", EDGE_SPEC, tracker,
+        window_scale=1.0, clear_fraction=clear_fraction,
+        windows=EDGE_WINDOWS,
+    )
+    return tracker, engine
+
+
+def test_burn_rate_exact_at_window_edges():
+    """Exact arithmetic pins: 2 bad + 8 good in the short window is error
+    rate 0.2 over budget 0.1 = burn 2.0, and the bad bucket falls out of
+    the short window on exactly the tick its bucket stops overlapping
+    ``(now - short, now]``."""
+    tracker, engine = _edge_engine()
+    for t in (0, 10):  # bucket [0, 100): the bad ones
+        tracker.record(t, 1.0, [])
+    for i in range(8):  # bucket [500, 600): the good ones
+        tracker.record(500 + i, 1.0, ["availability"])
+
+    assert engine.burn_rate(1000, 1000) == pytest.approx(2.0)
+    assert engine.burn_rate(1000, 2000) == pytest.approx(2.0)
+    transitions = engine.tick(1000)
+    alert = engine.alerts[0]
+    assert [(k, a.name) for k, a in transitions] == [("fired", "edge:w")]
+    assert alert.burn_short == pytest.approx(2.0)
+    assert alert.burn_long == pytest.approx(2.0)
+    assert alert.peak_burn == pytest.approx(2.0)
+
+    # now=1100: cutoff is 100, the bad bucket [0,100) no longer overlaps
+    # the short window -- burn_short drops to exactly 0 while the long
+    # window still carries the full error mass
+    assert engine.tick(1100) == []  # long window holds it firing
+    assert alert.burn_short == pytest.approx(0.0)
+    assert alert.burn_long == pytest.approx(2.0)
+    assert alert.firing
+
+    # now=2100: the bad bucket leaves the long window too -> both clear
+    transitions = engine.tick(2100)
+    assert [(k, a.name) for k, a in transitions] == [("cleared", "edge:w")]
+    assert not alert.firing
+    assert alert.cleared_at_ms == 2100
+    assert alert.fired_count == 1
+
+
+def test_burn_alert_fires_only_when_both_windows_burn():
+    """The multi-window rule: a short-window spike with a quiet long
+    window never pages."""
+    tracker, engine = _edge_engine()
+    # long window: 38 good spread over [0, 1000)
+    for i in range(38):
+        tracker.record(i * 26, 1.0, ["availability"])
+    # short spike: 2 bad in [1900, 2000) -- the only traffic in the short
+    # window, so burn_short = (1.0 / 0.1) = 10x ...
+    tracker.record(1900, 1.0, [])
+    tracker.record(1910, 1.0, [])
+    engine.tick(2000)
+    alert = engine.alerts[0]
+    assert alert.burn_short == pytest.approx(10.0)
+    # ... but the long window dilutes it: 2 bad / 40 total = 0.05 error
+    assert alert.burn_long == pytest.approx(0.5)
+    assert not alert.firing  # no page: not sustained
+
+
+def test_burn_alert_clear_hysteresis_no_flap():
+    """A burn hovering just under the threshold cannot flap the alert:
+    clearing requires BOTH windows under clear_fraction x threshold."""
+    tracker, engine = _edge_engine(clear_fraction=0.9)  # clear under 1.8x
+    for i in range(8):
+        tracker.record(i, 1.0, [])  # 8 bad
+    for i in range(32):
+        tracker.record(100 + i, 1.0, ["availability"])  # 32 good
+    engine.tick(500)  # error 8/40 = 0.2 -> burn 2.0 on both windows
+    alert = engine.alerts[0]
+    assert alert.firing
+
+    # hover: fresh bucket at 19% errors -> burn 1.9, above the 1.8 clear
+    # line but below the 2.0 fire line -- must stay firing, not flap
+    for i in range(81):
+        tracker.record(1200 + i, 1.0, ["availability"])
+    for i in range(19):
+        tracker.record(1300 + i, 1.0, [])
+    engine.tick(2100)  # short window = only the hover bucket
+    assert alert.burn_short == pytest.approx(1.9)
+    assert alert.firing
+    assert alert.fired_count == 1  # never cleared, never re-fired
+
+    # full recovery: clean traffic only -> both burns 0 -> clears
+    for i in range(10):
+        tracker.record(3600 + i, 1.0, ["availability"])
+    engine.tick(4400)
+    assert not alert.firing
+
+
+def test_burn_windows_scale_invariant_virtual_vs_wall():
+    """Virtual-vs-wall parity: the identical request pattern fed at wall
+    scale and at 1000x compression (window_scale=0.001, bucket_ms scaled
+    the same way) produces identical burns, transitions, and summaries."""
+    wall = SloPlane(SLOSettings(enabled=True, bucket_ms=1000,
+                                window_scale=1.0))
+    virt = SloPlane(SLOSettings(enabled=True, bucket_ms=1,
+                                window_scale=0.001))
+
+    def feed(plane, scale_ms):
+        # 60 "seconds" of clean traffic, then 120 of 50% errors (record()
+        # ticks the engines itself, once per SLI bucket)
+        for s in range(60):
+            plane.record_offered(int(s * scale_ms))
+            plane.record(int(s * scale_ms), True, 2.0)
+        for s in range(60, 180):
+            t = int(s * scale_ms)
+            plane.record_offered(t)
+            plane.record(t, s % 2 == 0, 2.0)
+        return int(180 * scale_ms)
+
+    wall_now = feed(wall, 1000.0)
+    virt_now = feed(virt, 1.0)
+    assert sum(a.fired_count for a in wall.alerts()) >= 1  # the burn bit
+    for wall_a, virt_a in zip(wall.alerts(), virt.alerts()):
+        assert wall_a.name == virt_a.name
+        assert wall_a.firing == virt_a.firing
+        assert wall_a.fired_count == virt_a.fired_count
+        assert wall_a.burn_short == pytest.approx(virt_a.burn_short)
+        assert wall_a.burn_long == pytest.approx(virt_a.burn_long)
+        assert wall_a.peak_burn == pytest.approx(virt_a.peak_burn)
+    wall_sum = wall.summary(wall_now)
+    virt_sum = virt.summary(virt_now)
+    for name in SLO_CATALOG:
+        assert wall_sum[name]["availability"] == pytest.approx(
+            virt_sum[name]["availability"]
+        )
+        assert wall_sum[name]["peak_burn"] == pytest.approx(
+            virt_sum[name]["peak_burn"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# SLI primitives
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantile_merge_semantics():
+    edges = (1.0, 5.0, 25.0)
+    # counts per le-edge plus the +Inf slot
+    assert histogram_quantile(edges, (0, 0, 0, 0), 0.99) == 0.0
+    assert histogram_quantile(edges, (10, 0, 0, 0), 0.99) == 1.0
+    assert histogram_quantile(edges, (5, 4, 1, 0), 0.5) == 1.0
+    assert histogram_quantile(edges, (5, 4, 1, 0), 0.99) == 25.0
+    assert histogram_quantile(edges, (0, 0, 0, 3), 0.5) == float("inf")
+    # mergeability: summing two nodes' counts quantiles like one node
+    a, b = (5, 4, 1, 0), (0, 0, 9, 1)
+    merged = tuple(x + y for x, y in zip(a, b))
+    assert histogram_quantile(edges, merged, 0.9) == 25.0
+
+
+def test_sli_tracker_window_edges_and_goodput():
+    tracker = SliTracker(bucket_ms=100, predicates=("availability",))
+    tracker.record_offered(150, 3)  # 3 offered, only 2 ever complete
+    tracker.record(150, 2.0, ["availability"])
+    tracker.record(199, 30.0, [])
+    w = tracker.window(1000, 1000)
+    assert (w.total, w.offered) == (2, 3)
+    assert w.availability("availability") == pytest.approx(0.5)
+    assert w.goodput_ratio("availability") == pytest.approx(1 / 3)
+    # the bucket [100,200) leaves a 1000ms window exactly at now=1200
+    assert tracker.window(1199, 1000).total == 2
+    assert tracker.window(1200, 1000).total == 0
+    # empty windows consume no budget and claim full goodput
+    empty = tracker.window(5000, 100)
+    assert empty.availability("availability") == 1.0
+    assert empty.goodput_ratio() == 1.0
+    assert empty.quantile(0.99) == 0.0
+
+
+def test_open_loop_generator_rebase_forward_only():
+    gen = OpenLoopGenerator(1000.0, [b"k"], seed=3)
+    first = gen.arrivals(5)
+    gen.rebase(10_000)
+    jumped = gen.next_arrival()
+    assert jumped.at_ms >= 10_000
+    gen.rebase(0)  # backward rebase is a no-op: arrivals stay monotone
+    nxt = gen.next_arrival()
+    assert nxt.at_ms >= jumped.at_ms
+    assert [a.at_ms for a in first] == sorted(a.at_ms for a in first)
+
+
+# ---------------------------------------------------------------------------
+# attribution
+# ---------------------------------------------------------------------------
+
+
+def _journal():
+    return [
+        {"kind": "fd_signal", "virtual_ms": 1000,
+         "detail": {"trace_id": 7}},
+        {"kind": "placement_rebalance", "virtual_ms": 1500,
+         "detail": {"configuration_id": 5, "moved": 41}},
+        {"kind": "view_install", "virtual_ms": 1600, "node": "n1",
+         "detail": {"trace_id": 7, "removed": 3, "added": 0,
+                    "configuration_id": 5}},
+    ]
+
+
+def test_episodes_fold_signal_install_and_rebalance():
+    episodes = episodes_from_journal(_journal())
+    assert len(episodes) == 1
+    ep = episodes[0]
+    assert (ep.kind, ep.trace_id) == ("view-change", 7)
+    assert (ep.start_ms, ep.end_ms) == (1000, 1600)
+    assert ep.nodes_evicted == 3 and ep.partitions_moved == 41
+    assert describe(ep) == (
+        "view-change episode 7 (3 nodes evicted, 41 partitions moved)"
+    )
+    # the JSON-line wire dialect parses identically
+    as_lines = [json.dumps(e) for e in _journal()]
+    assert episodes_from_journal(as_lines) == episodes
+
+
+def test_episodes_in_flight_and_recovery():
+    episodes = episodes_from_journal([
+        {"kind": "fd_signal", "virtual_ms": 900, "detail": {"trace_id": 3}},
+        {"kind": "durability_recovered", "virtual_ms": 400,
+         "detail": {"node": "n2"}},
+    ])
+    kinds = {e.kind: e for e in episodes}
+    assert kinds["view-change"].trace_id == 3
+    assert kinds["view-change"].end_ms == 900  # install not landed yet
+    assert describe(kinds["recovery"]) == "recovery replay on n2"
+
+
+def test_attribute_burn_largest_overlap_later_start_wins():
+    episodes = episodes_from_journal(_journal() + [
+        {"kind": "view_install", "virtual_ms": 5000,
+         "detail": {"trace_id": 9, "removed": 1, "configuration_id": 6}},
+    ])
+    # a burn window spanning both: episode 7 overlaps 600ms, 9 only 1ms
+    assert attribute_burn(episodes, 500, 4000).trace_id == 7
+    # a window over neither
+    assert attribute_burn(episodes, 10_000, 11_000) is None
+    # equal overlap (both instantaneous inside): later start wins
+    tie = episodes_from_journal([
+        {"kind": "view_install", "virtual_ms": 100, "detail": {"trace_id": 1}},
+        {"kind": "view_install", "virtual_ms": 200, "detail": {"trace_id": 2}},
+    ])
+    assert attribute_burn(tie, 0, 300).trace_id == 2
+    assert describe(None).startswith("unattributed")
+
+
+def test_plane_attributes_fired_alert_to_episode():
+    plane = SloPlane(SLOSettings(enabled=True, bucket_ms=1,
+                                 window_scale=0.001))
+    for t in range(0, 400):  # sustained total outage: everything fires
+        plane.record_offered(t)
+        plane.record(t, False, 500.0)
+        plane.tick(t, force=True)
+    assert plane.firing_count() >= 2
+    plane.attribute([
+        {"kind": "fd_signal", "virtual_ms": 50, "detail": {"trace_id": 7}},
+        {"kind": "view_install", "virtual_ms": 120, "node": "n0",
+         "detail": {"trace_id": 7, "removed": 1, "configuration_id": 2}},
+    ])
+    names, burns, firing, traces = plane.status_digest()
+    assert set(names) == {
+        f"{slo}:{w}" for slo in SLO_CATALOG for w in ("fast", "slow")
+    }
+    for name, burn, fire, trace in zip(names, burns, firing, traces):
+        if fire:
+            assert trace == 7, f"{name} fired unattributed"
+            assert burn > 0
+
+
+# ---------------------------------------------------------------------------
+# the metastable-recovery checker
+# ---------------------------------------------------------------------------
+
+
+def _op(invoke_ms, status=PutAck.STATUS_OK, op="put"):
+    return ClientOp(client="c", op=op, key=b"k", value=b"v", version=1,
+                    status=status, invoke_ms=invoke_ms,
+                    complete_ms=invoke_ms + 1)
+
+
+def test_metastable_recovery_passes_when_goodput_returns():
+    history = (
+        [_op(t) for t in range(0, 200, 10)]              # clean baseline
+        + [_op(t, PutAck.STATUS_RETRY) for t in range(1000, 1100, 10)]
+        + [_op(t) for t in range(3000, 3200, 10)]        # full recovery
+    )
+    check_metastable_recovery(
+        history, faulted_from_ms=1000, healed_at_ms=3000
+    )
+
+
+def test_metastable_recovery_kill_test_bites_on_stuck_goodput():
+    """The seeded kill-test: a history whose tail stays collapsed after
+    the faults cleared MUST trip the checker -- proof the invariant is
+    live, not vacuous."""
+    history = (
+        [_op(t) for t in range(0, 200, 10)]
+        + [_op(t, PutAck.STATUS_RETRY) for t in range(3000, 3200, 10)]
+    )
+    with pytest.raises(InvariantViolation) as err:
+        check_metastable_recovery(
+            history, faulted_from_ms=1000, healed_at_ms=3000
+        )
+    assert err.value.invariant == "metastable-recovery"
+    assert "did not recover" in str(err.value)
+
+
+def test_metastable_recovery_vacuous_cases():
+    # too few ops in either segment: no claim
+    thin = [_op(0)] + [_op(3000, PutAck.STATUS_RETRY)]
+    check_metastable_recovery(thin, faulted_from_ms=1000, healed_at_ms=2000)
+    # baseline already degraded below the floor: judges recovery only
+    degraded = (
+        [_op(t, PutAck.STATUS_RETRY) for t in range(0, 200, 10)]
+        + [_op(t, PutAck.STATUS_RETRY) for t in range(3000, 3200, 10)]
+    )
+    check_metastable_recovery(
+        degraded, faulted_from_ms=1000, healed_at_ms=3000
+    )
+    # NOT_FOUND counts as good for reads, bad for writes
+    reads = [_op(t, PutAck.STATUS_NOT_FOUND, op="get")
+             for t in range(0, 200, 10)]
+    writes = [_op(t, PutAck.STATUS_NOT_FOUND) for t in range(3000, 3200, 10)]
+    with pytest.raises(InvariantViolation):
+        check_metastable_recovery(
+            reads + writes, faulted_from_ms=1000, healed_at_ms=3000
+        )
+
+
+def test_goodput_samples_folds_history_on_grid():
+    history = [
+        _op(0), _op(100), _op(300, PutAck.STATUS_RETRY),
+        _op(300, PutAck.STATUS_NOT_FOUND, op="get"),
+    ]
+    assert goodput_samples(history, bucket_ms=256) == [
+        (0, 2, 2), (256, 2, 1),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the wire and the service
+# ---------------------------------------------------------------------------
+
+SLO_STATUS = ClusterStatusResponse(
+    sender=Endpoint.from_parts("10.0.0.1", 4000),
+    configuration_id=11, membership_size=3,
+    slo_names=("serving.latency:fast",),
+    slo_burn_milli=(42_100,),
+    slo_firing=(1,),
+    slo_attributed_trace=(7,),
+)
+
+
+def test_slo_digest_round_trips_both_transports():
+    assert decode(encode(9, SLO_STATUS)) == (9, SLO_STATUS)
+    wire = gt.to_wire_response(SLO_STATUS).SerializeToString(
+        deterministic=True
+    )
+    back = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert back == SLO_STATUS
+    # a pre-SLO frame decodes to the empty defaults on both transports
+    old = ClusterStatusResponse(
+        sender=SLO_STATUS.sender, configuration_id=11, membership_size=3,
+    )
+    assert decode(encode(9, old))[1].slo_names == ()
+
+
+def _status(h, probe, target):
+    p = probe.send_message(target, ClusterStatusRequest(
+        sender=probe.address,
+    ))
+    assert h.scheduler.run_until(p.done, timeout_ms=60_000)
+    assert p.exception() is None, p.exception()
+    return p.peek()
+
+
+def _serving_cluster(seed, settings):
+    h = ClusterHarness(seed=seed, settings=settings)
+    placement = {"partitions": 16, "replicas": 3, "seed": 7}
+    h.start_seed(0, placement=placement, serving=True,
+                 handoff=InMemoryPartitionStore)
+    for i in range(1, 3):
+        h.join(i, placement=placement, serving=True,
+               handoff=InMemoryPartitionStore)
+    h.wait_and_verify_agreement(3)
+    return h
+
+
+def test_cluster_status_carries_slo_digest_when_enabled():
+    settings = Settings(slo=SLOSettings(enabled=True, window_scale=0.001))
+    h = _serving_cluster(21, settings)
+    try:
+        cluster = h.instances[h.addr(0)]
+        for j in range(12):
+            promise = cluster.serving_put(b"k%d" % j, b"v%d" % j)
+            assert h.scheduler.run_until(promise.done, timeout_ms=60_000)
+            assert promise.peek().status == PutAck.STATUS_OK
+        probe = InProcessClient(
+            Endpoint.from_parts("127.0.0.1", 9997), h.network, h.settings
+        )
+        reply = _status(h, probe, h.addr(0))
+        assert set(reply.slo_names) == {
+            f"{slo}:{w}" for slo in SLO_CATALOG for w in ("fast", "slow")
+        }
+        assert len(reply.slo_burn_milli) == len(reply.slo_names)
+        assert len(reply.slo_firing) == len(reply.slo_names)
+        assert len(reply.slo_attributed_trace) == len(reply.slo_names)
+        # healthy serving traffic: nothing fires, burns stay at zero
+        assert set(reply.slo_firing) == {0}
+        assert all(b == 0 for b in reply.slo_burn_milli)
+    finally:
+        h.shutdown()
+
+
+def test_cluster_status_slo_kill_switch_off_is_pre_slo_surface():
+    h = _serving_cluster(22, Settings())  # default: slo disabled
+    try:
+        cluster = h.instances[h.addr(0)]
+        promise = cluster.serving_put(b"k", b"v")
+        assert h.scheduler.run_until(promise.done, timeout_ms=60_000)
+        probe = InProcessClient(
+            Endpoint.from_parts("127.0.0.1", 9996), h.network, h.settings
+        )
+        reply = _status(h, probe, h.addr(0))
+        assert reply.slo_names == ()
+        assert reply.slo_burn_milli == ()
+        assert reply.slo_firing == ()
+        assert reply.slo_attributed_trace == ()
+    finally:
+        h.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# catalogs and tools
+# ---------------------------------------------------------------------------
+
+
+def test_catalogs_are_consistent():
+    for name, spec in SLO_CATALOG.items():
+        assert spec["sli"] in SLI_CATALOG, name
+        assert 0.0 < spec["objective"] < 1.0, name
+        assert spec["windows"], name
+        for w in spec["windows"]:
+            assert w in BURN_WINDOWS, name
+        if spec["sli"] == "fast-availability":
+            assert spec["latency_threshold_ms"] > 0, name
+    for pair in BURN_WINDOWS.values():
+        assert 0 < pair["short_s"] < pair["long_s"]
+        assert pair["burn"] > 0
+
+
+def test_tools_slo_renders_burning_and_ok_lines():
+    from tools.slo import render_slo, to_json
+
+    journal = tuple(json.dumps(e) for e in [
+        {"kind": "fd_signal", "virtual_ms": 50, "detail": {"trace_id": 7}},
+        {"kind": "view_install", "virtual_ms": 120, "node": "n0",
+         "detail": {"trace_id": 7, "removed": 3, "configuration_id": 2}},
+        {"kind": "placement_rebalance", "virtual_ms": 110,
+         "detail": {"configuration_id": 2, "moved": 41}},
+    ])
+    status = ClusterStatusResponse(
+        sender=SLO_STATUS.sender, configuration_id=11, membership_size=3,
+        journal=journal,
+        slo_names=("serving.latency:fast", "serving.availability:fast"),
+        slo_burn_milli=(42_100, 150),
+        slo_firing=(1, 0),
+        slo_attributed_trace=(7, 0),
+    )
+    text = render_slo(status)
+    lines = text.splitlines()
+    # firing alerts sort first and carry the full attribution sentence
+    assert "SLO burning: p99 latency (serving.latency:fast, burn 42.1x)" \
+        in lines[1]
+    assert "view-change episode 7 (3 nodes evicted, 41 partitions moved)" \
+        in lines[1]
+    assert "SLO ok: availability" in lines[2]
+
+    doc = to_json(status)
+    assert doc["firing"] == 1
+    assert doc["alerts"]["serving.latency:fast"]["attributed_trace"] == 7
+
+    # a kill-switch-off node renders the explicit off notice
+    off = ClusterStatusResponse(
+        sender=SLO_STATUS.sender, configuration_id=11, membership_size=3,
+    )
+    assert "settings.slo.enabled is off" in render_slo(off)

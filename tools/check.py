@@ -73,6 +73,9 @@ RULE_DOCS = {
     "metric-emission": "every METRIC_CATALOG name needs an emitting call "
                        "site and every emission a catalog entry, or the "
                        "catalog and the dashboards drift apart",
+    "slo-catalog": "every declared SLO must name a cataloged SLI and a "
+                   "valid window pair with sane thresholds, or the burn "
+                   "alerts evaluate garbage",
     # tools/check.py -- concurrency hygiene
     "thread-daemon": "a non-daemon thread outlives shutdown and hangs exit; "
                      "mark daemon=True or provably join it",
@@ -631,6 +634,7 @@ SETTINGS_GROUPS = {
     "adaptive_fd": "AdaptiveFdSettings",
     "profiling": "ProfilingSettings",
     "durability": "DurabilitySettings",
+    "slo": "SLOSettings",
 }
 
 
@@ -793,6 +797,84 @@ def check_metric_emission() -> list[Finding]:
                 path, lineno, "metric-emission",
                 f"emitted metric {name!r} is not in "
                 "observability.METRIC_CATALOG",
+            ))
+    return findings
+
+
+def check_slo_catalog() -> list[Finding]:
+    """SLO-target catalog lint over rapid_tpu/slo/burn.py.
+
+    SLI_CATALOG / BURN_WINDOWS / SLO_CATALOG are pure module literals so
+    this check reads them by AST, never importing the package. Every
+    declared SLO must name a cataloged SLI, carry an objective strictly
+    inside (0, 1) (an objective of 1.0 leaves zero error budget and the
+    burn-rate division blows up), and reference only declared window
+    pairs; every window pair must have 0 < short_s < long_s and a positive
+    burn threshold; every fast-availability SLO must declare a positive
+    latency_threshold_ms (the predicate is meaningless without one)."""
+    findings: list[Finding] = []
+    path = REPO / "rapid_tpu" / "slo" / "burn.py"
+    wanted = {"SLI_CATALOG", "BURN_WINDOWS", "SLO_CATALOG"}
+    lits = _module_literals(path, wanted)
+    for name in sorted(wanted - set(lits)):
+        findings.append(Finding(
+            path, 0, "slo-catalog",
+            f"{name} not found or not a pure literal",
+        ))
+    if len(lits) != len(wanted):
+        return findings
+    slis, sli_line = lits["SLI_CATALOG"]
+    windows, win_line = lits["BURN_WINDOWS"]
+    slos, slo_line = lits["SLO_CATALOG"]
+
+    for pair, spec in sorted(windows.items()):
+        short_s, long_s = spec.get("short_s", 0), spec.get("long_s", 0)
+        if not (0 < short_s < long_s):
+            findings.append(Finding(
+                path, win_line, "slo-catalog",
+                f"BURN_WINDOWS[{pair!r}] needs 0 < short_s < long_s, "
+                f"got ({short_s}, {long_s})",
+            ))
+        if spec.get("burn", 0) <= 0:
+            findings.append(Finding(
+                path, win_line, "slo-catalog",
+                f"BURN_WINDOWS[{pair!r}] burn threshold must be positive",
+            ))
+    for name, spec in sorted(slos.items()):
+        sli = spec.get("sli")
+        if sli not in slis:
+            findings.append(Finding(
+                path, slo_line, "slo-catalog",
+                f"SLO_CATALOG[{name!r}] names SLI {sli!r}, not in "
+                "SLI_CATALOG",
+            ))
+        objective = spec.get("objective", 0)
+        if not (0.0 < objective < 1.0):
+            findings.append(Finding(
+                path, slo_line, "slo-catalog",
+                f"SLO_CATALOG[{name!r}] objective {objective!r} must be "
+                "strictly inside (0, 1)",
+            ))
+        declared = spec.get("windows", ())
+        if not declared:
+            findings.append(Finding(
+                path, slo_line, "slo-catalog",
+                f"SLO_CATALOG[{name!r}] declares no window pairs",
+            ))
+        for pair in declared:
+            if pair not in windows:
+                findings.append(Finding(
+                    path, slo_line, "slo-catalog",
+                    f"SLO_CATALOG[{name!r}] references window pair "
+                    f"{pair!r}, not in BURN_WINDOWS",
+                ))
+        if sli == "fast-availability" and not (
+            spec.get("latency_threshold_ms", 0) > 0
+        ):
+            findings.append(Finding(
+                path, slo_line, "slo-catalog",
+                f"SLO_CATALOG[{name!r}] is a fast-availability SLO but "
+                "declares no positive latency_threshold_ms",
             ))
     return findings
 
@@ -980,6 +1062,7 @@ def run(paths: "list[str] | None" = None) -> list[Finding]:
     findings.extend(check_generator_reach())
     findings.extend(check_settings_catalog())
     findings.extend(check_metric_emission())
+    findings.extend(check_slo_catalog())
     findings.extend(check_plan_corpus())
     return findings
 

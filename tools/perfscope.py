@@ -17,7 +17,10 @@ prints): headline wall, per-size sweep walls, and compile counts, with a
 regression threshold (rc 3 when the new artifact is slower beyond it).
 
 ``check`` -- gate one bench artifact against BASELINE.json's north-star
-budget (rc 3 on breach), the CI-shaped form of the same comparison.
+budget plus the per-dimension budget table (DIMENSION_BUDGETS: serving
+tail latency, lost acked writes, SLO availability/goodput and firing burn
+alerts, messaging throughput, gray-detection speedup), rc 3 on any
+breach -- the CI-shaped form of the same comparison.
 
     python tools/perfscope.py render metrics.json
     python tools/perfscope.py diff old_bench.json new_bench.json
@@ -205,6 +208,82 @@ def load_bench_artifact(path: str) -> dict:
     raise ValueError(f"{path}: no bench JSON artifact line found")
 
 
+# Per-dimension budgets for the ``check`` subcommand, beyond the headline
+# north-star gate. Each row is (dimension, path, op, limit): ``path`` walks
+# the bench artifact dict; a row whose path is absent is skipped (partial
+# or outage artifacts gate only on what they carry), a present leaf must
+# satisfy ``op limit`` or check exits 3. Limits are deliberately loose
+# floors/ceilings -- they catch order-of-magnitude regressions and
+# invariant breaks (lost acked writes, an SLO burn alert still firing at
+# end of run), not machine-to-machine jitter; ``diff`` is the tool for
+# relative drift.
+DIMENSION_BUDGETS: Tuple[Tuple[str, Tuple[str, ...], str, float], ...] = (
+    ("serving", ("serving_qps", "steady", "p99_ms"), "<=", 25.0),
+    ("serving", ("serving_qps", "lost_acked_writes"), "<=", 0.0),
+    ("serving", ("serving_qps", "throughput_qps"), ">=", 100.0),
+    ("slo", ("serving_qps", "slo", "serving.availability", "availability"),
+     ">=", 0.99),
+    ("slo", ("serving_qps", "slo", "serving.availability", "goodput_ratio"),
+     ">=", 0.95),
+    ("slo", ("serving_qps", "slo", "serving.latency", "alerts", "fast",
+             "firing"), "<=", 0.0),
+    ("messaging", ("messaging_throughput", "broadcast_storm",
+                   "messages_per_s"), ">=", 1.0),
+    ("gray", ("gray_detection_ms", "gray_slow_node", "speedup"), ">=", 2.0),
+    ("gray", ("gray_detection_ms", "gray_flapping", "speedup"), ">=", 2.0),
+)
+
+_BUDGET_OPS = {
+    "<=": lambda got, limit: got <= limit,
+    ">=": lambda got, limit: got >= limit,
+}
+
+
+def _walk(doc: object, path: Tuple[str, ...]) -> Optional[float]:
+    """Dict-walk ``path`` into a bench artifact; numeric leaf (bools count
+    as 0/1) or None when any step is missing."""
+    node = doc
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    if isinstance(node, (bool, int, float)):
+        return float(node)
+    return None
+
+
+def check_budgets(doc: dict, budget_ms: float = NORTH_STAR_BUDGET_MS
+                  ) -> Tuple[List[str], List[str]]:
+    """The headline north-star gate plus every DIMENSION_BUDGETS row whose
+    path the artifact carries; (report lines, breach descriptions)."""
+    lines: List[str] = []
+    breaches: List[str] = []
+    value = doc.get("value")
+    if value is not None:
+        verdict = "within" if value <= budget_ms else "OVER"
+        lines.append(
+            f"headline {value:.1f} ms vs budget {budget_ms:.0f} ms "
+            f"({value / budget_ms * 100.0:.1f}%): {verdict}"
+        )
+        if value > budget_ms:
+            breaches.append(f"headline {value:.1f} ms > {budget_ms:.0f} ms")
+    for dimension, path, op, limit in DIMENSION_BUDGETS:
+        got = _walk(doc, path)
+        if got is None:
+            continue  # dimension absent from this artifact: nothing to gate
+        label = ".".join(path)
+        ok = _BUDGET_OPS[op](got, limit)
+        lines.append(
+            f"{dimension:<9} {label} = {got:g} (budget {op} {limit:g}): "
+            f"{'within' if ok else 'OVER'}"
+        )
+        if not ok:
+            breaches.append(
+                f"{dimension}: {label} = {got:g}, budget {op} {limit:g}"
+            )
+    return lines, breaches
+
+
 def diff_artifacts(old: dict, new: dict,
                    threshold: float = DEFAULT_THRESHOLD) -> Tuple[str, List[str]]:
     """Human-readable diff of two bench artifacts plus the list of
@@ -281,7 +360,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"(default {DEFAULT_THRESHOLD})")
 
     p_check = sub.add_parser(
-        "check", help="gate one bench artifact against BASELINE.json"
+        "check", help="gate one bench artifact against the north-star "
+        "budget and the per-dimension budget table"
     )
     p_check.add_argument("artifact")
     p_check.add_argument("--budget-ms", type=float, default=NORTH_STAR_BUDGET_MS,
@@ -311,17 +391,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # check
     doc = load_bench_artifact(args.artifact)
-    value = doc.get("value")
-    if value is None:
+    if doc.get("value") is None:
         print(f"{args.artifact}: no headline value (outage artifact?)",
               file=sys.stderr)
         return 2
-    verdict = "within" if value <= args.budget_ms else "OVER"
-    print(
-        f"headline {value:.1f} ms vs budget {args.budget_ms:.0f} ms "
-        f"({value / args.budget_ms * 100.0:.1f}%): {verdict}"
-    )
-    return 3 if value > args.budget_ms else 0
+    lines, breaches = check_budgets(doc, args.budget_ms)
+    for line in lines:
+        print(line)
+    for breach in breaches:
+        print(f"BUDGET BREACH: {breach}", file=sys.stderr)
+    return 3 if breaches else 0
 
 
 if __name__ == "__main__":

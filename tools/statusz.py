@@ -93,6 +93,22 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
             f" snapshot={status.durability_snapshot_version}"
             f" replayed={status.durability_replayed}"
         )
+    # SLO digest: one line per (SLO, window pair) alert -- burn rate in
+    # budget multiples, FIRING flag, and the attributed churn episode's
+    # trace id when the plane correlated one against the journal
+    if status.slo_names:
+        alerts = " ".join(
+            "{name}={burn:.2f}x{firing}{trace}".format(
+                name=name, burn=burn_milli / 1000.0,
+                firing=" FIRING" if firing else "",
+                trace=f"(episode {trace})" if trace else "",
+            )
+            for name, burn_milli, firing, trace in zip(
+                status.slo_names, status.slo_burn_milli,
+                status.slo_firing, status.slo_attributed_trace,
+            )
+        )
+        lines.append(f"  slo: {alerts}")
     # failure-detector digest: the node's worst monitored edges (already
     # sorted suspicion desc, RTT desc by the service), the gray-failure
     # signature an operator checks before any eviction shows up
@@ -205,6 +221,17 @@ def to_json(status: ClusterStatusResponse) -> dict:
             for tier, interval, threshold, flush in zip(
                 status.fd_tiers, status.fd_tier_interval_ms,
                 status.fd_tier_threshold, status.fd_tier_flush_ms,
+            )
+        },
+        "slo_alerts": {
+            name: {
+                "burn": burn_milli / 1000.0,
+                "firing": bool(firing),
+                "attributed_trace": trace,
+            }
+            for name, burn_milli, firing, trace in zip(
+                status.slo_names, status.slo_burn_milli,
+                status.slo_firing, status.slo_attributed_trace,
             )
         },
         "metrics": dict(zip(status.metric_names, status.metric_values)),
