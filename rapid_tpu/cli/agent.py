@@ -151,6 +151,20 @@ def main() -> None:
         "this node saw",
     )
     parser.add_argument(
+        "--forensics", action="store_true",
+        help="enable the forensics plane: HLC stamps on every message and "
+        "journal entry, burn-alert evidence capture, and crash/exit "
+        "journal hooks (with --journal-out, the dump also happens via "
+        "atexit + a faulthandler traceback file for hard crashes)",
+    )
+    parser.add_argument(
+        "--bundle-out",
+        help="path written on shutdown with a cluster-wide incident "
+        "evidence bundle (implies --forensics): this agent's evidence plus "
+        "a status sweep of every reachable member; feed the file to "
+        "tools/forensics.py report",
+    )
+    parser.add_argument(
         "--serving", action="store_true",
         help="demo mode: enable the serving plane (replicated Get/Put KV "
         "over placement + handoff) on this agent; every status tick writes "
@@ -184,6 +198,14 @@ def main() -> None:
         fd_window=args.fd_window,
         fd_window_threshold=args.fd_window_threshold,
     )
+    if args.forensics or args.bundle_out:
+        import dataclasses
+
+        from rapid_tpu.settings import ForensicsSettings
+
+        settings = dataclasses.replace(
+            settings, forensics=ForensicsSettings(enabled=True)
+        )
     if args.transport == "grpc":
         if args.gateway_address:
             parser.error(
@@ -232,6 +254,10 @@ def main() -> None:
         .add_subscription(ClusterEvents.VIEW_CHANGE, on_event("VIEW_CHANGE"))
         .add_subscription(ClusterEvents.KICKED, on_event("KICKED"))
     )
+    if settings.forensics.enabled and args.journal_out:
+        # crash/exit evidence: atexit journal dump + faulthandler traceback
+        # file beside it, in addition to the explicit dump on shutdown below
+        builder.use_forensics_dump(args.journal_out)
     if args.serving:
         from rapid_tpu.handoff.store import InMemoryPartitionStore
 
@@ -292,6 +318,14 @@ def main() -> None:
             if args.metrics_out:
                 _write_prometheus_atomic(args.metrics_out)
     except KeyboardInterrupt:
+        if args.bundle_out:
+            # capture while the cluster is still a member: the sweep needs
+            # live peers, so it runs before the graceful leave
+            try:
+                cluster.capture_bundle(args.bundle_out)
+                log.info("wrote evidence bundle to %s", args.bundle_out)
+            except Exception as exc:  # noqa: BLE001 -- still leave cleanly
+                log.warning("bundle capture failed: %s", exc)
         cluster.leave_gracefully()
     finally:
         if args.trace_out:

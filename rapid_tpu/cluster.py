@@ -20,6 +20,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .cut_detector import MultiNodeCutDetector
 from .events import ClusterEvents
+from .forensics.bundle import install_exit_hooks, write_bundle
+from .forensics.hlc import HlcClock, HlcStampingClient
 from .handoff.store import PartitionStore
 from .membership import MembershipView
 from .messaging.base import IMessagingClient, IMessagingServer
@@ -111,6 +113,42 @@ class Cluster:
         """The node's event journal; deliberately NOT gated on running so a
         post-mortem can dump it after shutdown."""
         return self._membership_service.recorder
+
+    def capture_bundle(self, path: Optional[str] = None, *,
+                       trigger: str = "explicit",
+                       detail: Optional[Dict[str, object]] = None,
+                       ) -> Dict[str, object]:
+        """Capture a cluster-wide incident evidence bundle (forensics
+        plane): this node's full evidence plus a status-RPC sweep of every
+        other member, each bounded by
+        ``settings.forensics.bundle_member_timeout_ms`` -- unreachable
+        members are named in the manifest, never waited on. When ``path``
+        is given the bundle is also written atomically (tmp +
+        ``os.replace``). Feed the file(s) to ``tools/forensics.py report``
+        for the HLC-ordered timeline and anomaly-signature verdicts."""
+        self._check_running()
+        bundle = self._membership_service.capture_cluster_bundle(
+            trigger, detail
+        )
+        if path is not None:
+            write_bundle(bundle, path)
+        return bundle
+
+    def capture_bundle_async(self, *, trigger: str = "explicit",
+                             detail: Optional[Dict[str, object]] = None,
+                             ) -> Promise:
+        """Non-blocking capture (virtual-time clusters drive this form and
+        pump the scheduler until the promise completes)."""
+        self._check_running()
+        return self._membership_service.capture_cluster_bundle_async(
+            trigger, detail
+        )
+
+    @property
+    def last_bundle(self) -> Optional[Dict[str, object]]:
+        """The most recent bundle an automatic trigger (e.g. a burn alert)
+        pinned on this node; NOT gated on running, like the recorder."""
+        return self._membership_service.last_bundle
 
     def register_subscription(
         self, event: ClusterEvents, callback: SubscriptionCallback
@@ -230,6 +268,7 @@ class ClusterBuilder:
         self._serving = False
         self._tier_resolver: Optional[Callable[[Endpoint], str]] = None
         self._durability_dir: Optional[str] = None
+        self._forensics_dump: Optional[str] = None
 
     def set_metadata(self, metadata: Dict[str, bytes]) -> "ClusterBuilder":
         self._metadata = tuple(sorted(metadata.items()))
@@ -362,6 +401,52 @@ class ClusterBuilder:
         self._handoff_store = store
         return store
 
+    def use_forensics_dump(self, journal_path: str) -> "ClusterBuilder":
+        """Register crash/exit evidence hooks (forensics plane): an atexit
+        dump of the flight-recorder journal to ``journal_path`` (atomic:
+        tmp + ``os.replace``) plus a faulthandler traceback file beside it
+        (``journal_path + ".crash"``) for hard crashes that never reach
+        atexit. Inert unless ``settings.forensics.enabled``."""
+        self._forensics_dump = journal_path
+        return self
+
+    def _forensics(
+        self, resources: SharedResources, client: IMessagingClient,
+        durable,
+    ) -> Tuple[Optional[HlcClock], IMessagingClient,
+               Optional[FlightRecorder]]:
+        """Forensics-plane assembly, shared by ``start`` and ``join_async``.
+
+        When ``settings.forensics.enabled``: mint this node's hybrid
+        logical clock (physical axis = the node's scheduler clock, so
+        virtual-time runs are deterministic and a nemesis clock-skew
+        scheduler skews the HLC with the node; incarnation = the durable
+        store's persisted boot count when one exists), wrap the messaging
+        client so every outbound message carries a fresh stamp, and build
+        the HLC-stamping flight recorder at the configured capacity. When
+        off: (None, client, None) -- the exact pre-forensics path, byte
+        for byte on the wire."""
+        if not self._settings.forensics.enabled:
+            return None, client, None
+        incarnation = 1
+        if durable is not None:
+            bump = getattr(durable, "bump_incarnation", None)
+            if bump is not None:
+                incarnation = max(1, int(bump()))
+        hlc = HlcClock(
+            clock=resources.scheduler.now_ms, incarnation=incarnation
+        )
+        recorder = FlightRecorder(
+            node=str(self._listen_address),
+            clock=resources.scheduler.now_ms,
+            capacity=self._settings.forensics.journal_capacity,
+            hlc=hlc,
+            metrics=self._metrics,
+        )
+        if self._forensics_dump:
+            install_exit_hooks(recorder, self._forensics_dump)
+        return hlc, HlcStampingClient(client, hlc), recorder
+
     def set_broadcaster_factory(self, factory) -> "ClusterBuilder":
         """Swap the dissemination strategy: ``factory(client, rng)`` returns
         the IBroadcaster this node's service uses (default:
@@ -443,6 +528,11 @@ class ClusterBuilder:
         """Bootstrap a seed node (Cluster.java:255-280)."""
         resources, client, server, rng = self._prepare()
         durable = self._durable_store()
+        # forensics plane (kill-switched): HLC-stamping client wrapper plus
+        # the HLC-stamping recorder; (None, client, None) when off
+        hlc, client, forensics_recorder = self._forensics(
+            resources, client, durable
+        )
         # restart-aware identity: a seed that persisted its NodeId boots
         # with the same identity it had before the restart
         node_id = durable.node_id if durable is not None else None
@@ -467,13 +557,18 @@ class ClusterBuilder:
             broadcaster=self._broadcaster(client, rng),
             metrics=self._metrics,
             tracer=self._tracer,
-            recorder=FlightRecorder(
-                node=str(self._listen_address),
-                clock=resources.scheduler.now_ms,
+            recorder=(
+                forensics_recorder
+                if forensics_recorder is not None
+                else FlightRecorder(
+                    node=str(self._listen_address),
+                    clock=resources.scheduler.now_ms,
+                )
             ),
             placement=self._placement,
             handoff_store=self._handoff_store,
             serving=self._serving,
+            hlc=hlc,
         )
         if durable is not None:
             durable.set_identity(node_id)
@@ -496,6 +591,11 @@ class ClusterBuilder:
         server.start()
         result: Promise = Promise()
         durable = self._durable_store()
+        # forensics plane (kill-switched): stamp the join traffic too, so
+        # a seed's causal timeline includes the joiner's first messages
+        hlc, client, forensics_recorder = self._forensics(
+            resources, client, durable
+        )
         # Restart-aware rejoin: reuse the persisted NodeId. A returning
         # host still present in the ring then gets HOSTNAME_ALREADY_IN_RING
         # in phase 1 and SAFE_TO_JOIN from observers that recognize the
@@ -513,8 +613,13 @@ class ClusterBuilder:
         # the flight recorder outlives individual join attempts: created here
         # so retry exhaustion is journaled even when no service ever exists,
         # then handed to the MembershipService on success
-        recorder = FlightRecorder(
-            node=str(self._listen_address), clock=resources.scheduler.now_ms
+        recorder = (
+            forensics_recorder
+            if forensics_recorder is not None
+            else FlightRecorder(
+                node=str(self._listen_address),
+                clock=resources.scheduler.now_ms,
+            )
         )
 
         def fail_all(reason: str) -> None:
@@ -630,6 +735,7 @@ class ClusterBuilder:
                 placement=self._placement,
                 handoff_store=self._handoff_store,
                 serving=self._serving,
+                hlc=hlc,
             )
             if durable is not None:
                 durable.set_identity(state["node_id"])

@@ -37,6 +37,7 @@ _SNAP_SUFFIX = ".bin"
 
 META_NODE_ID = "node_id"
 META_CONFIG_ID = "config_id"
+META_INCARNATION = "incarnation"
 
 
 class DurablePartitionStore(PartitionStore):
@@ -250,6 +251,23 @@ class DurablePartitionStore(PartitionStore):
 
     def set_config_id(self, config_id: int) -> None:
         self._set_meta(META_CONFIG_ID, _CONFIG_ID.pack(config_id))
+
+    @property
+    def incarnation(self) -> int:
+        """Boot count persisted in the WAL meta (0 before the first
+        ``bump_incarnation``). The forensics HLC stamps it so a restarted
+        member's fresh clock is never mistaken for a regression of its
+        previous life (PR 17 incarnation-seq discipline)."""
+        raw = self._meta.get(META_INCARNATION)
+        if raw is None or len(raw) != _CONFIG_ID.size:
+            return 0
+        return int(_CONFIG_ID.unpack(raw)[0])
+
+    def bump_incarnation(self) -> int:
+        """Advance and persist the boot count; returns the new value."""
+        nxt = self.incarnation + 1
+        self._set_meta(META_INCARNATION, _CONFIG_ID.pack(nxt))
+        return nxt
 
     @property
     def config_id(self) -> Optional[int]:

@@ -1,0 +1,54 @@
+"""Forensics plane: hybrid logical clocks, incident evidence bundles, and
+causal cluster timelines.
+
+Three layers, each usable on its own:
+
+``hlc``
+    Hybrid logical clocks (physical-ms, logical counter). Stamped on every
+    outbound message next to the ``__tc`` trace sidecar and merged on
+    receive, so journal events from wall-clock-skewed nodes still order
+    causally. The whole layer rides the same reserved-key / append-only
+    proto-field pattern the trace context uses: with the forensics kill
+    switch off the wire bytes are unchanged.
+
+``bundle``
+    Incident evidence capture: journal tails, metric-history rings, SLO
+    digest, trace spans, and config/view ids from every reachable member,
+    written atomically with a manifest fingerprint. Triggered by SLO burn
+    alerts, search-plane invariant violations, crash/dump paths, or an
+    explicit ``Cluster.capture_bundle()`` / ``agent --bundle-out``.
+
+``timeline``
+    Merge one or more bundles into a single HLC-ordered cluster timeline
+    and run the anomaly-signature detectors over it (``tools/forensics.py``
+    is the CLI face).
+"""
+
+from .hlc import HlcClock, HlcStamp, hlc_of, stamp_hlc
+from .bundle import (
+    BUNDLE_SCHEMA_VERSION,
+    bundle_fingerprint,
+    capture_local_evidence,
+    write_bundle,
+)
+from .timeline import (
+    SIGNATURE_CATALOG,
+    TimelineEvent,
+    detect_signatures,
+    merge_timeline,
+)
+
+__all__ = [
+    "HlcClock",
+    "HlcStamp",
+    "hlc_of",
+    "stamp_hlc",
+    "BUNDLE_SCHEMA_VERSION",
+    "bundle_fingerprint",
+    "capture_local_evidence",
+    "write_bundle",
+    "SIGNATURE_CATALOG",
+    "TimelineEvent",
+    "detect_signatures",
+    "merge_timeline",
+]

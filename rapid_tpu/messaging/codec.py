@@ -19,6 +19,7 @@ import msgpack
 
 from ..runtime.lockdep import make_lock
 from .. import types as T
+from ..forensics.hlc import HlcStamp, hlc_of, stamp_hlc
 from ..observability import TraceContext, stamp_trace_context, trace_context_of
 
 # Encode memo for LARGE tuples (a 100k-member JoinResponse's endpoint and
@@ -226,6 +227,12 @@ def encode(request_no: int, msg: Any) -> bytes:
         # "__"-prefixed top-level key, so peers that don't know this one
         # (or future reserved keys) parse the frame unchanged
         payload["__tc"] = ctx.to_wire()
+    hlc = hlc_of(msg)
+    if hlc is not None:
+        # same reserved-key discipline as "__tc": absent unless the
+        # forensics plane stamped the message, so with the kill switch off
+        # the frame is byte-identical to the pre-forensics encoding
+        payload["__hlc"] = hlc.to_wire()
     body = msgpack.packb(payload, use_bin_type=True)
     if len(body) >= _BODY_MEMO_MIN:
         global _body_memo_bytes
@@ -292,6 +299,9 @@ def encode_versioned(request_no: int, msg: Any, version: int) -> bytes:
     ctx = trace_context_of(msg)
     if ctx is not None and version >= 1:
         payload["__tc"] = ctx.to_wire()
+    hlc = hlc_of(msg)
+    if hlc is not None and version >= 1:
+        payload["__hlc"] = hlc.to_wire()
     body = msgpack.packb(payload, use_bin_type=True)
     return ENVELOPE.pack(request_no, tag) + body
 
@@ -310,9 +320,11 @@ def decode(frame: bytes) -> Tuple[int, Any]:
     cls = _TYPES[tag]
     raw = msgpack.unpackb(frame[ENVELOPE.size :], raw=False)
     # "__"-prefixed top-level keys are envelope extensions (today: "__tc"
-    # trace context), not dataclass fields -- strip them all so frames from
-    # newer peers always construct cleanly
+    # trace context and "__hlc" hybrid-logical-clock stamps), not dataclass
+    # fields -- strip them all so frames from newer peers always construct
+    # cleanly
     tc = raw.pop("__tc", None)
+    hlc = raw.pop("__hlc", None)
     kwargs = {
         name: _tupled(_dec(value))
         for name, value in raw.items()
@@ -321,4 +333,8 @@ def decode(frame: bytes) -> Tuple[int, Any]:
     msg = cls(**kwargs)
     if tc is not None:
         stamp_trace_context(msg, TraceContext.from_wire(tc))
+    if hlc is not None:
+        stamp = HlcStamp.from_wire(hlc)
+        if stamp is not None:
+            stamp_hlc(msg, stamp)
     return request_no, msg

@@ -22,6 +22,7 @@ import grpc.aio
 
 from ..runtime.lockdep import make_lock
 from .. import types as T
+from ..forensics.hlc import HlcStamp, hlc_of, stamp_hlc
 from ..observability import TraceContext, stamp_trace_context, trace_context_of
 from ..runtime.futures import Promise
 from ..settings import Settings
@@ -195,6 +196,12 @@ def to_wire_request(msg: T.RapidMessage):
         tc.parentSpanId = ctx.parent_span_id
         tc.origin = ctx.origin
         tc.flags = ctx.flags
+    stamp = hlc_of(msg)
+    if stamp is not None:
+        h = req.hlc
+        h.physicalMs = stamp.physical_ms
+        h.logical = stamp.logical
+        h.incarnation = stamp.incarnation
     return req
 
 
@@ -207,6 +214,13 @@ def from_wire_request(req) -> T.RapidMessage:
             parent_span_id=int(tc.parentSpanId),
             origin=str(tc.origin),
             flags=int(tc.flags),
+        ))
+    if req.HasField("hlc"):
+        h = req.hlc
+        stamp_hlc(msg, HlcStamp(
+            physical_ms=int(h.physicalMs),
+            logical=int(h.logical),
+            incarnation=max(1, int(h.incarnation)),
         ))
     return msg
 
@@ -384,6 +398,11 @@ def to_wire_response(msg) :
         s.sloBurnMilli.extend(msg.slo_burn_milli)
         s.sloFiring.extend(msg.slo_firing)
         s.sloAttributedTrace.extend(msg.slo_attributed_trace)
+        s.journalDropped = msg.journal_dropped
+        s.journalCapacity = msg.journal_capacity
+        s.hlcPhysicalMs = msg.hlc_physical_ms
+        s.hlcLogical = msg.hlc_logical
+        s.hlcIncarnation = msg.hlc_incarnation
     elif isinstance(msg, T.PutAck):
         a = resp.putAck
         a.sender.CopyFrom(_ep(msg.sender))
@@ -472,6 +491,11 @@ def from_wire_response(resp):
             slo_burn_milli=tuple(int(v) for v in m.sloBurnMilli),
             slo_firing=tuple(int(v) for v in m.sloFiring),
             slo_attributed_trace=tuple(int(v) for v in m.sloAttributedTrace),
+            journal_dropped=int(m.journalDropped),
+            journal_capacity=int(m.journalCapacity),
+            hlc_physical_ms=int(m.hlcPhysicalMs),
+            hlc_logical=int(m.hlcLogical),
+            hlc_incarnation=int(m.hlcIncarnation),
         )
     if which == "putAck":
         m = resp.putAck

@@ -107,6 +107,13 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("origin", "string", 3, False),
         ("flags", "int32", 4, False),
     ],
+    # forensics plane: hybrid logical clock stamp; rides outside the request
+    # oneof like TraceContext, so pre-forensics peers skip it natively
+    "HlcStamp": [
+        ("physicalMs", "int64", 1, False),
+        ("logical", "int64", 2, False),
+        ("incarnation", "int64", 3, False),
+    ],
     "ClusterStatusRequest": [
         ("sender", "M:Endpoint", 1, False),
         ("includeHistory", "int32", 2, False),
@@ -170,6 +177,15 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("sloBurnMilli", "int64", 38, True),
         ("sloFiring", "int64", 39, True),
         ("sloAttributedTrace", "int64", 40, True),
+        # forensics plane exposure: journal truncation accounting plus the
+        # node's current hybrid-logical-clock reading (append-only per the
+        # PR 3/13 pattern: old peers ignore 41+, new peers read zeros from
+        # old peers)
+        ("journalDropped", "int64", 41, False),
+        ("journalCapacity", "int64", 42, False),
+        ("hlcPhysicalMs", "int64", 43, False),
+        ("hlcLogical", "int64", 44, False),
+        ("hlcIncarnation", "int64", 45, False),
     ],
     "HandoffRequest": [
         ("sender", "M:Endpoint", 1, False),
@@ -235,6 +251,11 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
 # so a JVM peer's decoder skips it as an unknown field.
 TRACE_CTX_FIELD_NUMBER = 15
 
+# The hybrid-logical-clock stamp rides outside the oneof too: field 18,
+# the next number above the oneof's current maximum (17), reserved the
+# same way 15 is -- future oneof entries must skip both.
+HLC_FIELD_NUMBER = 18
+
 # The oneof envelopes (rapid.proto:21-45): (field, message type, number)
 _REQUEST_ONEOF = [
     ("preJoinMessage", "PreJoinMessage", 1),
@@ -250,9 +271,9 @@ _REQUEST_ONEOF = [
     ("clusterStatusRequest", "ClusterStatusRequest", 11),
     # 12/13 are handoff-plane extensions, 14/16 serving-plane extensions,
     # 17 the transport batch envelope; 15 is reserved for traceCtx
-    # (TRACE_CTX_FIELD_NUMBER), which rides outside the oneof -- the
-    # extension messages skip it, so the oneof is contiguous from 1 except
-    # for that one documented gap
+    # (TRACE_CTX_FIELD_NUMBER) and 18 for hlc (HLC_FIELD_NUMBER), both of
+    # which ride outside the oneof -- the extension messages skip them, so
+    # the oneof is contiguous from 1 except for those documented gaps
     ("handoffRequest", "HandoffRequest", 12),
     ("handoffAck", "HandoffAck", 13),
     ("get", "Get", 14),
@@ -355,6 +376,9 @@ def _build_file() -> descriptor_pb2.FileDescriptorProto:
         if envelope_name == "RapidRequest":
             msg.field.append(_field(
                 "traceCtx", "M:TraceContext", TRACE_CTX_FIELD_NUMBER, False,
+            ))
+            msg.field.append(_field(
+                "hlc", "M:HlcStamp", HLC_FIELD_NUMBER, False,
             ))
 
     service = file_proto.service.add()

@@ -38,7 +38,9 @@ import contextlib
 import contextvars
 import itertools
 import json
+import os
 import re
+import tempfile
 import threading
 import time
 import weakref
@@ -167,6 +169,8 @@ METRIC_CATALOG = frozenset({
     "durability.segments",          # live WAL segment count (gauge)
     "durability.replayed_records",  # log records replayed by last recovery
     "durability.torn_truncations",  # torn tails truncated at a bad record
+    # forensics plane (forensics/, observability.py)
+    "journal.dropped_events",  # flight-recorder entries lost to overflow
     # SLO plane (slo/)
     "slo.requests",        # requests scored by the SLI tracker
     "slo.offered",         # open-loop arrivals offered to the serving path
@@ -223,6 +227,7 @@ EVENT_CATALOG = frozenset({
     "durability_checkpoint",  # snapshot + marker written, old segments culled
     "slo_alert_fired",   # multi-window burn-rate alert started firing
     "slo_alert_cleared",  # burn rates fell back under the clear threshold
+    "bundle_captured",   # forensic evidence bundle written (trigger + path)
 })
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).
@@ -1143,21 +1148,53 @@ class FlightRecorder:
     a monotonic sequence number, the event kind (from ``EVENT_CATALOG``),
     wall-clock seconds, the node's virtual/scheduler milliseconds, and a
     small detail dict. The deque drops the oldest entry on overflow, so a
-    recorder can run forever. ``to_wire`` serializes the tail as JSON lines
-    (the form both the msgpack codec and the proto wire carry in
-    ``ClusterStatusResponse.journal``); ``dump`` writes the same lines to a
-    file on crash/exit."""
+    recorder can run forever; ``dropped`` counts those losses (and bills
+    the ``journal.dropped_events`` counter when a metrics registry is
+    attached) so evidence bundles report truncation instead of hiding it.
+    When the forensics plane wires an HLC clock, each entry also carries an
+    ``hlc`` coordinate (``[physical_ms, logical, incarnation]``) so skewed
+    nodes' journals merge into one causal timeline. ``to_wire`` serializes
+    the tail as JSON lines (the form both the msgpack codec and the proto
+    wire carry in ``ClusterStatusResponse.journal``); ``dump`` writes the
+    same lines to a file on crash/exit -- atomically, via tmp +
+    ``os.replace``, so a crash mid-dump never leaves a torn journal."""
 
     def __init__(self, capacity: int = DEFAULT_JOURNAL_CAPACITY,
                  node: str = "",
-                 clock: Optional[Callable[[], int]] = None) -> None:
+                 clock: Optional[Callable[[], int]] = None,
+                 hlc=None, metrics: Optional["Metrics"] = None) -> None:
         self.node = node
         self._clock = clock
+        # duck-typed forensics.hlc.HlcClock (kept import-free: this module
+        # is also loaded standalone by tools/check.py)
+        self._hlc = hlc
+        self._metrics = metrics
         self._seq = itertools.count(1)
         self._lock = make_lock("FlightRecorder._lock")
+        # guarded-by: _lock
+        self._dropped = 0
         self._events: "collections.deque[Dict[str, object]]" = (
             collections.deque(maxlen=max(1, capacity))
         )
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen or 0
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def hlc_now(self):
+        """The attached HLC clock's current stamp, or None when the
+        forensics plane is off."""
+        if self._hlc is None:
+            return None
+        try:
+            return self._hlc.peek()
+        except Exception:  # noqa: BLE001 -- forensics never loses the event
+            return None
 
     def record(self, kind: str, virtual_ms: Optional[int] = None,
                **detail: object) -> Dict[str, object]:
@@ -1174,8 +1211,18 @@ class FlightRecorder:
             "node": self.node,
             "detail": {str(k): v for k, v in detail.items()},
         }
+        if self._hlc is not None:
+            try:
+                entry["hlc"] = self._hlc.now().to_wire()
+            except Exception:  # noqa: BLE001 -- forensics never loses the event
+                pass
         with self._lock:
+            overflowing = len(self._events) == self._events.maxlen
             self._events.append(entry)
+            if overflowing:
+                self._dropped += 1
+        if overflowing and self._metrics is not None:
+            self._metrics.incr("journal.dropped_events")
         return entry
 
     def __len__(self) -> int:
@@ -1194,9 +1241,19 @@ class FlightRecorder:
         )
 
     def dump(self, path: str, n: Optional[int] = None) -> None:
-        with open(path, "w") as fh:
-            for line in self.to_wire(n):
-                fh.write(line + "\n")
+        directory = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(prefix=".journal-", dir=directory)
+        try:
+            with os.fdopen(fd, "w") as fh:
+                for line in self.to_wire(n):
+                    fh.write(line + "\n")
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
 
 # --------------------------------------------------------------------------- #

@@ -66,11 +66,26 @@ class _ThreadExecutor(ProtocolExecutor):
         self._thread.join(timeout=5)
 
 
+def _is_virtual(scheduler: Scheduler) -> bool:
+    """True when the scheduler is (or wraps, via an ``inner`` chain) a
+    VirtualScheduler -- e.g. a nemesis SkewedScheduler around the shared
+    virtual clock. Such a node must serialize protocol tasks through the
+    scheduler, not a real thread: a thread races the virtual clock, which
+    jumps past RPC deadlines before the thread completes the response."""
+    seen = 0
+    while scheduler is not None and seen < 8:
+        if isinstance(scheduler, VirtualScheduler):
+            return True
+        scheduler = getattr(scheduler, "inner", None)
+        seen += 1
+    return False
+
+
 class SharedResources:
     def __init__(self, scheduler: Optional[Scheduler] = None, name: str = "node") -> None:
         self.scheduler: Scheduler = scheduler if scheduler is not None else RealScheduler()
         self._owns_scheduler = scheduler is None
-        if isinstance(self.scheduler, VirtualScheduler):
+        if _is_virtual(self.scheduler):
             self.protocol_executor: ProtocolExecutor = _SchedulerExecutor(self.scheduler)
         else:
             self.protocol_executor = _ThreadExecutor(f"{name}-protocol")

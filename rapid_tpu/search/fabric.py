@@ -77,12 +77,23 @@ class ServingFabric:
     """One probe's worth of cluster: N engines, one plan, one clock."""
 
     def __init__(self, plan: FaultPlan, n: int = 5, partitions: int = 16,
-                 replicas: int = 3, config_seed: int = 0) -> None:
+                 replicas: int = 3, config_seed: int = 0,
+                 forensics: bool = False) -> None:
         self.plan = plan
         self.scheduler = VirtualScheduler()
         self.metrics = Metrics()
+        # forensics mirror: HLC on the fabric's virtual clock stamps every
+        # journal entry, so a violating probe's journal pins into the same
+        # causal-timeline tooling as real members' bundles (off = exact
+        # pre-forensics entries)
+        self.hlc = None
+        if forensics:
+            from ..forensics.hlc import HlcClock
+
+            self.hlc = HlcClock(clock=self.scheduler.now_ms)
         self.recorder = FlightRecorder(
-            capacity=4096, node="fabric", clock=self.scheduler.now_ms
+            capacity=4096, node="fabric", clock=self.scheduler.now_ms,
+            hlc=self.hlc, metrics=self.metrics,
         )
         self.nemesis = Nemesis(plan, self.scheduler, metrics=self.metrics)
         self.nemesis.arm(epoch_ms=0)

@@ -108,13 +108,18 @@ class Hunter:
     def __init__(self, seed: int = 0, budget: int = 50,
                  harness: str = "engine", guided: bool = True,
                  shrink: bool = True, shrink_budget: int = 200,
-                 probe_overrides: Optional[dict] = None) -> None:
+                 probe_overrides: Optional[dict] = None,
+                 forensics: bool = False) -> None:
         self.seed = int(seed)
         self.budget = int(budget)
         self.harness = harness
         self.guided = guided
         self.shrink = shrink
         self.shrink_budget = shrink_budget
+        # forensics flag: probes run with the harness HLC mirror on, and
+        # every shrunken witness is pinned WITH its evidence bundle (the
+        # violating probe's journal + metrics under invariant_violation)
+        self.forensics = bool(forensics)
         self.defaults = dict(
             ENGINE_DEFAULTS if harness == "engine" else SIM_DEFAULTS
         )
@@ -128,7 +133,12 @@ class Hunter:
         )
 
     def _spec_for(self, plan_json: dict) -> dict:
-        return {"harness": self.harness, **self.defaults, "plan": plan_json}
+        spec = {"harness": self.harness, **self.defaults, "plan": plan_json}
+        if self.forensics:
+            # only stamped when on, so flag-off specs (and the corpus
+            # artifacts pinned from them) are byte-identical to before
+            spec["forensics"] = True
+        return spec
 
     def run(self) -> HuntReport:
         report = HuntReport(
@@ -175,18 +185,29 @@ class Hunter:
                         spec, target_kinds=kinds,
                         max_probes=self.shrink_budget,
                     )
-                    report.pinned.append({
+                    pin = {
                         "kinds": sorted(kinds),
                         "spec": shrunk,
                         "shrink_probes": spent,
-                    })
+                    }
+                    if self.forensics:
+                        # one confirming replay of the minimized spec pins
+                        # the witness WITH its forensic evidence bundle
+                        witness = run_probe(shrunk)
+                        bundle = witness.info.get("bundle")
+                        if bundle is not None:
+                            pin["bundle"] = bundle
+                    report.pinned.append(pin)
         report.coverage = frozenset(coverage)
         return report
 
 
 def pin_to_file(pin: dict, path: str, name: str, description: str) -> None:
     """Write one shrunk violation as a corpus artifact (the format
-    scenarios/corpus/ files use)."""
+    scenarios/corpus/ files use). A pin carrying a forensic evidence
+    bundle (forensics-flagged hunts) additionally writes the bundle as a
+    ``.bundle.json`` sidecar next to the artifact, readable by
+    ``tools/forensics.py report``."""
     artifact = {
         "name": name,
         "description": description,
@@ -196,3 +217,8 @@ def pin_to_file(pin: dict, path: str, name: str, description: str) -> None:
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(artifact, fh, indent=2, sort_keys=True)
         fh.write("\n")
+    bundle = pin.get("bundle")
+    if bundle is not None:
+        from ..forensics.bundle import write_bundle
+
+        write_bundle(bundle, path + ".bundle.json")

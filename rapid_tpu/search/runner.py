@@ -120,6 +120,31 @@ def _collect(checks) -> List[dict]:
     return violations
 
 
+def _witness_bundle(node: str, journal, metrics_snapshot, violations,
+                    harness: str, hlc=None) -> dict:
+    """Forensic evidence for a violating probe (spec flag ``forensics``):
+    the harness journal (HLC-stamped when the mirror is on) and metric
+    digest, bundled with the verdicts under the ``invariant_violation``
+    trigger -- the same document ``tools/forensics.py`` merges, so a hunt
+    witness replays into a causal timeline."""
+    from ..forensics.bundle import build_bundle, member_record
+
+    stamp = None
+    if hlc is not None:
+        try:
+            stamp = hlc.peek().to_wire()
+        except Exception:  # noqa: BLE001 -- evidence degrades, never throws
+            stamp = None
+    local = member_record(
+        node, hlc=stamp, journal=list(journal),
+        metrics={k: int(v) for k, v in dict(metrics_snapshot).items()},
+    )
+    return build_bundle("invariant_violation", local, detail={
+        "harness": harness,
+        "kinds": sorted({v["invariant"] for v in violations}),
+    })
+
+
 # -- engine harness ------------------------------------------------------- #
 
 def run_engine_probe(spec: dict) -> ProbeResult:
@@ -131,6 +156,7 @@ def run_engine_probe(spec: dict) -> ProbeResult:
         n=spec.get("n", 5),
         partitions=spec.get("partitions", 16),
         replicas=spec.get("replicas", 3),
+        forensics=bool(spec.get("forensics", False)),
     )
     history = fabric.run(
         spec.get("horizon_ms", 4000), spec.get("ops", 40),
@@ -197,16 +223,22 @@ def run_engine_probe(spec: dict) -> ProbeResult:
         | coverage_from_fault_actions(fabric.metrics.snapshot())
     )
     acked = sum(1 for o in history if o.op == "put" and o.status == 0)
+    info = {
+        "harness": "engine",
+        "history": len(history),
+        "acked_puts": acked,
+        "virtual_ms": fabric.scheduler.now_ms(),
+        "live": len(fabric.live),
+    }
+    if violations and spec.get("forensics"):
+        info["bundle"] = _witness_bundle(
+            "fabric", fabric.journal(), fabric.metrics.snapshot(),
+            violations, "engine", hlc=fabric.hlc,
+        )
     return ProbeResult(
         coverage=coverage,
         violations=tuple(violations),
-        info={
-            "harness": "engine",
-            "history": len(history),
-            "acked_puts": acked,
-            "virtual_ms": fabric.scheduler.now_ms(),
-            "live": len(fabric.live),
-        },
+        info=info,
     )
 
 
@@ -255,6 +287,7 @@ def run_sim_probe(spec: dict) -> ProbeResult:
             capacity=capacity,
             fd_gray_confirm=spec.get("fd_gray_confirm", 0),
             fd_gray_warmup=spec.get("fd_gray_warmup", 3),
+            forensics=bool(spec.get("forensics", False)),
         ),
         seed=SIM_SEED,
     ).ready()
@@ -420,16 +453,22 @@ def run_sim_probe(spec: dict) -> ProbeResult:
         1 for o in history
         if o.op == "put" and o.status == PutAck.STATUS_OK
     )
+    info = {
+        **info,
+        "history": len(history),
+        "acked_puts": acked,
+        "virtual_ms": sim.virtual_ms,
+        "view_changes": len(sim.view_changes),
+    }
+    if violations and spec.get("forensics"):
+        info["bundle"] = _witness_bundle(
+            "sim", sim.recorder.tail(4096), sim.metrics.snapshot(),
+            violations, "sim", hlc=sim.hlc,
+        )
     return ProbeResult(
         coverage=coverage,
         violations=tuple(violations),
-        info={
-            **info,
-            "history": len(history),
-            "acked_puts": acked,
-            "virtual_ms": sim.virtual_ms,
-            "view_changes": len(sim.view_changes),
-        },
+        info=info,
     )
 
 

@@ -20,6 +20,8 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from .cut_detector import MultiNodeCutDetector
 from .events import ClusterEvents, NodeStatusChange
 from .fast_paxos import FastPaxos
+from .forensics.bundle import build_bundle, capture_local_evidence
+from .forensics.hlc import HlcClock, hlc_of, stamp_hlc
 from .handoff.engine import HandoffEngine
 from .handoff.store import PartitionStore
 from .hashing import endpoint_hash, to_signed
@@ -29,6 +31,7 @@ from .messaging.unicast import UnicastToAllBroadcaster
 from .metadata import FrozenMetadata, MetadataManager
 from .monitoring.base import IEdgeFailureDetectorFactory
 from .observability import (
+    DEFAULT_JOURNAL_CAPACITY,
     PARTITIONS_MOVED_BUCKETS,
     FlightRecorder,
     Metrics,
@@ -49,6 +52,7 @@ from .placement.engine import (
     weight_of,
 )
 from .runtime.futures import Promise, successful_as_list
+from .runtime.lockdep import make_lock
 from .runtime.resources import SharedResources
 from .runtime.scheduler import ScheduledTask
 from .serving.engine import ServingEngine
@@ -125,6 +129,7 @@ class MembershipService:
         placement: Optional[PlacementConfig] = None,
         handoff_store: Optional[PartitionStore] = None,
         serving: bool = False,
+        hlc: Optional[HlcClock] = None,
     ) -> None:
         self._my_addr = my_addr
         self._cut_detection = cut_detector
@@ -173,12 +178,28 @@ class MembershipService:
         self._stable_view = StableViewTimer(
             self.metrics, "protocol", clock=self._scheduler.now_ms
         )
+        # forensics plane: this node's hybrid logical clock (None keeps the
+        # pre-forensics path byte-for-byte; outbound stamping happens in the
+        # HlcStampingClient wrapper the builder installs, inbound merging in
+        # handle_message below)
+        self._hlc = hlc
+        # the latest evidence bundle captured by an automatic trigger
+        # (slo_burn today); Cluster.capture_bundle / agent --bundle-out
+        # read it so an operator can fetch what the alert pinned
+        self.last_bundle: Optional[Dict[str, object]] = None
         # bounded black-box journal of membership-relevant events, served
-        # via the status RPC and dumpable on crash/exit
+        # via the status RPC and dumpable on crash/exit; journal entries are
+        # HLC-stamped when the forensics plane is on
         self.recorder = (
             recorder
             if recorder is not None
-            else FlightRecorder(node=str(my_addr), clock=self._scheduler.now_ms)
+            else FlightRecorder(
+                node=str(my_addr), clock=self._scheduler.now_ms,
+                capacity=(settings.forensics.journal_capacity
+                          if settings.forensics.enabled
+                          else DEFAULT_JOURNAL_CAPACITY),
+                hlc=hlc, metrics=self.metrics,
+            )
         )
         # profiling plane: a metric history ring over this node's registry,
         # snapshotted opportunistically from the status RPC and served as
@@ -200,6 +221,10 @@ class MembershipService:
             self._slo = SloPlane(
                 settings.slo, metrics=self.metrics, recorder=self.recorder
             )
+            if settings.forensics.enabled:
+                # forensics trigger: a burn alert firing pins a local-only
+                # evidence bundle at the moment of the transition
+                self._slo.on_transition = self._on_slo_transitions
         # the trace context of the churn this node is currently working on:
         # minted by the local fd_signal root or adopted from the first
         # traced alert/vote, carried onto outgoing alerts and the eventual
@@ -291,6 +316,13 @@ class MembershipService:
             # experiments/message_load.py compares payload receptions
             name += ".control"
         self.metrics.incr(f"messages.{name}")
+        if self._hlc is not None:
+            # HLC receive rule: fold the sender's stamp into the local clock
+            # before any handler records journal events for this message, so
+            # effects are always HLC-after their cause across nodes
+            stamp = hlc_of(msg)
+            if stamp is not None:
+                self._hlc.merge(stamp)
         if isinstance(msg, PreJoinMessage):
             return self._handle_pre_join(msg)
         if isinstance(msg, JoinMessage):
@@ -331,9 +363,14 @@ class MembershipService:
         context, so inners that lost their own stamp adopt it (the gossip
         receive() discipline)."""
         ctx = trace_context_of(batch)
+        hlc_stamp = hlc_of(batch)
         for inner in batch.messages:
             if ctx is not None and trace_context_of(inner) is None:
                 stamp_trace_context(inner, ctx)
+            if hlc_stamp is not None and hlc_of(inner) is None:
+                # the native codec carries only the envelope's HLC stamp;
+                # inners adopt it exactly like the trace context above
+                stamp_hlc(inner, hlc_stamp)
             try:
                 self.handle_message(inner)
             except Exception:  # noqa: BLE001 -- one poisoned inner message
@@ -541,6 +578,15 @@ class MembershipService:
             self._slo.attribute(self.recorder.tail(64))
             (slo_names, slo_burn_milli, slo_firing,
              slo_attributed_trace) = self._slo.status_digest()
+        # forensics plane: journal truncation counters plus this node's
+        # current HLC coordinate (all zero pre-forensics -- old peers and
+        # goldens see their exact old shape)
+        hlc_physical_ms = hlc_logical = hlc_incarnation = 0
+        if self._hlc is not None:
+            hlc_stamp = self._hlc.peek()
+            hlc_physical_ms = hlc_stamp.physical_ms
+            hlc_logical = hlc_stamp.logical
+            hlc_incarnation = hlc_stamp.incarnation
         return ClusterStatusResponse(
             sender=self._my_addr,
             configuration_id=self._view.get_current_configuration_id(),
@@ -586,6 +632,167 @@ class MembershipService:
             slo_burn_milli=slo_burn_milli,
             slo_firing=slo_firing,
             slo_attributed_trace=slo_attributed_trace,
+            journal_dropped=int(getattr(self.recorder, "dropped", 0)),
+            journal_capacity=int(getattr(self.recorder, "capacity", 0)),
+            hlc_physical_ms=hlc_physical_ms,
+            hlc_logical=hlc_logical,
+            hlc_incarnation=hlc_incarnation,
+        )
+
+    # ------------------------------------------------------------------ #
+    # Forensics plane (forensics/, tools/forensics.py)
+    # ------------------------------------------------------------------ #
+
+    def _durability_dict(self) -> Optional[Dict[str, int]]:
+        if self._handoff is None:
+            return None
+        stats_fn = getattr(self._handoff.store, "durability_stats", None)
+        if stats_fn is None:
+            return None
+        try:
+            stats = stats_fn()
+            return {
+                "segments": int(stats["segments"]),
+                "snapshot_version": int(stats["snapshot_version"]),
+                "replayed": int(stats["replayed_records"]),
+            }
+        except Exception:  # noqa: BLE001 -- evidence capture degrades
+            return None
+
+    def _local_record(self) -> Dict[str, object]:
+        """This node's member record, assembled straight from the plane
+        objects -- never via the status RPC, so a capture triggered from
+        inside the SLO/status path cannot recurse. Safe on any thread (the
+        recorder locks; everything else is a snapshot read)."""
+        return capture_local_evidence(
+            node=str(self._my_addr),
+            recorder=self.recorder,
+            metrics=self.metrics,
+            tracer=self.tracer,
+            slo=self._slo,
+            hlc=self._hlc,
+            configuration_id=self._view.get_current_configuration_id(),
+            membership_size=self._view.membership_size,
+            durability=self._durability_dict(),
+            history=self._history,
+            journal_tail=self._settings.forensics.bundle_journal_tail,
+            history_tail=self._settings.forensics.bundle_history_tail,
+        )
+
+    def local_evidence(self, trigger: str = "explicit",
+                       detail: Optional[Dict[str, object]] = None,
+                       ) -> Dict[str, object]:
+        """A local-only evidence bundle (the automatic-trigger form)."""
+        return build_bundle(trigger, self._local_record(), detail=detail)
+
+    def capture_cluster_bundle_async(
+        self, trigger: str = "explicit",
+        detail: Optional[Dict[str, object]] = None,
+    ) -> Promise:
+        """Cluster-wide evidence capture: the local record plus a status-RPC
+        sweep of every other member. A callback state machine (never blocks,
+        so it works under virtual time exactly like ``join_async``): the
+        bundle completes when every member answered or the scheduler-clock
+        deadline (``forensics.bundle_member_timeout_ms``) fires, whichever
+        is first -- members still pending at the deadline are recorded as
+        unreachable, so a partitioned cluster still yields a bundle naming
+        who was missing."""
+        from .forensics.bundle import status_to_record, unreachable_record
+
+        local = self._local_record()
+        result: Promise = Promise()
+        futures: List[Tuple[Endpoint, Promise]] = []
+        for member in self._view.get_ring(0):
+            if member == self._my_addr:
+                continue
+            request = ClusterStatusRequest(
+                sender=self._my_addr,
+                include_history=self._settings.forensics.bundle_history_tail,
+            )
+            futures.append(
+                (member, self._client.send_message(member, request))
+            )
+        state = {"remaining": len(futures), "finished": False}
+        lock = make_lock("MembershipService.capture_bundle.lock")
+
+        def finish() -> None:
+            members: List[Dict[str, object]] = []
+            for member, future in futures:
+                if not future.done():
+                    members.append(unreachable_record(
+                        str(member), "status deadline exceeded"
+                    ))
+                elif future.exception() is not None:
+                    members.append(unreachable_record(
+                        str(member), str(future.exception())
+                    ))
+                else:
+                    status = future.peek()
+                    if isinstance(status, ClusterStatusResponse):
+                        members.append(status_to_record(status))
+                    else:
+                        members.append(unreachable_record(
+                            str(member),
+                            f"unexpected response {type(status).__name__}",
+                        ))
+            bundle = build_bundle(
+                trigger, local, members=members, detail=detail
+            )
+            self.last_bundle = bundle
+            self.recorder.record(
+                "bundle_captured", trigger=trigger,
+                fingerprint=str(bundle["manifest"]["fingerprint"])[:12],  # type: ignore[index]
+                events=int(bundle["manifest"]["events"]),  # type: ignore[index]
+            )
+            result.set_result(bundle)
+
+        def maybe_finish(last: bool) -> None:
+            with lock:
+                if state["finished"]:
+                    return
+                if last:
+                    state["remaining"] -= 1
+                    if state["remaining"] > 0:
+                        return
+                state["finished"] = True
+            finish()
+
+        for _member, future in futures:
+            future.add_callback(lambda _p: maybe_finish(True))
+        self._scheduler.schedule(
+            self._settings.forensics.bundle_member_timeout_ms,
+            lambda: maybe_finish(False),
+        )
+        if not futures:
+            maybe_finish(False)
+        return result
+
+    def capture_cluster_bundle(self, trigger: str = "explicit",
+                               detail: Optional[Dict[str, object]] = None,
+                               timeout: float = 60.0) -> Dict[str, object]:
+        """Blocking wrapper for real-time mode (virtual-time callers drive
+        the async form). Never call on the protocol executor: the member
+        responses complete there."""
+        return self.capture_cluster_bundle_async(trigger, detail).result(
+            timeout
+        )
+
+    def _on_slo_transitions(self, transitions) -> None:
+        """Burn-alert forensics trigger: the first "fired" transition in a
+        tick captures a local-only bundle and journals the capture, so the
+        evidence window is pinned at the moment the alert fired rather than
+        whenever an operator notices."""
+        fired = [alert for kind, alert in transitions if kind == "fired"]
+        if not fired:
+            return
+        bundle = self.local_evidence(
+            "slo_burn", detail={"alerts": [a.name for a in fired]},
+        )
+        self.last_bundle = bundle
+        self.recorder.record(
+            "bundle_captured", trigger="slo_burn",
+            fingerprint=str(bundle["manifest"]["fingerprint"])[:12],  # type: ignore[index]
+            events=int(bundle["manifest"]["events"]),  # type: ignore[index]
         )
 
     # ------------------------------------------------------------------ #

@@ -131,6 +131,33 @@ SETTINGS_CATALOG = {
                "window burn rates drop below clear_fraction x the fire "
                "threshold (1.0 disables the hysteresis band)",
     },
+    "forensics.enabled": {
+        "min": 0, "max": 1,
+        "doc": "kill switch: False attaches no HLC sidecar, no bundle "
+               "triggers, no exit hooks, and reproduces the exact "
+               "pre-forensics wire bytes",
+    },
+    "forensics.journal_capacity": {
+        "min": 1, "max": 1048576,
+        "doc": "FlightRecorder ring capacity in events; overflow drops the "
+               "oldest entry and counts journal.dropped_events so bundles "
+               "report truncation instead of hiding it",
+    },
+    "forensics.bundle_journal_tail": {
+        "min": 1, "max": 65536,
+        "doc": "journal entries captured per member in an evidence bundle",
+    },
+    "forensics.bundle_history_tail": {
+        "min": 0, "max": 65536,
+        "doc": "metric-history ring snapshots captured per member in an "
+               "evidence bundle (0 skips the history carriage)",
+    },
+    "forensics.bundle_member_timeout_ms": {
+        "min": 1, "max": 600000,
+        "doc": "per-member status-RPC deadline during cluster-wide bundle "
+               "capture; a member that misses it is marked unreachable and "
+               "the capture proceeds without blocking",
+    },
 }
 
 
@@ -269,6 +296,37 @@ class SLOSettings:
             )
 
 
+@dataclass(frozen=True)
+class ForensicsSettings:
+    """Knobs for the forensics plane (forensics/). Defaults are
+    conservative: the plane is off (``enabled=False`` attaches no HLC
+    sidecar and reproduces the exact pre-forensics wire bytes) and, when
+    on, outbound messages carry hybrid-logical-clock stamps, journal
+    entries gain HLC coordinates, and evidence bundles capture bounded
+    tails from every reachable member. Bounds live in SETTINGS_CATALOG
+    (linted by tools/check.py)."""
+
+    enabled: bool = False
+    journal_capacity: int = 256
+    bundle_journal_tail: int = 128
+    bundle_history_tail: int = 32
+    bundle_member_timeout_ms: int = 2000
+
+    def __post_init__(self) -> None:
+        for key, value in (
+            ("enabled", int(self.enabled)),
+            ("journal_capacity", self.journal_capacity),
+            ("bundle_journal_tail", self.bundle_journal_tail),
+            ("bundle_history_tail", self.bundle_history_tail),
+            ("bundle_member_timeout_ms", self.bundle_member_timeout_ms),
+        ):
+            bounds = SETTINGS_CATALOG[f"forensics.{key}"]
+            assert bounds["min"] <= value <= bounds["max"], (
+                f"forensics.{key}={value!r} outside "
+                f"[{bounds['min']}, {bounds['max']}]"
+            )
+
+
 @dataclass
 class Settings:
     # Transport timeouts/retries (GrpcClient.java:55-59)
@@ -341,6 +399,12 @@ class Settings:
     # attribution. Off by default; the enabled flag is the kill switch
     # back to the exact pre-SLO serving path.
     slo: SLOSettings = field(default_factory=SLOSettings)
+
+    # Forensics plane (forensics/): hybrid logical clocks on the wire,
+    # HLC-stamped journals, and automatic incident evidence bundles. Off
+    # by default; the enabled flag is the kill switch back to the exact
+    # pre-forensics wire bytes and journal shape.
+    forensics: ForensicsSettings = field(default_factory=ForensicsSettings)
 
     def __post_init__(self) -> None:
         assert self.fd_policy in ("cumulative", "windowed"), (

@@ -217,8 +217,17 @@ class Simulator:  # guarded-by: sim-loop
         # real member's view_change parents onto the detecting node's
         # fd_signal. Cleared when the view installs.
         self._churn_ctx: Optional[TraceContext] = None
+        # forensics mirror: the sim's HLC runs on the virtual clock, so a
+        # sim journal is deterministic run-to-run and merges causally with
+        # real members' bundles (None keeps pre-forensics journal entries)
+        self.hlc = None
+        if self.config.forensics:
+            from ..forensics.hlc import HlcClock
+
+            self.hlc = HlcClock(clock=lambda: self.virtual_ms)
         self.recorder = FlightRecorder(
-            node="sim", clock=lambda: self.virtual_ms
+            node="sim", clock=lambda: self.virtual_ms,
+            hlc=self.hlc, metrics=self.metrics,
         )
         # fault plane
         self._ingress_partitioned: Set[int] = set()

@@ -103,6 +103,11 @@ class SimConfig:
     # by bridged real nodes (sim/bridge.py registers their actual fast-round
     # votes into these rows). 0 = all-simulated cluster.
     extern_proposals: int = 0
+    # Forensics mirror (forensics/hlc.py): when True the sim's flight
+    # recorder stamps every journal entry with an HLC driven by the VIRTUAL
+    # clock, so sim journals merge into the same causal timelines as real
+    # members' (tools/forensics.py). Off = the exact pre-forensics entries.
+    forensics: bool = False
     # Heterogeneous broadcast LATENCY (the paper's Fig.-11 conflict regime):
     # a broadcast from sender s reaches group g ``deliver_delay[g, s]``
     # EXTRA rounds late (0..max_delivery_delay). Nothing is lost -- but

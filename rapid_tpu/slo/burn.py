@@ -24,7 +24,7 @@ pair.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .attrib import Episode, attribute_burn, episodes_from_journal
 from .sli import SliTracker
@@ -219,6 +219,12 @@ class SloPlane:
         # the plane from its protocol executor (serving handlers and their
         # completion callbacks run there), bench/sim from the driving thread
         self._last_tick_bucket: Optional[int] = None  # guarded-by: protocol-executor
+        # forensics-plane seam: invoked with the transition list whenever a
+        # tick produces one (the burn-alert evidence-capture trigger); the
+        # owner sets it, the plane never requires it
+        self.on_transition: Optional[
+            Callable[[List[Tuple[str, BurnAlert]]], None]
+        ] = None
 
     # -- feeding ------------------------------------------------------------
 
@@ -283,6 +289,12 @@ class SloPlane:
                 )
         if self.metrics is not None and (transitions or force):
             self.metrics.set_gauge("slo.firing", self.firing_count())
+        if transitions and self.on_transition is not None:
+            try:
+                self.on_transition(transitions)
+            except Exception:  # noqa: BLE001 -- an evidence capture must
+                # never sink the serving/status path that ticked the plane
+                pass
         return transitions
 
     def alerts(self) -> List[BurnAlert]:

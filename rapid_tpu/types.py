@@ -402,6 +402,15 @@ class ClusterStatusResponse:
     slo_burn_milli: Tuple[int, ...] = ()
     slo_firing: Tuple[int, ...] = ()
     slo_attributed_trace: Tuple[int, ...] = ()
+    # forensics plane (0/absent when forensics is not enabled): journal
+    # truncation accounting (entries the flight recorder dropped on
+    # overflow, and the ring's capacity) plus the node's current hybrid
+    # logical clock -- the coordinates evidence bundles merge timelines on
+    journal_dropped: int = 0
+    journal_capacity: int = 0
+    hlc_physical_ms: int = 0
+    hlc_logical: int = 0
+    hlc_incarnation: int = 0
 
 
 @dataclass(frozen=True)

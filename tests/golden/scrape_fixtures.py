@@ -58,6 +58,23 @@ SLO_RESPONSE = ClusterStatusResponse(
     slo_attributed_trace=(0, 7),
 )
 
+# a forensics-plane-bearing status: journal truncation accounting plus
+# the node's hybrid logical clock (proto fields 41-45) -- the coordinates
+# evidence bundles merge cluster timelines on; incarnation 2 pins a
+# restarted member's persisted boot count
+HLC_RESPONSE = ClusterStatusResponse(
+    sender=MEMBER,
+    configuration_id=-6148914691236517206,
+    membership_size=3,
+    reports_tracked=1,
+    consensus_votes=2,
+    journal_dropped=6,
+    journal_capacity=256,
+    hlc_physical_ms=1_750_000,
+    hlc_logical=4,
+    hlc_incarnation=2,
+)
+
 # named (request_no, message) pairs pinned on the native msgpack wire
 TCP_SCRAPES = {
     "request_with_history": (11, SCRAPE_REQUEST),
@@ -66,4 +83,5 @@ TCP_SCRAPES = {
     "request_plain": (12, ClusterStatusRequest(sender=SCRAPER)),
     "response_with_history": (13, SCRAPE_RESPONSE),
     "response_with_slo": (14, SLO_RESPONSE),
+    "response_with_hlc": (15, HLC_RESPONSE),
 }

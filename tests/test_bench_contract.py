@@ -679,3 +679,40 @@ def test_jitwatch_overhead_within_budget(monkeypatch):
         f"jitwatch overhead: instrumented={instrumented * 1e3:.1f}ms "
         f"plain={plain * 1e3:.1f}ms"
     )
+
+
+def test_perfscope_trend_contract(tmp_path):
+    """ISSUE 18 satellite: the trend subcommand renders the headline
+    trajectory across the repo's committed BENCH_rNN artifacts -- outage
+    runs (rc 17) are marked in place but never plotted as regressions --
+    and flags a >threshold slowdown between measured neighbors with rc 3."""
+    from pathlib import Path
+
+    from tools.perfscope import load_trend_entry, trend_report
+
+    root = Path(bench.__file__).parent
+    entries = [
+        load_trend_entry(str(root / f"BENCH_r{i:02d}.json"))
+        for i in range(1, 6)
+    ]
+    text, regressions = trend_report(entries)
+    assert "5 runs (2 measured, 3 outage)" in text
+    assert text.count("OUTAGE") == 3 and "rc 17" in text
+    assert "r02" in text and "% vs r01" in text
+    assert regressions == []  # outages between runs are not perf points
+
+    # a synthetic >threshold slowdown between measured runs must flag;
+    # the outage wedged between them must not break the comparison chain
+    def artifact(n, rc, value):
+        return {"n": n, "rc": rc, "tail": "",
+                "parsed": {"metric": "decision_latency_ms", "value": value}
+                if rc == 0 else None}
+
+    paths = []
+    for n, rc, value in ((1, 0, 100.0), (2, 17, None), (3, 0, 150.0)):
+        p = tmp_path / f"run{n}.json"
+        p.write_text(json.dumps(artifact(n, rc, value)))
+        paths.append(str(p))
+    text2, regs2 = trend_report([load_trend_entry(p) for p in paths])
+    assert len(regs2) == 1 and "r01 -> r03" in regs2[0]
+    assert "OUTAGE" in text2

@@ -1,0 +1,551 @@
+"""Forensics plane: hybrid logical clocks, evidence bundles, timelines.
+
+The acceptance scenario is the one from the PR issue: a staggered 3-node
+churn under a +/-500ms clock_skew plan must produce an evidence bundle
+whose merged timeline orders fd_signal -> alerts -> view_install
+correctly by HLC while the nodes' own (skewed) clocks provably disagree
+-- a message that "arrives before it was sent" by local clocks lands
+after its send on the HLC axis. Everything runs on virtual time, so the
+whole file is tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from rapid_tpu.durability import FSYNC_NEVER
+from rapid_tpu.faults import FaultPlan
+from rapid_tpu.forensics.bundle import (
+    build_bundle,
+    capture_local_evidence,
+    install_exit_hooks,
+    load_bundle,
+    verify_bundle,
+    write_bundle,
+)
+from rapid_tpu.forensics.hlc import HlcClock, HlcStamp, hlc_of, stamp_hlc
+from rapid_tpu.forensics.timeline import detect_signatures, merge_timeline
+from rapid_tpu.messaging import codec
+from rapid_tpu.observability import FlightRecorder, Metrics
+from rapid_tpu.settings import (
+    DurabilitySettings,
+    ForensicsSettings,
+    Settings,
+)
+from rapid_tpu.types import Endpoint, ProbeMessage
+
+from harness import ClusterHarness
+
+REPO = __file__.rsplit("/", 2)[0]
+
+
+def _forensics_settings(**kw) -> Settings:
+    return Settings(forensics=ForensicsSettings(enabled=True, **kw))
+
+
+# ---------------------------------------------------------------------------
+# HLC unit semantics
+# ---------------------------------------------------------------------------
+
+
+class TestHlc:
+    def test_now_is_strictly_monotonic_under_a_frozen_clock(self):
+        clock = HlcClock(clock=lambda: 1000)
+        stamps = [clock.now() for _ in range(50)]
+        for a, b in zip(stamps, stamps[1:]):
+            assert b.pair() > a.pair()
+        # frozen physical time: all advancement is logical
+        assert all(s.physical_ms == 1000 for s in stamps)
+
+    def test_physical_advance_resets_logical(self):
+        t = [1000]
+        clock = HlcClock(clock=lambda: t[0])
+        clock.now()
+        clock.now()
+        t[0] = 2000
+        stamp = clock.now()
+        assert stamp == HlcStamp(2000, 0, 1)
+
+    def test_regressing_physical_clock_never_moves_stamps_backward(self):
+        t = [5000]
+        clock = HlcClock(clock=lambda: t[0])
+        high = clock.now()
+        t[0] = 100  # wall clock stepped back (NTP slew, skew fault)
+        low = clock.now()
+        assert low.pair() > high.pair()
+        assert low.physical_ms == high.physical_ms  # held, logical bumped
+
+    def test_merge_is_strictly_greater_than_both_inputs(self):
+        t = [1000]
+        clock = HlcClock(clock=lambda: t[0])
+        local = clock.now()
+        # remote far ahead (the +500 skewed peer), equal, and behind
+        for remote in (HlcStamp(9000, 3), HlcStamp(1000, 7), HlcStamp(10, 2)):
+            merged = clock.merge(remote)
+            assert merged.pair() > remote.pair()
+            assert merged.pair() > local.pair()
+            local = merged
+
+    def test_causal_chain_across_skewed_nodes(self):
+        # A (+500) sends to B (-500): every hop must order after its cause
+        # even though B's physical clock reads 1000ms behind A's.
+        a = HlcClock(clock=lambda: 1500)
+        b = HlcClock(clock=lambda: 500)
+        send = a.now()
+        recv = b.merge(send)
+        after = b.now()
+        assert send.pair() < recv.pair() < after.pair()
+
+    def test_wire_round_trip(self):
+        stamp = HlcStamp(12345, 7, incarnation=3)
+        assert HlcStamp.from_wire(stamp.to_wire()) == stamp
+        assert HlcStamp.from_wire([5, 2]) == HlcStamp(5, 2, 1)
+
+    @pytest.mark.parametrize("raw", [
+        None, 42, "x", [], [1], ["a", "b"], [-1, 0], [0, -2],
+        [1, 1, 0], [1, 1, -5], {"physical": 1},
+    ])
+    def test_malformed_wire_stamps_are_rejected(self, raw):
+        assert HlcStamp.from_wire(raw) is None
+
+    def test_clock_failure_falls_back_to_last_physical(self):
+        state = {"ok": True}
+
+        def dying():
+            if not state["ok"]:
+                raise RuntimeError("clock is gone")
+            return 700
+
+        clock = HlcClock(clock=dying)
+        clock.now()
+        state["ok"] = False
+        stamp = clock.now()  # must not raise, must still advance
+        assert stamp.physical_ms == 700 and stamp.logical >= 1
+
+
+# ---------------------------------------------------------------------------
+# Wire carriage + the kill switch
+# ---------------------------------------------------------------------------
+
+
+class TestWireKillSwitch:
+    def test_unstamped_frame_has_no_hlc_key(self):
+        msg = ProbeMessage(sender=Endpoint.from_parts("127.0.0.1", 9))
+        frame = codec.encode(1, msg)
+        assert b"__hlc" not in frame
+
+    def test_kill_switch_off_reproduces_pre_forensics_bytes(self):
+        # two identical messages, one stamped: the unstamped frame must be
+        # byte-identical to the stamped frame minus the rider -- i.e. the
+        # rider is the ONLY delta the forensics plane can introduce
+        plain = ProbeMessage(sender=Endpoint.from_parts("127.0.0.1", 9))
+        stamped = ProbeMessage(sender=Endpoint.from_parts("127.0.0.1", 9))
+        stamp_hlc(stamped, HlcStamp(1234, 5, 2))
+        plain_frame = codec.encode(1, plain)
+        stamped_frame = codec.encode(1, stamped)
+        assert b"__hlc" in stamped_frame
+        assert b"__hlc" not in plain_frame
+        # and a second unstamped encoding is bit-identical (determinism)
+        again = ProbeMessage(sender=Endpoint.from_parts("127.0.0.1", 9))
+        assert codec.encode(1, again) == plain_frame
+
+    def test_stamp_round_trips_through_the_codec(self):
+        msg = ProbeMessage(sender=Endpoint.from_parts("127.0.0.1", 9))
+        stamp_hlc(msg, HlcStamp(777, 3, 4))
+        _no, decoded = codec.decode(codec.encode(1, msg))
+        assert hlc_of(decoded) == HlcStamp(777, 3, 4)
+
+    def test_decoder_strips_rider_from_unstamped_peers(self):
+        msg = ProbeMessage(sender=Endpoint.from_parts("127.0.0.1", 9))
+        _no, decoded = codec.decode(codec.encode(1, msg))
+        assert hlc_of(decoded) is None
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: drop accounting + exit hooks
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_overflow_counts_drops_and_bills_the_metric(self):
+        metrics = Metrics()
+        rec = FlightRecorder(capacity=4, node="n1", metrics=metrics)
+        for i in range(10):
+            rec.record("probe", virtual_ms=i)
+        assert len(rec) == 4
+        assert rec.dropped == 6
+        assert metrics.snapshot()["journal.dropped_events"] == 6
+
+    def test_entries_carry_hlc_when_the_clock_is_attached(self):
+        rec = FlightRecorder(capacity=8, node="n1",
+                             hlc=HlcClock(clock=lambda: 250))
+        entry = rec.record("probe", virtual_ms=1)
+        assert entry["hlc"][0] == 250 and len(entry["hlc"]) == 3
+
+    def test_install_exit_hooks_is_idempotent(self, tmp_path):
+        rec = FlightRecorder(capacity=8, node="n1")
+        path = str(tmp_path / "journal.jsonl")
+        assert install_exit_hooks(rec, path) is True
+        assert install_exit_hooks(rec, path) is False  # second call: no-op
+
+    def test_dump_is_atomic_and_loadable(self, tmp_path):
+        rec = FlightRecorder(capacity=8, node="n1")
+        rec.record("probe", virtual_ms=5, peer="n2")
+        path = tmp_path / "journal.jsonl"
+        rec.dump(str(path))
+        lines = path.read_text().splitlines()
+        assert len(lines) == 1
+        assert json.loads(lines[0])["kind"] == "probe"
+        # no tmp droppings left behind by the tmp+replace protocol
+        assert [p.name for p in tmp_path.iterdir()] == ["journal.jsonl"]
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: staggered churn under +/-500ms skew
+# ---------------------------------------------------------------------------
+
+
+class TestSkewedChurnTimeline:
+    def test_bundle_orders_causality_despite_skewed_clocks(self):
+        h = ClusterHarness(seed=31, settings=_forensics_settings())
+        plan = (
+            FaultPlan(seed=3)
+            .clock_skew(h.addr(1), offset_ms=500)
+            .clock_skew(h.addr(2), offset_ms=-500)
+        )
+        h.with_faults(plan)
+        try:
+            h.start_seed(0)
+            h.join(1)
+            h.join(2)
+            h.wait_and_verify_agreement(3)
+            h.fail_nodes([h.addr(0)])
+            # 3 -> 2 can't quorum the fast round; the classic fallback
+            # reconverges in ~700s of virtual time under this skew
+            h.wait_and_verify_agreement(2, timeout_ms=1_500_000)
+
+            survivor = h.instances[h.addr(1)]
+            promise = survivor.capture_bundle_async(trigger="explicit")
+            ok = h.scheduler.run_until(promise.done, timeout_ms=120_000)
+            assert ok and promise.exception() is None
+            bundle = promise.peek()
+
+            # both survivors contributed evidence; nothing unreachable
+            assert bundle["manifest"]["members"] == 2
+            assert bundle["manifest"]["unreachable"] == []
+            assert verify_bundle(bundle)
+
+            events = merge_timeline([bundle])
+            assert events, "merged timeline is empty"
+            assert all(e.hlc is not None for e in events), (
+                "forensics-on journals must be HLC-stamped"
+            )
+            n1, n2 = str(h.addr(1)), str(h.addr(2))
+
+            # causality on the HLC axis: the failure is detected, alerts
+            # fire, and only then does the shrunk view install
+            first_fd = min(
+                i for i, e in enumerate(events) if e.kind == "fd_signal"
+            )
+            last_view = max(
+                i for i, e in enumerate(events)
+                if e.kind == "view_install" and e.node == n2
+            )
+            alerts = [
+                i for i, e in enumerate(events)
+                if e.kind in ("alert_out", "alert_in")
+            ]
+            assert first_fd < last_view
+            assert any(first_fd < i < last_view for i in alerts), (
+                "no alert between failure detection and the view install"
+            )
+
+            # the wall-clock order is provably wrong: an alert received on
+            # the -500 node carries a LOCAL receive time earlier than the
+            # +500 sender's send time ("arrived before it was sent"), yet
+            # the HLC merge rule still orders receive after send
+            inversions = [
+                (o, i)
+                for o in events
+                if o.node == n1 and o.kind == "alert_out"
+                for i in events
+                if i.node == n2 and i.kind == "alert_in"
+                and i.hlc_key > o.hlc_key
+                and i.virtual_ms is not None and o.virtual_ms is not None
+                and i.virtual_ms < o.virtual_ms
+            ]
+            assert inversions, (
+                "expected at least one wall-vs-HLC inversion across the "
+                "+/-500ms skew"
+            )
+        finally:
+            h.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Bundle capture under partial reachability (never blocks)
+# ---------------------------------------------------------------------------
+
+
+class TestPartialReachability:
+    def test_unresponsive_member_is_named_not_waited_on(self):
+        settings = _forensics_settings(bundle_member_timeout_ms=2000)
+        # real ping-pong FDs so the drop/duplicate nemesis has probe
+        # traffic to chew on while the capture fans out
+        h = ClusterHarness(seed=7, use_static_fd=False, settings=settings)
+        plan = (
+            FaultPlan(seed=11)
+            .duplicate(0.25, msg_types=[ProbeMessage])
+            .drop(0.2, msg_types=[ProbeMessage])
+        )
+        h.with_faults(plan)
+        try:
+            h.start_seed(0)
+            h.join(1)
+            h.join(2)
+            h.wait_and_verify_agreement(3)
+            # gray member: still in the view, answers nothing (every
+            # ingress frame dropped at its server)
+            h.servers[h.addr(2)].interceptors.append(lambda _msg: False)
+
+            started = h.scheduler.now_ms()
+            promise = h.instances[h.addr(0)].capture_bundle_async(
+                trigger="explicit"
+            )
+            ok = h.scheduler.run_until(promise.done, timeout_ms=120_000)
+            assert ok and promise.exception() is None
+            elapsed = h.scheduler.now_ms() - started
+            # bounded by the per-member deadline, not the cluster's patience
+            assert elapsed <= 60_000, f"capture stalled for {elapsed}ms"
+
+            bundle = promise.peek()
+            assert bundle["manifest"]["unreachable"] == [str(h.addr(2))]
+            records = {m["node"]: m for m in bundle["members"]}
+            assert records[str(h.addr(2))]["reachable"] is False
+            assert records[str(h.addr(1))]["reachable"] is True
+            assert records[str(h.addr(1))]["journal"], (
+                "reachable members must still contribute their journal"
+            )
+            assert verify_bundle(bundle)
+        finally:
+            h.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Restarted members: the incarnation axis
+# ---------------------------------------------------------------------------
+
+
+class TestRestartIncarnation:
+    def test_restart_bumps_incarnation_and_never_merges_two_lives(
+        self, tmp_path
+    ):
+        settings = Settings(
+            forensics=ForensicsSettings(enabled=True),
+            durability=DurabilitySettings(
+                enabled=True, fsync_policy=FSYNC_NEVER
+            ),
+        )
+        h = ClusterHarness(seed=13, settings=settings)
+        dirs = {i: str(tmp_path / f"node{i}") for i in range(3)}
+        placement = {"partitions": 16, "replicas": 3, "seed": 7}
+        try:
+            h.start_seed(0, placement=placement, durability=dirs[0])
+            h.join(1, placement=placement, durability=dirs[1])
+            h.join(2, placement=placement, durability=dirs[2])
+            h.wait_and_verify_agreement(3)
+            victim = h.instances[h.addr(2)]
+            assert victim.get_cluster_status().hlc_incarnation == 1
+
+            first = h.instances[h.addr(0)].capture_bundle_async(
+                trigger="explicit"
+            )
+            assert h.scheduler.run_until(first.done, timeout_ms=120_000)
+
+            # power loss, then back with the same WAL directory before the
+            # failure detector concludes (the PR 17 rejoin idiom)
+            victim.get_partition_store().crash()
+            h.fail_nodes([h.addr(2)])
+            h.blacklist.discard(h.addr(2))
+            revived = h.join(2, placement=placement, durability=dirs[2])
+            h.wait_and_verify_agreement(3)
+            assert revived.get_cluster_status().hlc_incarnation == 2
+
+            second = h.instances[h.addr(0)].capture_bundle_async(
+                trigger="explicit"
+            )
+            assert h.scheduler.run_until(second.done, timeout_ms=120_000)
+
+            merged = merge_timeline([first.peek(), second.peek()])
+            n2 = str(h.addr(2))
+            lives = {e.hlc[2] for e in merged if e.node == n2 and e.hlc}
+            assert lives == {1, 2}, f"expected both incarnations, got {lives}"
+            # the restarted recorder restarts seq at 1: identical
+            # (seq, kind) pairs across the two lives must NOT dedupe
+            by_life = {
+                1: {(e.seq, e.kind) for e in merged
+                    if e.node == n2 and e.hlc and e.hlc[2] == 1},
+                2: {(e.seq, e.kind) for e in merged
+                    if e.node == n2 and e.hlc and e.hlc[2] == 2},
+            }
+            colliding = by_life[1] & by_life[2]
+            assert colliding, "test needs overlapping (seq, kind) pairs"
+            # while a stable member's overlapping tails DO dedupe
+            n0 = str(h.addr(0))
+            n0_keys = [
+                (e.hlc[2], e.seq, e.kind) for e in merged
+                if e.node == n0 and e.hlc
+            ]
+            assert len(n0_keys) == len(set(n0_keys))
+        finally:
+            h.shutdown()
+
+    def test_durable_incarnation_survives_reopen(self, tmp_path):
+        from rapid_tpu.durability import DurablePartitionStore
+
+        store = DurablePartitionStore(
+            str(tmp_path), fsync_policy=FSYNC_NEVER
+        )
+        assert store.bump_incarnation() == 1
+        store.crash()
+        reopened = DurablePartitionStore(
+            str(tmp_path), fsync_policy=FSYNC_NEVER
+        )
+        assert reopened.incarnation == 1
+        assert reopened.bump_incarnation() == 2
+
+
+# ---------------------------------------------------------------------------
+# tools/forensics.py: the CI-shaped report/verify contract
+# ---------------------------------------------------------------------------
+
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, f"{REPO}/tools/forensics.py", *argv],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def _bundle_with(journal_events, path):
+    rec = FlightRecorder(capacity=64, node="10.0.0.1:9001",
+                         hlc=HlcClock(clock=lambda: 1000))
+    for kind, detail in journal_events:
+        rec.record(kind, virtual_ms=100, **detail)
+    local = capture_local_evidence(node="10.0.0.1:9001", recorder=rec)
+    bundle = build_bundle("explicit", local)
+    write_bundle(bundle, str(path))
+    return bundle
+
+
+class TestForensicsCli:
+    def test_seeded_stuck_handoff_exits_3(self, tmp_path):
+        path = tmp_path / "stuck.json"
+        _bundle_with([
+            ("handoff_started", {"sessions": 2, "version": 4}),
+            ("handoff_complete", {"partition": 0}),
+        ], path)
+        proc = _cli("report", str(path))
+        assert proc.returncode == 3, proc.stdout + proc.stderr
+        assert "stuck_handoff" in proc.stdout
+
+    def test_clean_bundle_exits_0(self, tmp_path):
+        path = tmp_path / "clean.json"
+        _bundle_with([
+            ("handoff_started", {"sessions": 1, "version": 4}),
+            ("handoff_complete", {"partition": 0}),
+        ], path)
+        proc = _cli("report", str(path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_verify_detects_tampering(self, tmp_path):
+        path = tmp_path / "bundle.json"
+        _bundle_with([("probe", {"peer": "x"})], path)
+        assert _cli("verify", str(path)).returncode == 0
+        doc = load_bundle(str(path))
+        doc["members"][0]["metrics"] = {"messages.forged": 1}
+        path.write_text(json.dumps(doc))
+        assert _cli("verify", str(path)).returncode == 3
+
+    def test_detectors_match_the_cli_verdict(self, tmp_path):
+        path = tmp_path / "stuck2.json"
+        bundle = _bundle_with([
+            ("handoff_started", {"sessions": 3, "version": 9}),
+        ], path)
+        findings = detect_signatures(merge_timeline([bundle]))
+        assert [f["signature"] for f in findings] == ["stuck_handoff"]
+        assert findings[0]["stuck"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Search-plane witnesses carry evidence when the flag is on
+# ---------------------------------------------------------------------------
+
+
+# the hand-minimized witness of the historical promote-sync bug
+# (tests/test_search.py): starve one replica of Puts, evict a leader, and
+# mute Get quorum traffic to the fresh replica
+BUG_PLAN = {"seed": 7, "rules": [
+    {"type": "DropRule", "at": "egress", "windows": [[0, None]],
+     "src": None, "dst": "node:7003", "msg_types": ["Put"],
+     "probability": 1.0},
+    {"type": "PartitionRule", "at": "egress", "windows": [[1200, None]],
+     "src": None, "dst": "node:7000", "msg_types": None},
+    {"type": "DropRule", "at": "egress", "windows": [[1200, None]],
+     "src": None, "dst": "node:7002", "msg_types": ["Get"],
+     "probability": 1.0},
+]}
+BUG_SPEC = {"harness": "engine", "n": 5, "partitions": 16, "replicas": 3,
+            "horizon_ms": 4000, "ops": 40, "keys": 6, "plan": BUG_PLAN}
+
+
+class TestSearchWitnessBundles:
+    def test_violating_probe_pins_a_verifiable_bundle(self, monkeypatch):
+        from rapid_tpu.search.runner import run_probe
+
+        # resurrect the historical promote-sync bug so the probe violates
+        monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
+        spec = dict(BUG_SPEC, forensics=True)
+        result = run_probe(spec)
+        assert result.violated
+        bundle = result.info.get("bundle")
+        assert bundle is not None
+        assert bundle["trigger"] == "invariant_violation"
+        assert "linearizability" in bundle["detail"]["kinds"]
+        assert verify_bundle(bundle)
+        assert merge_timeline([bundle]), "witness bundle has no journal"
+
+    def test_flag_off_probes_carry_no_bundle(self, monkeypatch):
+        from rapid_tpu.search.runner import run_probe
+
+        monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
+        result = run_probe(dict(BUG_SPEC))
+        assert result.violated
+        assert "bundle" not in result.info
+
+    def test_pin_to_file_writes_the_evidence_sidecar(self, tmp_path,
+                                                     monkeypatch):
+        from rapid_tpu.search.hunt import pin_to_file
+        from rapid_tpu.search.runner import run_probe
+
+        monkeypatch.setenv("RAPID_BUG_NEWROW_SYNC", "1")
+        witness = run_probe(dict(BUG_SPEC, forensics=True))
+        pin = {
+            "kinds": sorted({v["invariant"] for v in witness.violations}),
+            "spec": dict(BUG_SPEC, forensics=True),
+            "bundle": witness.info["bundle"],
+        }
+        path = tmp_path / "witness.json"
+        pin_to_file(pin, str(path), "witness", "pinned by the test")
+        # the corpus artifact itself carries no bundle (scenario replays
+        # stay byte-identical to flag-off pins)...
+        artifact = json.loads(path.read_text())
+        assert "bundle" not in artifact
+        # ...the evidence rides the sidecar, readable by the CLI
+        sidecar = load_bundle(str(path) + ".bundle.json")
+        assert verify_bundle(sidecar)
+        assert _cli("report", str(path) + ".bundle.json").returncode in (0, 3)

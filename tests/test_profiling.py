@@ -36,6 +36,7 @@ import pytest
 
 from golden.scrape_fixtures import (
     HISTORY_LINES,
+    HLC_RESPONSE,
     SCRAPE_REQUEST,
     SCRAPE_RESPONSE,
     SLO_RESPONSE,
@@ -375,6 +376,17 @@ def test_scrape_grpc_bytes_golden():
     assert parsed.slo_burn_milli == (150, 42100)
     assert parsed.slo_firing == (0, 1)
 
+    # the forensics digest (journal accounting + HLC, fields 41-45)
+    # rides the same response
+    wire = gt.to_wire_response(HLC_RESPONSE).SerializeToString(
+        deterministic=True
+    )
+    assert wire.hex() == GOLDEN["grpc"]["ClusterStatusResponse_hlc"]
+    parsed = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert parsed == HLC_RESPONSE
+    assert parsed.hlc_incarnation == 2
+    assert parsed.journal_dropped == 6
+
 
 def test_pre_profiling_frames_parse_to_defaults():
     """Rolling upgrade both ways: an old peer's frame (no scrape fields)
@@ -392,6 +404,8 @@ def test_pre_profiling_frames_parse_to_defaults():
     assert back == old_resp and back.history == ()
     # pre-SLO peers' frames fill the alert digest with its empty defaults
     assert back.slo_names == () and back.slo_firing == ()
+    # pre-forensics peers' frames fill the HLC digest with zeros
+    assert back.hlc_physical_ms == 0 and back.hlc_incarnation == 0
 
 
 # ---------------------------------------------------------------------------

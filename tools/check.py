@@ -73,6 +73,13 @@ RULE_DOCS = {
     "metric-emission": "every METRIC_CATALOG name needs an emitting call "
                        "site and every emission a catalog entry, or the "
                        "catalog and the dashboards drift apart",
+    "event-emission": "every EVENT_CATALOG kind needs an emitting call site "
+                      "and every journal/instant emission a catalog entry, "
+                      "or post-mortems grep for events that never happen",
+    "signature-catalog": "every anomaly signature needs a detector that "
+                         "emits it and every detector finding a catalog "
+                         "row, or forensic reports cite undocumented "
+                         "signatures",
     "slo-catalog": "every declared SLO must name a cataloged SLI and a "
                    "valid window pair with sane thresholds, or the burn "
                    "alerts evaluate garbage",
@@ -374,9 +381,10 @@ def check_wire_tags() -> list[Finding]:
     unique AND contiguous from 1 (so a new message -- e.g. the handoff
     messages after ClusterStatus -- must take the next number, never a gap
     or a reuse), EXCEPT that the request oneof may skip
-    TRACE_CTX_FIELD_NUMBER, which rides outside the oneof on the same
-    envelope and whose number is therefore reserved; no oneof number
-    collides with it outright. Msgpack-side: no dataclass field of any
+    the reserved envelope-rider numbers (TRACE_CTX_FIELD_NUMBER,
+    HLC_FIELD_NUMBER), which ride outside the oneof on the same envelope
+    and whose numbers are therefore reserved; no oneof number collides
+    with one of them outright. Msgpack-side: no dataclass field of any
     codec-carried message may start with ``__`` -- decode strips every
     ``__``-prefixed top-level key as an envelope extension, so such a
     field would silently vanish on the wire."""
@@ -448,7 +456,7 @@ def check_wire_tags() -> list[Finding]:
                 ))
 
     wanted = {"_MESSAGES", "_REQUEST_ONEOF", "_RESPONSE_ONEOF",
-              "TRACE_CTX_FIELD_NUMBER"}
+              "TRACE_CTX_FIELD_NUMBER", "HLC_FIELD_NUMBER"}
     lits = _module_literals(schema_path, wanted)
     for name in sorted(wanted - lits.keys()):
         findings.append(Finding(
@@ -479,7 +487,17 @@ def check_wire_tags() -> list[Finding]:
                         f"{msg_name} uses invalid field number {number}",
                     ))
 
-    trace_number = lits.get("TRACE_CTX_FIELD_NUMBER", (None, 0))[0]
+    # numbers reserved for the envelope riders (traceCtx, hlc): they sit on
+    # RapidRequest outside the oneof, so the oneof must skip them, never
+    # reuse them. Each new rider appends its NAME here and its number at
+    # the top of the envelope's free space, exactly like a proto
+    # `reserved` declaration.
+    reserved = {
+        name: lits[name][0]
+        for name in ("TRACE_CTX_FIELD_NUMBER", "HLC_FIELD_NUMBER")
+        if name in lits
+    }
+    reserved_numbers = set(reserved.values())
     for oneof_name in ("_REQUEST_ONEOF", "_RESPONSE_ONEOF"):
         if oneof_name not in lits:
             continue
@@ -491,30 +509,38 @@ def check_wire_tags() -> list[Finding]:
                 f"{oneof_name} reuses a field number: {sorted(numbers)}",
             ))
         # contiguity from 1, with one documented exception: the request
-        # oneof skips TRACE_CTX_FIELD_NUMBER (it rides outside the oneof on
-        # the same envelope, so its number is reserved, not free)
-        expected = list(range(1, len(numbers) + 1))
-        if (
-            oneof_name == "_REQUEST_ONEOF"
-            and trace_number is not None
-            and trace_number <= len(numbers)
-        ):
-            expected = [
-                n for n in range(1, len(numbers) + 2) if n != trace_number
-            ]
+        # oneof skips every reserved envelope-rider number (they live
+        # outside the oneof on the same envelope, so reserved, not free)
+        expected: list = []
+        candidate = 1
+        while len(expected) < len(numbers):
+            if not (
+                oneof_name == "_REQUEST_ONEOF"
+                and candidate in reserved_numbers
+            ):
+                expected.append(candidate)
+            candidate += 1
         if sorted(numbers) != expected:
             findings.append(Finding(
                 schema_path, line, "wire-tags",
                 f"{oneof_name} numbers {sorted(numbers)} are not contiguous "
-                "from 1 (modulo the reserved traceCtx number); new messages "
-                "must take the next free number",
+                "from 1 (modulo the reserved envelope-rider numbers "
+                f"{sorted(reserved_numbers)}); new messages must take the "
+                "next free number",
             ))
-        if trace_number is not None and trace_number in numbers:
-            findings.append(Finding(
-                schema_path, line, "wire-tags",
-                f"{oneof_name} number {trace_number} collides with "
-                "TRACE_CTX_FIELD_NUMBER (rides outside the oneof)",
-            ))
+        for rider, number in sorted(reserved.items()):
+            if number in numbers:
+                findings.append(Finding(
+                    schema_path, line, "wire-tags",
+                    f"{oneof_name} number {number} collides with "
+                    f"{rider} (rides outside the oneof)",
+                ))
+    if len(reserved_numbers) != len(reserved):
+        findings.append(Finding(
+            schema_path, 0, "wire-tags",
+            "two envelope riders share one reserved field number: "
+            f"{sorted(reserved.items())}",
+        ))
         if messages:
             for _, type_name, _ in entries:
                 if type_name not in messages:
@@ -635,6 +661,7 @@ SETTINGS_GROUPS = {
     "profiling": "ProfilingSettings",
     "durability": "DurabilitySettings",
     "slo": "SLOSettings",
+    "forensics": "ForensicsSettings",
 }
 
 
@@ -797,6 +824,121 @@ def check_metric_emission() -> list[Finding]:
                 path, lineno, "metric-emission",
                 f"emitted metric {name!r} is not in "
                 "observability.METRIC_CATALOG",
+            ))
+    return findings
+
+
+def check_event_emission() -> list[Finding]:
+    """Catalog-emission lint for journal/instant events (the two-sided
+    EVENT_CATALOG discipline, mirror of check_metric_emission).
+
+    The per-file ``unknown-span`` rule covers one direction at each call
+    site: a literal .event()/.record() kind must be cataloged. This check
+    closes the loop repo-wide: every EVENT_CATALOG kind must have at least
+    one emitting call site somewhere in rapid_tpu/ or scenarios.py -- a
+    cataloged kind nobody records is a stale doc a post-mortem will grep
+    bundles for in vain -- and every literal emission must be cataloged.
+    Conditional picks between literals (slo/burn.py's
+    ``"slo_alert_fired" if kind == "fired" else "slo_alert_cleared"``)
+    count for each branch, same as the metric scan."""
+    findings: list[Finding] = []
+    obs_path = REPO / "rapid_tpu" / "observability.py"
+    emitted: dict = {}  # kind -> (path, lineno) of first literal emission
+
+    for path in iter_py_files([REPO / "rapid_tpu", REPO / "scenarios.py"]):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            continue  # the syntax rule already owns this finding
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in EVENT_METHODS
+                and node.args
+            ):
+                continue
+            args = [node.args[0]]
+            if isinstance(node.args[0], ast.IfExp):
+                args = [node.args[0].body, node.args[0].orelse]
+            for arg in args:
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    emitted.setdefault(arg.value, (path, node.lineno))
+
+    for kind in sorted(EVENT_CATALOG):
+        if kind not in emitted:
+            findings.append(Finding(
+                obs_path, 0, "event-emission",
+                f"EVENT_CATALOG lists {kind!r} but no .event()/.record() "
+                "call site in rapid_tpu/ emits it",
+            ))
+    for kind, (path, lineno) in sorted(emitted.items()):
+        if kind not in EVENT_CATALOG:
+            findings.append(Finding(
+                path, lineno, "event-emission",
+                f"recorded event kind {kind!r} is not in "
+                "observability.EVENT_CATALOG",
+            ))
+    return findings
+
+
+def check_signature_catalog() -> list[Finding]:
+    """Anomaly-signature catalog lint over rapid_tpu/forensics/timeline.py.
+
+    SIGNATURE_CATALOG is the closed set of names forensic findings may
+    carry (tools/forensics.py exits 3 on any of them, operators route
+    pages by them). Two-sided freshness, same contract as RULE_CATALOG:
+    every catalog row needs a detector that emits it (a ``_finding(...)``
+    call with that literal name), every emitted name a catalog row with a
+    non-empty doc -- else reports cite signatures nobody documented, or
+    the catalog documents detectors that no longer exist."""
+    findings: list[Finding] = []
+    path = REPO / "rapid_tpu" / "forensics" / "timeline.py"
+
+    lits = _module_literals(path, {"SIGNATURE_CATALOG"})
+    if "SIGNATURE_CATALOG" not in lits:
+        findings.append(Finding(
+            path, 0, "signature-catalog",
+            "SIGNATURE_CATALOG not found or not a pure literal",
+        ))
+        return findings
+    catalog, cat_line = lits["SIGNATURE_CATALOG"]
+
+    emitted: dict = {}  # signature -> lineno of first _finding() literal
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_finding"
+            and node.args
+        ):
+            continue
+        args = [node.args[0]]
+        if isinstance(node.args[0], ast.IfExp):
+            args = [node.args[0].body, node.args[0].orelse]
+        for arg in args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                emitted.setdefault(arg.value, node.lineno)
+
+    for name, spec in sorted(catalog.items()):
+        if not (isinstance(spec, dict) and str(spec.get("doc", "")).strip()):
+            findings.append(Finding(
+                path, cat_line, "signature-catalog",
+                f"SIGNATURE_CATALOG[{name!r}] must carry a non-empty doc",
+            ))
+        if name not in emitted:
+            findings.append(Finding(
+                path, cat_line, "signature-catalog",
+                f"SIGNATURE_CATALOG lists {name!r} but no detector emits "
+                "it (_finding call with that literal name)",
+            ))
+    for name, lineno in sorted(emitted.items()):
+        if name not in catalog:
+            findings.append(Finding(
+                path, lineno, "signature-catalog",
+                f"detector emits signature {name!r} missing from "
+                "SIGNATURE_CATALOG",
             ))
     return findings
 
@@ -1062,6 +1204,8 @@ def run(paths: "list[str] | None" = None) -> list[Finding]:
     findings.extend(check_generator_reach())
     findings.extend(check_settings_catalog())
     findings.extend(check_metric_emission())
+    findings.extend(check_event_emission())
+    findings.extend(check_signature_catalog())
     findings.extend(check_slo_catalog())
     findings.extend(check_plan_corpus())
     return findings

@@ -57,6 +57,11 @@ def main(argv=None) -> int:
                              "scenarios/corpus/*.json)")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON instead of text")
+    parser.add_argument("--forensics", action="store_true",
+                        help="run probes with the HLC forensics mirror and "
+                             "pin each shrunk witness WITH its evidence "
+                             "bundle (--pin writes a .bundle.json sidecar "
+                             "readable by tools/forensics.py report)")
     args = parser.parse_args(argv)
 
     from rapid_tpu.search.hunt import Hunter, pin_to_file
@@ -64,7 +69,7 @@ def main(argv=None) -> int:
     hunter = Hunter(
         seed=args.seed, budget=args.budget, harness=args.harness,
         guided=not args.unguided, shrink=not args.no_shrink,
-        shrink_budget=args.shrink_budget,
+        shrink_budget=args.shrink_budget, forensics=args.forensics,
     )
     report = hunter.run()
 

@@ -22,9 +22,18 @@ tail latency, lost acked writes, SLO availability/goodput and firing burn
 alerts, messaging throughput, gray-detection speedup), rc 3 on any
 breach -- the CI-shaped form of the same comparison.
 
+``trend`` -- the headline + per-dimension trajectory across a SERIES of
+bench runs (the repo's BENCH_rNN.json wrappers or raw bench lines), so
+the perf history stops being hand-maintained prose. Runs whose wrapper
+carries rc 17 (bench.py's accelerator-unreachable watchdog exit) or no
+parseable artifact are rendered as OUTAGE markers -- an unreachable
+device is an environment fact, never plotted as a regression; rc 3 only
+when two *measured* neighbours drift beyond the threshold.
+
     python tools/perfscope.py render metrics.json
     python tools/perfscope.py diff old_bench.json new_bench.json
     python tools/perfscope.py check bench.json
+    python tools/perfscope.py trend BENCH_r*.json
 """
 
 from __future__ import annotations
@@ -192,10 +201,11 @@ def chrome_trace_events(phases: Dict[str, Tuple[float, float]]) -> Dict[str, obj
 # --------------------------------------------------------------------------- #
 
 
-def load_bench_artifact(path: str) -> dict:
-    """The bench's single JSON line (tolerating surrounding log lines: the
-    first line that parses as a dict with a 'metric' key wins)."""
-    for line in open(path).read().splitlines():
+def _bench_line(text: str) -> Optional[dict]:
+    """The first line of ``text`` that parses as a bench artifact (a dict
+    with a 'metric' key), or None -- shared by the file and wrapper-tail
+    loaders."""
+    for line in text.splitlines():
         line = line.strip()
         if not line.startswith("{"):
             continue
@@ -205,7 +215,131 @@ def load_bench_artifact(path: str) -> dict:
             continue
         if isinstance(doc, dict) and "metric" in doc:
             return doc
-    raise ValueError(f"{path}: no bench JSON artifact line found")
+    return None
+
+
+def load_bench_artifact(path: str) -> dict:
+    """The bench's single JSON line (tolerating surrounding log lines: the
+    first line that parses as a dict with a 'metric' key wins)."""
+    doc = _bench_line(open(path).read())
+    if doc is None:
+        raise ValueError(f"{path}: no bench JSON artifact line found")
+    return doc
+
+
+# rc 17 is bench.py's watchdog exit: the accelerator never answered, so
+# the run measured the environment, not the code (BENCH_r03-r05 carry it)
+OUTAGE_RC = 17
+
+
+def load_trend_entry(path: str) -> dict:
+    """One point on the perf-history trajectory. Accepts the repo's
+    BENCH_rNN.json run wrapper ({"n", "rc", "tail", "parsed"}) or a raw
+    bench artifact file; returns {path, n, rc, artifact} where artifact is
+    None for an outage (watchdog rc, or nothing parseable)."""
+    text = open(path).read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "rc" in doc and "tail" in doc:
+        artifact = doc.get("parsed")
+        if not isinstance(artifact, dict):  # older wrappers: re-scan tail
+            artifact = _bench_line(str(doc.get("tail", "")))
+        rc = int(doc.get("rc", 0))
+        if rc != 0:
+            artifact = None  # a failed run's partial line proves nothing
+        return {"path": path, "n": doc.get("n"), "rc": rc,
+                "artifact": artifact}
+    artifact = _bench_line(text)
+    return {"path": path, "n": None, "rc": 0 if artifact else None,
+            "artifact": artifact}
+
+
+def trend_report(entries: List[dict],
+                 threshold: float = DEFAULT_THRESHOLD) -> Tuple[str, List[str]]:
+    """The trajectory report plus regression descriptions. Entries sort by
+    run number (wrapper "n") with path as tiebreaker; outage entries are
+    rendered in place but never compared -- each measured run diffs
+    against the previous *measured* run, skipping outages between them."""
+    entries = sorted(
+        entries,
+        key=lambda e: (e["n"] if isinstance(e["n"], int) else 1 << 30,
+                       e["path"]),
+    )
+    measured = [e for e in entries if e["artifact"] is not None]
+    lines: List[str] = [
+        f"bench trend across {len(entries)} runs "
+        f"({len(measured)} measured, {len(entries) - len(measured)} outage):"
+    ]
+    regressions: List[str] = []
+
+    def run_label(entry: dict) -> str:
+        if isinstance(entry["n"], int):
+            return f"r{entry['n']:02d}"
+        name = entry["path"].rsplit("/", 1)[-1]
+        return name[:-5] if name.endswith(".json") else name
+
+    width = max((len(run_label(e)) for e in entries), default=3)
+    headline_max = max(
+        (float(e["artifact"].get("value") or 0.0) for e in measured),
+        default=0.0,
+    )
+    prev: Optional[dict] = None
+    for entry in entries:
+        label = run_label(entry)
+        artifact = entry["artifact"]
+        if artifact is None or artifact.get("value") is None:
+            why = (
+                f"rc {entry['rc']}" if entry["rc"] not in (0, None)
+                else "no artifact"
+            )
+            lines.append(
+                f"  {label:<{width}}  {'OUTAGE':<{BAR_WIDTH}}  ({why}: "
+                "accelerator/environment, not a perf point)"
+            )
+            continue
+        value = float(artifact["value"])
+        bar = "#" * max(
+            1, round(value / headline_max * BAR_WIDTH)
+        ) if headline_max > 0 else ""
+        suffix = ""
+        if prev is not None:
+            old_v = float(prev["artifact"]["value"])
+            pct = (value - old_v) / old_v * 100.0 if old_v else 0.0
+            suffix = f"  ({pct:+.1f}% vs {run_label(prev)})"
+            if old_v > 0 and value > old_v * (1.0 + threshold):
+                regressions.append(
+                    f"headline {run_label(prev)} -> {label}: "
+                    f"{old_v:.1f} -> {value:.1f} ms"
+                )
+        lines.append(
+            f"  {label:<{width}}  {bar:<{BAR_WIDTH}}  {value:8.1f} ms"
+            f"{suffix}"
+        )
+        prev = entry
+    # per-dimension trajectories: every budget-table path any measured
+    # artifact carries, one row per dimension leaf
+    seen_paths: List[Tuple[str, Tuple[str, ...]]] = []
+    for dimension, path, _, _ in DIMENSION_BUDGETS:
+        if (dimension, path) in seen_paths:
+            continue
+        if any(_walk(e["artifact"], path) is not None for e in measured):
+            seen_paths.append((dimension, path))
+    for dimension, path in seen_paths:
+        label = ".".join(path)
+        points = []
+        for entry in entries:
+            if entry["artifact"] is None:
+                points.append(f"{run_label(entry)}=outage")
+                continue
+            got = _walk(entry["artifact"], path)
+            points.append(
+                f"{run_label(entry)}={got:g}" if got is not None
+                else f"{run_label(entry)}=--"
+            )
+        lines.append(f"  {dimension:<9} {label}: {' '.join(points)}")
+    return "\n".join(lines), regressions
 
 
 # Per-dimension budgets for the ``check`` subcommand, beyond the headline
@@ -368,6 +502,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          help="headline budget (default: the BASELINE.json "
                          "north-star 5000ms)")
 
+    p_trend = sub.add_parser(
+        "trend", help="headline + per-dimension trajectory across a series "
+        "of bench runs (BENCH_rNN.json wrappers or raw artifacts); outage "
+        "runs are marked, never counted as regressions"
+    )
+    p_trend.add_argument("artifacts", nargs="+",
+                         help="bench run files, e.g. BENCH_r*.json")
+    p_trend.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                         help="regression threshold between consecutive "
+                         f"measured runs (default {DEFAULT_THRESHOLD})")
+
     args = parser.parse_args(argv)
 
     if args.cmd == "render":
@@ -388,6 +533,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         for reg in regressions:
             print(f"REGRESSION: {reg}", file=sys.stderr)
         return 3 if regressions else 0
+
+    if args.cmd == "trend":
+        entries = [load_trend_entry(path) for path in args.artifacts]
+        text, regressions = trend_report(entries, threshold=args.threshold)
+        print(text)
+        for reg in regressions:
+            print(f"REGRESSION: {reg}", file=sys.stderr)
+        if regressions:
+            return 3
+        return 0 if any(e["artifact"] for e in entries) else 2
 
     # check
     doc = load_bench_artifact(args.artifact)
