@@ -79,7 +79,7 @@ WATCHDOG_S = 20 * 60
 _PROGRESS: dict = {
     "headline": None, "backend": None, "sweep": [], "wan": None,
     "serving": None, "messaging": None, "gray_detection": None,
-    "recovery": None,
+    "recovery": None, "hierarchy": None,
 }
 
 # jitwatch compile accounting of the most recent warmed_run (warmup vs
@@ -115,6 +115,17 @@ SERVING_SLO_WINDOW_SCALE = 0.001
 # rapid_tpu/faults.py:apply_topology). 0 = the flat-fabric control point.
 WAN_N_NODES = 2_000
 WAN_RTTS_MS = (0, 500, 1000)
+
+# Hierarchy dimension: flat vs hierarchical A/B on the same seed. The flat
+# anchor is sized at the scale Rapid's published evaluation stops (2k
+# members in one flat configuration); the hierarchical leg seats 10x that
+# across HIER_CELLS cells and must still converge the same 1% crash with
+# cut parity, a composed global view matching a from-scratch recompute,
+# and composition work billed per touched cell (O(cells), not O(members)).
+HIER_FLAT_N = 2_000
+HIER_SCALE_FACTOR = 10
+HIER_CELLS = 8
+HIER_PARENT_ROUND_MS = 4
 
 # Messaging dimension: real-socket transport throughput on loopback. Two
 # workloads -- a pipelined request/response pair (RPC round-trip rate) and a
@@ -303,6 +314,7 @@ def _emit_json(headline: dict, backend: str, sweep: list) -> None:
                 "messaging_throughput": _PROGRESS["messaging"],
                 "gray_detection_ms": _PROGRESS["gray_detection"],
                 "recovery_time_ms": _PROGRESS["recovery"],
+                "hierarchy_scale": _PROGRESS["hierarchy"],
                 "time_to_stable_view_ms": _stable_view_hist(),
                 "placement_partitions_moved": _placement_hist(),
                 "handoff_session_bytes": _handoff_hist(),
@@ -601,6 +613,16 @@ def run_sweep(backend: str, seed: int) -> list:
         _PROGRESS["recovery"] = {"error": f"{type(exc).__name__}: {exc}"}
         print(f"bench.py: recovery dimension failed: {exc}",
               file=sys.stderr, flush=True)
+    # hierarchy dimension: the flat-vs-hierarchical scale A/B; a parity or
+    # composition-agreement failure is a correctness bug and crashes
+    try:
+        run_hierarchy_dimension(seed)
+    except AssertionError:
+        raise
+    except Exception as exc:  # noqa: BLE001 -- keep the artifact
+        _PROGRESS["hierarchy"] = {"error": f"{type(exc).__name__}: {exc}"}
+        print(f"bench.py: hierarchy dimension failed: {exc}",
+              file=sys.stderr, flush=True)
     return out
 
 
@@ -620,29 +642,108 @@ def run_wan_dimension(seed: int) -> list:
     out = _PROGRESS["wan"] = []
     rng = np.random.default_rng(seed)
     for rtt in WAN_RTTS_MS:
-        config = SimConfig(capacity=n, groups=2, max_delivery_delay=2,
-                           rounds_per_interval=4)
-        sim = Simulator(n, config=config, seed=seed)
-        if rtt:
-            topo = LatencyTopology(racks=2, zones=2, regions=2,
-                                   rack_rtt_ms=0, zone_rtt_ms=0,
-                                   region_rtt_ms=0, inter_region_rtt_ms=rtt)
-            apply_topology(sim, topo)
+        # one victim draw per RTT point: the flat measurement and the
+        # hierarchical (region = cell) leg replay the identical workload,
+        # so hier_virtual_ms is a same-seed cross-region agreement latency
         victims = rng.choice(n, size=n // 100, replace=False)
+        entry = {"inter_region_rtt_ms": rtt, "n": n}
+        for prefix, hierarchical in (("", False), ("hier_", True)):
+            config = SimConfig(capacity=n, groups=2, max_delivery_delay=2,
+                               rounds_per_interval=4)
+            sim = Simulator(n, config=config, seed=seed)
+            topo = None
+            if rtt:
+                topo = LatencyTopology(racks=2, zones=2, regions=2,
+                                       rack_rtt_ms=0, zone_rtt_ms=0,
+                                       region_rtt_ms=0,
+                                       inter_region_rtt_ms=rtt)
+                apply_topology(sim, topo)
+            if hierarchical:
+                # zone-aligned cells when a topology is present (one cell
+                # per region); rendezvous split at the control point
+                sim.enable_hierarchy(
+                    cells=2, topology=topo,
+                    parent_round_ms=HIER_PARENT_ROUND_MS,
+                )
+            sim.crash(victims)
+            t0 = time.perf_counter()
+            record = sim.run_until_decision(max_rounds=64, batch=16)
+            wall_ms = (time.perf_counter() - t0) * 1000.0
+            assert record is not None, f"no decision at inter-region RTT {rtt}"
+            assert set(record.cut) == set(victims), (
+                f"cut-set parity violated at inter-region RTT {rtt}"
+            )
+            entry[prefix + "virtual_ms"] = record.virtual_time_ms
+            entry[prefix + "wall_ms"] = round(wall_ms, 1)
+            if hierarchical:
+                entry["hier_parent_rounds"] = sim.parent_rounds
+        out.append(entry)
+    return out
+
+
+def run_hierarchy_dimension(seed: int) -> dict:
+    """Flat vs hierarchical A/B on the same seed: the flat anchor runs
+    HIER_FLAT_N members in one configuration; the hierarchical leg seats
+    HIER_SCALE_FACTOR times as many across HIER_CELLS cells and must
+    converge the same 1% correlated crash with cut parity, a composed
+    global view that matches a from-scratch recompute, and at least one
+    parent round billed on the virtual clock. member_ceiling_ratio is the
+    scale claim the perfscope budget table gates (>= 10x)."""
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.sim.engine import SimConfig
+
+    out = _PROGRESS["hierarchy"] = {}
+
+    def leg(n: int, cells: int) -> dict:
+        config = SimConfig(capacity=n, rounds_per_interval=4)
+        sim = Simulator(n, config=config, seed=seed)
+        if cells:
+            sim.enable_hierarchy(cells=cells,
+                                 parent_round_ms=HIER_PARENT_ROUND_MS)
+        rng = np.random.default_rng(seed)  # same draw for both legs' n-th
+        victims = rng.choice(n, size=max(1, n // 100), replace=False)
         sim.crash(victims)
         t0 = time.perf_counter()
         record = sim.run_until_decision(max_rounds=64, batch=16)
         wall_ms = (time.perf_counter() - t0) * 1000.0
-        assert record is not None, f"no decision at inter-region RTT {rtt}"
+        assert record is not None, f"no decision at n={n} cells={cells}"
         assert set(record.cut) == set(victims), (
-            f"cut-set parity violated at inter-region RTT {rtt}"
+            f"cut-set parity violated at n={n} cells={cells}"
         )
-        out.append({
-            "inter_region_rtt_ms": rtt,
+        entry = {
             "n": n,
             "virtual_ms": record.virtual_time_ms,
             "wall_ms": round(wall_ms, 1),
-        })
+            "cut_ok": True,
+        }
+        if cells:
+            rows = sim.hierarchy_rows()
+            composed = sim.global_fingerprint()
+            for cell in range(cells):
+                sim._hierarchy_recompute_cell(cell)  # noqa: SLF001
+            assert sim.global_fingerprint() == composed, (
+                "incremental composition diverged from recompute"
+            )
+            entry.update({
+                "cells": cells,
+                "live_cells": len(rows),
+                "parent_rounds": sim.parent_rounds,
+                "fingerprint_ok": True,
+            })
+        return entry
+
+    flat = leg(HIER_FLAT_N, 0)
+    hier = leg(HIER_FLAT_N * HIER_SCALE_FACTOR, HIER_CELLS)
+    ratio = hier["n"] / flat["n"]
+    assert ratio >= 10.0, f"hierarchical leg seats only {ratio:.1f}x"
+    assert hier["parent_rounds"] >= 1, "no parent round billed"
+    out.update({
+        "cells": HIER_CELLS,
+        "flat": flat,
+        "hierarchical": hier,
+        "member_ceiling_ratio": round(ratio, 1),
+        "agreement_virtual_ms": hier["virtual_ms"],
+    })
     return out
 
 

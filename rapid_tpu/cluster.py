@@ -114,6 +114,13 @@ class Cluster:
         post-mortem can dump it after shutdown."""
         return self._membership_service.recorder
 
+    @property
+    def hierarchy(self):
+        """The hierarchy plane (hierarchy/plane.py), or None when
+        ``settings.hierarchy`` is off. Harnesses use it to seed parent
+        bootstrap hints and to read the composed global view."""
+        return self._membership_service.hierarchy
+
     def capture_bundle(self, path: Optional[str] = None, *,
                        trigger: str = "explicit",
                        detail: Optional[Dict[str, object]] = None,

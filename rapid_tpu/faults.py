@@ -55,6 +55,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from .hierarchy.cells import cell_of as _hier_cell_of
 from .runtime.lockdep import make_lock
 from .messaging.base import IMessagingClient, IMessagingServer
 from .messaging.retries import call_with_retries
@@ -131,6 +132,19 @@ class DropRule(Rule):
 @dataclass(frozen=True)
 class PartitionRule(Rule):
     """Deterministic one-way cut while a window is open (iptables INPUT)."""
+
+
+@dataclass(frozen=True)
+class CellPartitionRule(Rule):
+    """Hierarchy-plane fault: cut every link CROSSING cell ``cell``'s
+    boundary while a window is open, leaving intra-cell traffic alone --
+    the cell keeps running Rapid internally but its leader can no longer
+    reach peer leaders (and vice versa). ``cells`` is the rendezvous cell
+    count (hierarchy/cells.py); with a plan topology the zone is the cell,
+    matching the engine's assignment discipline."""
+
+    cell: int = 0
+    cells: int = 2
 
 
 @dataclass(frozen=True)
@@ -261,6 +275,7 @@ class DiskStallRule(Rule):
 RULE_CATALOG = {
     "DropRule": "compiled",        # -> Simulator.ingress_loss
     "PartitionRule": "compiled",   # -> Simulator.one_way_ingress_partition
+    "CellPartitionRule": "compiled",  # cell slots -> ingress partition
     "FlipFlopRule": "compiled",    # -> partition toggled at phase edges
     "LossyLinkRule": "compiled",   # -> Simulator.ingress_loss
     "SlowNodeRule": "compiled",    # >= one round -> partition-equivalent
@@ -378,6 +393,23 @@ class FaultPlan:
                           at: str = EGRESS) -> "FaultPlan":
         return self._add(PartitionRule(
             match=self._match(src, dst, None), at=at, windows=windows,
+        ))
+
+    def cell_partition(self, cell: int, cells: int,
+                       windows: Tuple[Window, ...] = _ALWAYS,
+                       at: str = EGRESS) -> "FaultPlan":
+        """Isolate hierarchy cell ``cell`` (of ``cells``) from every other
+        cell while a window is open: cross-boundary messages drop in both
+        directions, intra-cell traffic is untouched."""
+        if cells < 2:
+            raise ValueError(
+                f"a cell partition needs >= 2 cells, got {cells}"
+            )
+        if not 0 <= cell < cells:
+            raise ValueError(f"cell {cell} outside [0, {cells})")
+        return self._add(CellPartitionRule(
+            match=self._match(None, None, None), at=at, windows=windows,
+            cell=cell, cells=cells,
         ))
 
     def flip_flop(self, period_ms: int, src: Optional[Endpoint] = None,
@@ -598,6 +630,9 @@ def _rule_to_json(rule: Rule) -> dict:
     if isinstance(rule, FlipFlopRule):
         spec["period_ms"] = rule.period_ms
         spec["start_ms"] = rule.start_ms
+    elif isinstance(rule, CellPartitionRule):
+        spec["cell"] = rule.cell
+        spec["cells"] = rule.cells
     elif isinstance(rule, DropRule):  # includes LossyLinkRule
         spec["probability"] = rule.probability
     elif isinstance(rule, DelayRule):
@@ -649,6 +684,9 @@ def _build_rule(plan: FaultPlan, spec: dict) -> None:
         plan.drop(float(spec["probability"]), **common)
     elif kind == "PartitionRule":
         plan.partition_one_way(src=src, dst=dst, windows=windows, at=at)
+    elif kind == "CellPartitionRule":
+        plan.cell_partition(int(spec["cell"]), int(spec["cells"]),
+                            windows=windows, at=at)
     elif kind == "FlipFlopRule":
         plan.flip_flop(int(spec["period_ms"]), src=src, dst=dst,
                        start_ms=int(spec.get("start_ms", 0)),
@@ -836,8 +874,22 @@ class Nemesis:
                 continue
             if not rule.active_at(t):
                 continue
-            if isinstance(rule, (PartitionRule, FlipFlopRule,
-                                 RestartNodeRule)):
+            if isinstance(rule, CellPartitionRule):
+                # cross-boundary cut: drop iff exactly one end is inside
+                # the partitioned cell (intra-cell traffic untouched)
+                if src is not None and dst is not None:
+                    in_src = _hier_cell_of(
+                        src, rule.cells, topology=self.plan.topology,
+                        slots=self.plan.topology_slots or None,
+                    ) == rule.cell
+                    in_dst = _hier_cell_of(
+                        dst, rule.cells, topology=self.plan.topology,
+                        slots=self.plan.topology_slots or None,
+                    ) == rule.cell
+                    if in_src != in_dst:
+                        out.drop = True
+            elif isinstance(rule, (PartitionRule, FlipFlopRule,
+                                   RestartNodeRule)):
                 # a down-window restart victim is, to the message plane, a
                 # one-way cut; its recovery semantics live in the harness
                 out.drop = True
@@ -1167,6 +1219,16 @@ def endpoint_slots(sim) -> Dict[Endpoint, int]:
     }
 
 
+def _slot_cell(sim, plan: FaultPlan, slot: int, cells: int) -> int:
+    """Hierarchy cell of a device slot: topology zone when the plan carries
+    one (slots ARE topology indices), rendezvous over the slot's seated
+    endpoint otherwise -- the same precedence hierarchy/cells.py applies."""
+    if plan.topology is not None:
+        return plan.topology.zone_of(slot)
+    host, port = sim.endpoint_of(slot)
+    return _hier_cell_of(Endpoint(hostname=host, port=port), cells)
+
+
 def apply_plan_at(sim, plan: FaultPlan, t_ms: int,
                   slots: Optional[Dict[Endpoint, int]] = None) -> None:
     """Set the simulator's fault arrays to the plan's state at plan-time
@@ -1182,6 +1244,19 @@ def apply_plan_at(sim, plan: FaultPlan, t_ms: int,
     cut: List[int] = []
     for idx, rule in _device_rules(plan, round_ms):
         if not rule.active_at(t_ms):
+            continue
+        if isinstance(rule, CellPartitionRule):
+            # cell -> slot expansion: to the probe fabric outside the
+            # boundary, every member of the isolated cell is probe-dead
+            # (one-way ingress cut) -- the cell's internal traffic is not
+            # modeled per-link on device, so the compilation captures the
+            # externally visible outcome (the cell ages out of the
+            # composed view)
+            cut.extend(
+                s for s in range(sim.config.capacity)
+                if sim.active[s]
+                and _slot_cell(sim, plan, s, rule.cells) == rule.cell
+            )
             continue
         if rule.match.dst is not None:
             targets = [slots[rule.match.dst]]

@@ -85,6 +85,8 @@ _TYPES: Tuple[Type, ...] = (
     T.Put,  # 23
     T.PutAck,  # 24
     T.MessageBatch,  # 25
+    T.CellDigestMessage,  # 26
+    T.GlobalViewMessage,  # 27
 )
 _TAG_OF = {cls: tag for tag, cls in enumerate(_TYPES)}
 

@@ -187,6 +187,26 @@ def to_wire_request(msg: T.RapidMessage):
         # oneof discriminator and trace context unchanged
         for inner in msg.messages:
             b.requests.append(to_wire_request(inner))
+    elif isinstance(msg, T.CellDigestMessage):
+        c = req.cellDigestMessage
+        c.sender.CopyFrom(_ep(msg.sender))
+        c.cell = msg.cell
+        c.configurationId = msg.configuration_id
+        c.membershipSize = msg.membership_size
+        c.leader = msg.leader
+        c.fingerprint = msg.fingerprint
+        c.parentRound = msg.parent_round
+    elif isinstance(msg, T.GlobalViewMessage):
+        g = req.globalViewMessage
+        g.sender.CopyFrom(_ep(msg.sender))
+        g.parentConfigurationId = msg.parent_configuration_id
+        g.globalFingerprint = msg.global_fingerprint
+        g.cells.extend(msg.cells)
+        g.epochs.extend(msg.epochs)
+        g.sizes.extend(msg.sizes)
+        g.leaders.extend(msg.leaders)
+        g.fingerprints.extend(msg.fingerprints)
+        g.parentRound = msg.parent_round
     else:
         raise TypeError(f"not a request type: {type(msg).__name__}")
     ctx = trace_context_of(msg)
@@ -337,6 +357,30 @@ def _from_wire_request_content(req) -> T.RapidMessage:
             sender=_ep_back(m.sender),
             messages=tuple(from_wire_request(r) for r in m.requests),
         )
+    if which == "cellDigestMessage":
+        m = req.cellDigestMessage
+        return T.CellDigestMessage(
+            sender=_ep_back(m.sender),
+            cell=int(m.cell),
+            configuration_id=int(m.configurationId),
+            membership_size=int(m.membershipSize),
+            leader=str(m.leader),
+            fingerprint=int(m.fingerprint),
+            parent_round=int(m.parentRound),
+        )
+    if which == "globalViewMessage":
+        m = req.globalViewMessage
+        return T.GlobalViewMessage(
+            sender=_ep_back(m.sender),
+            parent_configuration_id=int(m.parentConfigurationId),
+            global_fingerprint=int(m.globalFingerprint),
+            cells=tuple(int(c) for c in m.cells),
+            epochs=tuple(int(e) for e in m.epochs),
+            sizes=tuple(int(s) for s in m.sizes),
+            leaders=tuple(str(l) for l in m.leaders),
+            fingerprints=tuple(int(f) for f in m.fingerprints),
+            parent_round=int(m.parentRound),
+        )
     raise ValueError(f"empty RapidRequest envelope: {which}")
 
 
@@ -403,6 +447,14 @@ def to_wire_response(msg) :
         s.hlcPhysicalMs = msg.hlc_physical_ms
         s.hlcLogical = msg.hlc_logical
         s.hlcIncarnation = msg.hlc_incarnation
+        s.cellId = msg.cell_id
+        s.cellSize = msg.cell_size
+        s.parentConfigurationId = msg.parent_configuration_id
+        s.globalFingerprint = msg.global_fingerprint
+        s.globalCells.extend(msg.global_cells)
+        s.globalEpochs.extend(msg.global_epochs)
+        s.globalSizes.extend(msg.global_sizes)
+        s.globalLeaders.extend(msg.global_leaders)
     elif isinstance(msg, T.PutAck):
         a = resp.putAck
         a.sender.CopyFrom(_ep(msg.sender))
@@ -496,6 +548,14 @@ def from_wire_response(resp):
             hlc_physical_ms=int(m.hlcPhysicalMs),
             hlc_logical=int(m.hlcLogical),
             hlc_incarnation=int(m.hlcIncarnation),
+            cell_id=int(m.cellId),
+            cell_size=int(m.cellSize),
+            parent_configuration_id=int(m.parentConfigurationId),
+            global_fingerprint=int(m.globalFingerprint),
+            global_cells=tuple(int(c) for c in m.globalCells),
+            global_epochs=tuple(int(e) for e in m.globalEpochs),
+            global_sizes=tuple(int(s) for s in m.globalSizes),
+            global_leaders=tuple(str(l) for l in m.globalLeaders),
         )
     if which == "putAck":
         m = resp.putAck

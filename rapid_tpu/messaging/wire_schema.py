@@ -186,6 +186,18 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("hlcPhysicalMs", "int64", 43, False),
         ("hlcLogical", "int64", 44, False),
         ("hlcIncarnation", "int64", 45, False),
+        # hierarchy plane exposure: the member's cell, its cell-local
+        # size, the parent (leader-set) configuration id, the composed
+        # global fingerprint, and the per-cell rows of the composed view
+        # as parallel arrays (append-only per the PR 3/13 pattern)
+        ("cellId", "int64", 46, False),
+        ("cellSize", "int64", 47, False),
+        ("parentConfigurationId", "int64", 48, False),
+        ("globalFingerprint", "int64", 49, False),
+        ("globalCells", "int64", 50, True),
+        ("globalEpochs", "int64", 51, True),
+        ("globalSizes", "int64", 52, True),
+        ("globalLeaders", "string", 53, True),
     ],
     "HandoffRequest": [
         ("sender", "M:Endpoint", 1, False),
@@ -244,6 +256,29 @@ _MESSAGES: Dict[str, List[Tuple[str, str, int, bool]]] = {
         ("sender", "M:Endpoint", 1, False),
         ("requests", "M:RapidRequest", 2, True),
     ],
+    # hierarchy plane (PR 19): a leader's announcement of its cell's row
+    # (leader-to-leader) and the composed global view a leader fans back
+    # into its own cell (leader-to-cell), as parallel per-cell arrays
+    "CellDigestMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("cell", "int64", 2, False),
+        ("configurationId", "int64", 3, False),
+        ("membershipSize", "int64", 4, False),
+        ("leader", "string", 5, False),
+        ("fingerprint", "int64", 6, False),
+        ("parentRound", "int64", 7, False),
+    ],
+    "GlobalViewMessage": [
+        ("sender", "M:Endpoint", 1, False),
+        ("parentConfigurationId", "int64", 2, False),
+        ("globalFingerprint", "int64", 3, False),
+        ("cells", "int64", 4, True),
+        ("epochs", "int64", 5, True),
+        ("sizes", "int64", 6, True),
+        ("leaders", "string", 7, True),
+        ("fingerprints", "int64", 8, True),
+        ("parentRound", "int64", 9, False),
+    ],
 }
 
 # Trace context rides OUTSIDE the request oneof (a sibling of `content`):
@@ -279,6 +314,9 @@ _REQUEST_ONEOF = [
     ("get", "Get", 14),
     ("put", "Put", 16),
     ("messageBatch", "MessageBatch", 17),
+    # 19/20 are hierarchy-plane extensions (18 is reserved for hlc above)
+    ("cellDigestMessage", "CellDigestMessage", 19),
+    ("globalViewMessage", "GlobalViewMessage", 20),
 ]
 _RESPONSE_ONEOF = [
     ("joinResponse", "JoinResponse", 1),

@@ -179,6 +179,10 @@ METRIC_CATALOG = frozenset({
     "slo.firing",          # burn alerts currently firing (gauge)
     "slo.alerts_fired",    # burn-alert fire transitions
     "slo.alerts_cleared",  # burn-alert clear transitions (recovery)
+    # hierarchy plane (hierarchy/, sim/driver.py)
+    "hierarchy.cells",          # configured cell count (gauge)
+    "hierarchy.live_cells",     # cells present in the composed global view
+    "hierarchy.parent_rounds",  # parent configuration rounds advanced
 })
 
 # Dynamic name families: an f-string call site is legal iff its literal head
@@ -228,6 +232,7 @@ EVENT_CATALOG = frozenset({
     "slo_alert_fired",   # multi-window burn-rate alert started firing
     "slo_alert_cleared",  # burn rates fell back under the clear threshold
     "bundle_captured",   # forensic evidence bundle written (trigger + path)
+    "parent_round",      # hierarchy parent round advanced (composition moved)
 })
 
 # Histogram bucket upper edges (``le``, inclusive -- Prometheus convention).

@@ -25,6 +25,7 @@ INVARIANTS = (
     "gray-collateral",
     "durability",
     "metastable-recovery",
+    "hierarchy-agreement",
 )
 
 
@@ -362,6 +363,48 @@ def check_leader_agreement(
                 f"split-brain on partition {p}: leaders {named} claimed "
                 f"by {sorted(claims[p])}",
             )
+
+
+def check_hierarchy_agreement(
+    digests: Mapping[str, Tuple[Sequence[int], Sequence[str], int]],
+) -> None:
+    """``(global_cells, global_leaders, global_fingerprint)`` per node --
+    the hierarchy plane's status digest (ClusterStatusResponse fields, or
+    HierarchyPlane.status_fields in-process). Two invariants:
+
+    * **composed-view convergence**: once quiesced, every member's
+      composed global view folds to the same fingerprint over the same
+      cell set (everyone adopted the parent decision);
+    * **single live leader per cell**: no two members name different
+      leaders for one cell -- a cell partition may stall the composition
+      but must never split a cell's leadership (leader order is a pure
+      function of the cell view, so disagreement means the views split).
+    """
+    fingerprints: Dict[int, List[str]] = {}
+    claims: Dict[int, Dict[str, str]] = {}
+    for node in sorted(digests):
+        cells, leaders, fingerprint = digests[node]
+        fingerprints.setdefault(int(fingerprint), []).append(node)
+        for cell, leader in zip(cells, leaders):
+            claims.setdefault(int(cell), {})[node] = leader
+    for cell in sorted(claims):
+        named = sorted(set(claims[cell].values()))
+        if len(named) > 1:
+            raise InvariantViolation(
+                "hierarchy-agreement",
+                f"two live leaders for cell {cell}: {named} claimed by "
+                f"{sorted(claims[cell])}",
+            )
+    if len(fingerprints) > 1:
+        parts = "; ".join(
+            f"{fp} on {', '.join(nodes)}"
+            for fp, nodes in sorted(fingerprints.items())
+        )
+        raise InvariantViolation(
+            "hierarchy-agreement",
+            f"composed global views diverged across "
+            f"{len(digests)} members: {parts}",
+        )
 
 
 def check_config_parity(stamped: int, recomputed: int) -> None:

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..faults import (
     EGRESS,
+    CellPartitionRule,
     FaultPlan,
     FlipFlopRule,
     Nemesis,
@@ -169,6 +170,28 @@ class ServingFabric:
         out: List[Tuple[int, Endpoint]] = []
         victims: Set[Endpoint] = set()
         for rule in plan.rules:
+            if isinstance(rule, CellPartitionRule):
+                # a lasting cell partition isolates the named cell from the
+                # rest of the fabric: outside the boundary every member of
+                # that cell is probe-dead, so the FD evicts the whole cell
+                # (the same externally visible outcome apply_plan_at
+                # compiles for the device plane)
+                from ..hierarchy.cells import cell_of
+
+                for start, end in rule.windows:
+                    if end is not None and end - start < DETECT_MS:
+                        continue
+                    for ep in self.endpoints:
+                        if ep in victims or cell_of(
+                            ep, rule.cells,
+                            topology=plan.topology,
+                            slots=plan.topology_slots or None,
+                        ) != rule.cell:
+                            continue
+                        out.append((start + DETECT_MS, ep))
+                        victims.add(ep)
+                    break
+                continue
             dst = rule.match.dst
             if dst is None or dst not in self.stores or dst in victims:
                 continue
@@ -438,6 +461,44 @@ class ServingFabric:
             str(ep): getattr(self.engines[ep]._map, "version", None)  # noqa: SLF001
             for ep in sorted(self.live)
         }
+
+    def hierarchy_digests(
+        self, cells: int
+    ) -> Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...], int]]:
+        """Each live node's composed hierarchy digest, derived from that
+        node's OWN map (not shared fabric state): cells, per-cell leaders,
+        and composed global fingerprint -- the triple
+        ``check_hierarchy_agreement`` consumes. Nodes whose maps diverged
+        mid-probe produce divergent fingerprints."""
+        from ..hierarchy.cells import cell_members
+        from ..hierarchy.parent import (
+            CellState, cell_fingerprint, cell_leaders, compose_fingerprint,
+        )
+
+        out: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...], int]] = {}
+        for ep in sorted(self.live):
+            held = self.engines[ep]._map  # noqa: SLF001
+            members = sorted(
+                {n for row in held.assignments for n in row},
+                key=lambda e: (e.hostname, e.port),
+            )
+            grouped = cell_members(members, cells)
+            rows = []
+            for cell in sorted(grouped):
+                group = grouped[cell]
+                rows.append(CellState(
+                    cell=cell,
+                    epoch=cell_fingerprint(group),
+                    size=len(group),
+                    leader=str(cell_leaders(group, 1)[0]),
+                    fingerprint=cell_fingerprint(group),
+                ))
+            out[str(ep)] = (
+                tuple(r.cell for r in rows),
+                tuple(r.leader for r in rows),
+                compose_fingerprint(rows),
+            )
+        return out
 
     def durable_versions(self) -> Dict[bytes, int]:
         """Ground truth for the durability invariant: per key, the highest

@@ -25,6 +25,7 @@ from ..faults import FaultPlan
 # same sync discipline RULE_CATALOG enforces), so a new fault rule cannot
 # silently stay unreachable by the search.
 GEN_RULES = (
+    "CellPartitionRule",
     "ClockSkewRule",
     "DelayRule",
     "DiskStallRule",
@@ -192,6 +193,11 @@ class PlanGenerator:
             spec["probability"] = rnd.choice([0.5, 0.75, 1.0])
         elif kind == "PartitionRule":
             spec = self._base(kind, rnd, dst=self._node(rnd))
+        elif kind == "CellPartitionRule":
+            cells = rnd.choice([2, 3, 4, 8])
+            spec = self._base(kind, rnd)
+            spec["cells"] = cells
+            spec["cell"] = rnd.randrange(0, cells)
         elif kind == "FlipFlopRule":
             spec = self._base(kind, rnd, dst=self._node(rnd))
             spec["period_ms"] = rnd.choice([800, 1600, 2400])
@@ -268,9 +274,9 @@ class PlanGenerator:
         # probe-wire only, skew rate in the supported band, sub-round
         # delays)
         kind = rnd.choice(
-            ("DropRule", "PartitionRule", "FlipFlopRule", "LossyLinkRule",
-             "SlowNodeRule", "ClockSkewRule", "DelayRule",
-             "RestartNodeRule")
+            ("DropRule", "PartitionRule", "CellPartitionRule",
+             "FlipFlopRule", "LossyLinkRule", "SlowNodeRule",
+             "ClockSkewRule", "DelayRule", "RestartNodeRule")
         )
         dst = self._node(rnd)
         if kind == "DropRule":
@@ -278,6 +284,11 @@ class PlanGenerator:
             spec["probability"] = rnd.choice([0.5, 1.0])
         elif kind == "PartitionRule":
             spec = self._base(kind, rnd, dst=dst)
+        elif kind == "CellPartitionRule":
+            cells = rnd.choice([2, 4, 8])
+            spec = self._base(kind, rnd)
+            spec["cells"] = cells
+            spec["cell"] = rnd.randrange(0, cells)
         elif kind == "FlipFlopRule":
             spec = self._base(kind, rnd, dst=dst)
             spec["period_ms"] = rnd.choice([2000, 4000, 8000])

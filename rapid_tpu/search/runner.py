@@ -33,6 +33,7 @@ from .checkers import (
     check_durability,
     check_fingerprint_agreement,
     check_gray_collateral,
+    check_hierarchy_agreement,
     check_leader_agreement,
     check_linearizable_history,
     check_metastable_recovery,
@@ -167,8 +168,17 @@ def run_engine_probe(spec: dict) -> ProbeResult:
         lambda: check_leader_agreement(fabric.live_digests()),
         lambda: check_view_agreement(fabric.map_versions()),
     ]
-    from ..faults import RestartNodeRule, TornWriteRule
+    from ..faults import CellPartitionRule, RestartNodeRule, TornWriteRule
 
+    hier_rules = [r for r in plan.rules if isinstance(r, CellPartitionRule)]
+    if hier_rules:
+        # cell-partition plans additionally carry the hierarchy oracle:
+        # every live node's composed global view (derived from its own
+        # map) must agree, and no cell may see two live leaders
+        cells = max(r.cells for r in hier_rules)
+        checks.append(
+            lambda: check_hierarchy_agreement(fabric.hierarchy_digests(cells))
+        )
     if any(isinstance(r, (RestartNodeRule, TornWriteRule)) for r in plan.rules):
         # restart-bearing plans additionally carry the durability oracle:
         # acked writes must survive every crash-and-recover, and each
@@ -178,11 +188,24 @@ def run_engine_probe(spec: dict) -> ProbeResult:
             if o.op == "put" and o.status == 0:  # PutAck.STATUS_OK
                 if o.version > acked_versions.get(o.key, 0):
                     acked_versions[o.key] = o.version
+        # a drop-class Put rule still open when the run ends legitimately
+        # leaves replica rows lagging -- the sim probe's lossy-replication
+        # fingerprint carve-out, applied to the recovery witness
+        end_ms = spec.get("horizon_ms", 4000)
+        lossy_at_end = any(
+            rs.get("type") in ("DropRule", "LossyLinkRule")
+            and (rs.get("msg_types") is None or "Put" in rs["msg_types"])
+            and any(
+                w[1] is None or w[1] >= end_ms
+                for w in rs.get("windows", ())
+            )
+            for rs in spec["plan"].get("rules", ())
+        )
         checks.append(
             lambda: check_durability(
                 acked_versions,
                 fabric.durable_versions(),
-                fabric.recovery_fingerprints(),
+                () if lossy_at_end else fabric.recovery_fingerprints(),
             )
         )
     pure_gray, victims = _gray_plan_victims(plan)
@@ -294,6 +317,15 @@ def run_sim_probe(spec: dict) -> ProbeResult:
     sim.enable_placement(**SIM_PLACEMENT)
     sim.enable_handoff(chunk_size=1024)
     sim.enable_serving(request_ms=1, fault_plan=serving_plan)
+    hier_cells = max(
+        (int(r.get("cells", 0)) for r in rule_specs
+         if r.get("type") == "CellPartitionRule"),
+        default=0,
+    )
+    if hier_cells:
+        # cell-partition plans run the hierarchy mirror so the composed
+        # global view's incremental maintenance is under oracle
+        sim.enable_hierarchy(cells=hier_cells)
     seated = endpoint_slots(sim)
     restart_victims = sorted({
         seated[r.match.dst] for r in device_plan.rules
@@ -388,6 +420,13 @@ def run_sim_probe(spec: dict) -> ProbeResult:
         lambda: check_linearizable_history(history),
         lambda: check_config_parity(stamped, sim.configuration_id()),
     ]
+    if sim.hierarchy_enabled:
+        # the incrementally maintained composition must match a
+        # from-scratch recompute over the surviving slots (and every cell
+        # must name exactly one live leader)
+        checks.append(
+            lambda: check_hierarchy_agreement(_sim_hierarchy_digests(sim))
+        )
     if restart_victims:
         acked_versions = {
             key: version for key, (version, _v) in sim.serving_acked.items()
@@ -490,6 +529,25 @@ def _sim_durable_versions(sim) -> dict:
                 if version > out.get(key, 0):
                     out[key] = version
     return out
+
+
+def _sim_hierarchy_digests(sim) -> dict:
+    """Two composition sources the hierarchy checker must see agree: the
+    sim's incrementally maintained rows, and a from-scratch recompute over
+    the live slots. Divergence means the incremental path dropped or
+    misattributed a churn edge."""
+    def digest() -> Tuple[Tuple[int, ...], Tuple[str, ...], int]:
+        rows = sim.hierarchy_rows()
+        return (
+            tuple(r.cell for r in rows),
+            tuple(r.leader for r in rows),
+            sim.global_fingerprint(),
+        )
+
+    incremental = digest()
+    for cell in range(sim._hier_n_cells):  # noqa: SLF001
+        sim._hierarchy_recompute_cell(cell)  # noqa: SLF001
+    return {"incremental": incremental, "recomputed": digest()}
 
 
 def _sim_fingerprints(sim) -> List[Tuple[int, str, object]]:

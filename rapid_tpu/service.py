@@ -58,10 +58,13 @@ from .runtime.scheduler import ScheduledTask
 from .serving.engine import ServingEngine
 from .settings import Settings
 from .slo.burn import SloPlane
+from .hierarchy.plane import HierarchyPlane
+from .hierarchy.routing import CellRouter, ParentChannel
 from .types import (
     AlertMessage,
     BatchedAlertMessage,
     CONSENSUS_MESSAGE_TYPES,
+    CellDigestMessage,
     ClusterStatusRequest,
     ClusterStatusResponse,
     ConsensusResponse,
@@ -70,6 +73,7 @@ from .types import (
     FastRoundPhase2bMessage,
     FastRoundVoteBatch,
     Get,
+    GlobalViewMessage,
     GossipEnvelope,
     HandoffAck,
     HandoffRequest,
@@ -151,6 +155,27 @@ class MembershipService:
                 scheduler=resources.scheduler, my_addr=my_addr,
             )
         )
+        # Hierarchy plane: cell-filtered broadcasts plus the two-level
+        # composition engine (settings.hierarchy is the kill switch; None
+        # keeps the exact flat path -- no wrapper on the broadcaster, no
+        # new message types on the wire). The router confines every
+        # protocol broadcast -- alerts, votes -- to this member's cell; the
+        # parent channel is the leader's batched leader-to-leader fabric.
+        self._hierarchy: Optional[HierarchyPlane] = None
+        if settings.hierarchy.enabled:
+            self._broadcaster = CellRouter(
+                self._broadcaster, my_addr, settings.hierarchy.cells
+            )
+            self._hierarchy = HierarchyPlane(
+                my_addr,
+                channel=ParentChannel(
+                    client, my_addr, scheduler=resources.scheduler,
+                    flush_ms=settings.hierarchy.parent_flush_ms,
+                ),
+                cells=settings.hierarchy.cells,
+                leaders_per_cell=settings.hierarchy.leaders_per_cell,
+                eviction_rounds=settings.hierarchy.eviction_rounds,
+            )
         self._subscriptions: Dict[ClusterEvents, List[SubscriptionCallback]] = {
             event: [] for event in ClusterEvents
         }
@@ -249,6 +274,16 @@ class MembershipService:
         self._alert_batcher_job = self._scheduler.schedule_at_fixed_rate(
             0, settings.batching_window_ms, self._alert_batcher_tick
         )
+        # parent heartbeat: leaders advance their parent round and
+        # re-announce every period so a whole lost cell ages out of the
+        # composed view even when the survivors see no churn of their own
+        self._hierarchy_job: Optional[ScheduledTask] = None
+        if self._hierarchy is not None and settings.hierarchy.parent_round_ms > 0:
+            self._hierarchy_job = self._scheduler.schedule_at_fixed_rate(
+                settings.hierarchy.parent_round_ms,
+                settings.hierarchy.parent_round_ms,
+                self._hierarchy_tick,
+            )
         self._broadcaster.set_membership(self._view.get_ring(0))
         self._fast_paxos = self._new_fast_paxos()
         self._create_failure_detectors()
@@ -303,6 +338,12 @@ class MembershipService:
         ]
         self._fire(ClusterEvents.VIEW_CHANGE, configuration_id, initial)
         self._update_placement(configuration_id)
+        if self._hierarchy is not None:
+            # the start/join view counts as an install: compute leadership
+            # and (if leading) announce this cell's row to the parent
+            self._hierarchy.on_view_installed(
+                self._view.get_ring(0), configuration_id
+            )
 
     # ------------------------------------------------------------------ #
     # Message dispatch (MembershipService.java:171-193)
@@ -350,9 +391,37 @@ class MembershipService:
             return self._handle_handoff_ack(msg)
         if isinstance(msg, (Get, Put)):
             return self._handle_serving(msg)
+        if isinstance(msg, (CellDigestMessage, GlobalViewMessage)):
+            return self._handle_hierarchy(msg)
         if isinstance(msg, MessageBatch):
             return self._handle_message_batch(msg)
         raise TypeError(f"unidentified request type {type(msg).__name__}")
+
+    def _handle_hierarchy(self, msg: RapidMessage) -> Promise:
+        """Hierarchy-plane traffic (a peer leader's cell digest, or our own
+        leader's composed global view): hop onto the protocol executor --
+        the plane reads the view and may announce through the broadcaster
+        seam -- and ack the frame. A member without the plane acks and
+        drops (a hierarchical peer's stray frame cannot poison dispatch)."""
+        future: Promise = Promise()
+        if self._hierarchy is None:
+            return Promise.completed(Response())
+
+        def task() -> None:
+            self._hierarchy.handle_message(msg)
+            future.try_set_result(Response())
+
+        self._resources.protocol_executor.execute(task)
+        return future
+
+    def _hierarchy_tick(self) -> None:
+        """Parent heartbeat edge. Fires on the scheduler's timer thread in
+        real deployments; the plane is guarded by the protocol executor,
+        so the tick body hops there (same discipline as the alert
+        batcher)."""
+        if self._hierarchy is None or self._shut_down:
+            return
+        self._resources.protocol_executor.execute(self._hierarchy.tick)
 
     def _handle_message_batch(self, batch: MessageBatch) -> Promise:
         """Unpack a transport batch envelope (a broadcaster's flush window,
@@ -587,6 +656,12 @@ class MembershipService:
             hlc_physical_ms = hlc_stamp.physical_ms
             hlc_logical = hlc_stamp.logical
             hlc_incarnation = hlc_stamp.incarnation
+        # hierarchy plane digest: the member's cell coordinates plus the
+        # composed global view as parallel per-cell rows (all empty/zero
+        # pre-hierarchy -- old peers and goldens see their exact old shape)
+        hierarchy_fields: Dict[str, object] = {}
+        if self._hierarchy is not None:
+            hierarchy_fields = self._hierarchy.status_fields()
         return ClusterStatusResponse(
             sender=self._my_addr,
             configuration_id=self._view.get_current_configuration_id(),
@@ -637,7 +712,15 @@ class MembershipService:
             hlc_physical_ms=hlc_physical_ms,
             hlc_logical=hlc_logical,
             hlc_incarnation=hlc_incarnation,
+            **hierarchy_fields,
         )
+
+    @property
+    def hierarchy(self) -> Optional[HierarchyPlane]:
+        """The hierarchy plane, or None when ``settings.hierarchy`` is off
+        (harnesses use it to seed parent bootstrap hints and to read the
+        composed global view directly)."""
+        return self._hierarchy
 
     # ------------------------------------------------------------------ #
     # Forensics plane (forensics/, tools/forensics.py)
@@ -1266,6 +1349,14 @@ class MembershipService:
         self._churn_ctx = None  # this churn's trace is complete
         self._fast_paxos = self._new_fast_paxos()
         self._broadcaster.set_membership(self._view.get_ring(0))
+        if self._hierarchy is not None:
+            # ordinary view install doubles as the hierarchy edge: leaders
+            # recompute deterministically from the new view (a leader
+            # eviction silently promotes the next member in leader order)
+            # and announce the cell's new row/epoch to the parent
+            self._hierarchy.on_view_installed(
+                self._view.get_ring(0), configuration_id
+            )
 
         if self._view.is_host_present(self._my_addr):
             self._create_failure_detectors()
@@ -1477,6 +1568,8 @@ class MembershipService:
             return
         self._shut_down = True
         self._alert_batcher_job.cancel()
+        if self._hierarchy_job is not None:
+            self._hierarchy_job.cancel()
         # _failure_detector_jobs is only ever touched on the protocol
         # executor (_create_failure_detectors runs there); keep shutdown's
         # cancel on the same context instead of racing it from the caller's

@@ -158,6 +158,45 @@ SETTINGS_CATALOG = {
                "capture; a member that misses it is marked unreachable and "
                "the capture proceeds without blocking",
     },
+    "hierarchy.enabled": {
+        "min": 0, "max": 1,
+        "doc": "kill switch: False runs the flat single-level protocol and "
+               "reproduces the exact pre-hierarchy wire bytes",
+    },
+    "hierarchy.cells": {
+        "min": 0, "max": 65536,
+        "doc": "number of cells for the rendezvous-hash fallback assignment "
+               "(0 derives the cell count from the attached topology's "
+               "zones, or 1 when there is no topology)",
+    },
+    "hierarchy.leaders_per_cell": {
+        "min": 1, "max": 7,
+        "doc": "size of each cell's deterministic leader set participating "
+               "in the parent configuration (failover promotes the next "
+               "member in leader order on an ordinary intra-cell view "
+               "change)",
+    },
+    "hierarchy.parent_flush_ms": {
+        "min": 0, "max": 60000,
+        "doc": "flush window coalescing a leader's parent-level traffic "
+               "into one MessageBatch per peer per window (0 sends each "
+               "cell digest as its own frame)",
+    },
+    "hierarchy.parent_round_ms": {
+        "min": 0, "max": 600000,
+        "doc": "parent heartbeat period: every period each leader advances "
+               "its parent round, re-announces its cell's digest to peer "
+               "leaders, and ages out cells idle for eviction_rounds "
+               "rounds -- this is what evicts a whole lost cell in O(1) "
+               "rounds even when the survivors see no churn (0 disables "
+               "the heartbeat; rounds then only advance on view changes)",
+    },
+    "hierarchy.eviction_rounds": {
+        "min": 1, "max": 100,
+        "doc": "parent rounds a foreign cell's row may stay idle before a "
+               "leader drops it from the composed view (whole-cell loss "
+               "detection horizon = eviction_rounds * parent_round_ms)",
+    },
 }
 
 
@@ -327,6 +366,40 @@ class ForensicsSettings:
             )
 
 
+@dataclass(frozen=True)
+class HierarchySettings:
+    """Knobs for the hierarchy plane (hierarchy/). Defaults are
+    conservative: the plane is off (``enabled=False`` runs the flat
+    single-level protocol and reproduces the exact pre-hierarchy wire
+    bytes) and, when on, the membership splits into deterministic cells
+    that each run Rapid internally while the cells' leader sets agree on
+    the composed global view, so cross-cell churn costs O(cells) instead
+    of O(members). Bounds live in SETTINGS_CATALOG (linted by
+    tools/check.py)."""
+
+    enabled: bool = False
+    cells: int = 0
+    leaders_per_cell: int = 1
+    parent_flush_ms: int = 50
+    parent_round_ms: int = 1000
+    eviction_rounds: int = 3
+
+    def __post_init__(self) -> None:
+        for key, value in (
+            ("enabled", int(self.enabled)),
+            ("cells", self.cells),
+            ("leaders_per_cell", self.leaders_per_cell),
+            ("parent_flush_ms", self.parent_flush_ms),
+            ("parent_round_ms", self.parent_round_ms),
+            ("eviction_rounds", self.eviction_rounds),
+        ):
+            bounds = SETTINGS_CATALOG[f"hierarchy.{key}"]
+            assert bounds["min"] <= value <= bounds["max"], (
+                f"hierarchy.{key}={value!r} outside "
+                f"[{bounds['min']}, {bounds['max']}]"
+            )
+
+
 @dataclass
 class Settings:
     # Transport timeouts/retries (GrpcClient.java:55-59)
@@ -405,6 +478,13 @@ class Settings:
     # by default; the enabled flag is the kill switch back to the exact
     # pre-forensics wire bytes and journal shape.
     forensics: ForensicsSettings = field(default_factory=ForensicsSettings)
+
+    # Hierarchy plane (hierarchy/): two-level cell-based membership --
+    # cells run Rapid internally, cell leader sets agree on the composed
+    # global view. Off by default; the enabled flag is the kill switch
+    # back to the flat single-level protocol and the exact pre-hierarchy
+    # wire bytes.
+    hierarchy: HierarchySettings = field(default_factory=HierarchySettings)
 
     def __post_init__(self) -> None:
         assert self.fd_policy in ("cumulative", "windowed"), (

@@ -276,6 +276,15 @@ class Simulator:  # guarded-by: sim-loop
         # SLO plane (opt-in via enable_slo; None is the kill-switch-off
         # path: serving requests run the exact pre-SLO code)
         self._slo = None
+        # hierarchy mirror (opt-in via enable_hierarchy; derived
+        # composition state like placement, so from_configuration restores
+        # re-enable it explicitly)
+        self._hier_cell_of: Optional[np.ndarray] = None
+        self._hier_n_cells = 0
+        self._hier_round_ms = 1
+        self._hier_leaders_per_cell = 1
+        self._hier_rows: dict = {}
+        self._hier_rounds = 0
         # membership-invariant element hashes: construction cost, not
         # protocol time (they feed every configuration_id fold)
         self.cluster.node_hashes()
@@ -897,6 +906,144 @@ class Simulator:  # guarded-by: sim-loop
     def slo_plane(self):
         """The live SLO plane (None unless enable_slo attached one)."""
         return self._slo
+
+    # -- hierarchy mirror --------------------------------------------------- #
+
+    def enable_hierarchy(
+        self,
+        cells: int = 0,
+        topology=None,
+        parent_round_ms: int = 1,
+        leaders_per_cell: int = 1,
+    ) -> None:
+        """Attach the hierarchy mirror: the device plane's analogue of the
+        engine's two-level composition (hierarchy/plane.py).
+
+        Slots partition into cells by the same pure functions the engine
+        uses -- topology zones when a LatencyTopology is given (slots ARE
+        topology indices), the seeded rendezvous hash over the slot's
+        endpoint otherwise -- so a protocol-plane member and its seated
+        device slot always land in the same cell. Each view change then
+        recomputes ONLY the touched cells' rows (epoch fold, leader order,
+        membership fingerprint over the cell-local slice of the active
+        mask) and, when the composition moved, bills one parent round of
+        ``parent_round_ms`` on the virtual clock -- cross-cell agreement
+        costs O(cells) work and one round of latency, never O(members).
+        Everything is a pure function of (membership, seed), so runs stay
+        bit-deterministic and `global_fingerprint` is comparable 1:1 with
+        the engine's composed fingerprints."""
+        from ..hierarchy.cells import cell_count, cell_of_endpoint
+        from ..types import Endpoint as _Endpoint
+
+        resolved = cell_count(cells, topology)
+        cell_of = np.zeros(self.config.capacity, dtype=np.int32)
+        for slot in range(self.config.capacity):
+            if topology is not None:
+                cell_of[slot] = topology.zone_of(slot)
+            else:
+                host, port = self.endpoint_of(slot)
+                cell_of[slot] = cell_of_endpoint(
+                    _Endpoint(hostname=host, port=port), resolved
+                )
+        self._hier_cell_of = cell_of
+        self._hier_n_cells = resolved
+        self._hier_round_ms = int(parent_round_ms)
+        self._hier_leaders_per_cell = int(leaders_per_cell)
+        self._hier_rows = {}
+        self._hier_rounds = 0
+        for cell in range(resolved):
+            self._hierarchy_recompute_cell(cell)
+        self.metrics.set_gauge("hierarchy.cells", resolved)
+
+    def _hierarchy_recompute_cell(self, cell: int) -> None:
+        """Rebuild one cell's composed-view row from its cell-local slice
+        of the active mask (hierarchy/parent.py CellState discipline)."""
+        from ..hierarchy.parent import (
+            CellState, cell_fingerprint, cell_leaders, _fold,
+        )
+        from ..types import Endpoint as _Endpoint
+
+        slots = np.flatnonzero(self.active & (self._hier_cell_of == cell))
+        if not len(slots):
+            self._hier_rows.pop(cell, None)
+            return
+        _, _, host_h, port_h = self.cluster.node_hashes()
+        members = []
+        for slot in slots:
+            host, port = self.endpoint_of(int(slot))
+            members.append(_Endpoint(hostname=host, port=port))
+        leaders = cell_leaders(members, self._hier_leaders_per_cell)
+        # the cell's epoch is a config-id-style chained fold over the
+        # cell-local slice's element hashes: it moves exactly when the
+        # cell's membership moves, the same contract the engine's
+        # per-cell Rapid configuration id provides
+        epoch = _fold(
+            sorted(
+                int(host_h[slot]) ^ int(port_h[slot]) for slot in slots
+            )
+        )
+        self._hier_rows[cell] = CellState(
+            cell=cell,
+            epoch=epoch,
+            size=len(members),
+            leader=str(leaders[0]),
+            fingerprint=cell_fingerprint(members),
+        )
+
+    def _hierarchy_view_change(self, record, vc_span) -> None:
+        """Mirror one view change into the composition: recompute touched
+        cells only, bill one parent round when the composition moved."""
+        touched = sorted(
+            {int(self._hier_cell_of[s]) for s in record.added}
+            | {int(self._hier_cell_of[s]) for s in record.removed}
+        )
+        before = self.global_fingerprint()
+        for cell in touched:
+            self._hierarchy_recompute_cell(cell)
+        after = self.global_fingerprint()
+        if after == before:
+            return
+        # one leader-to-leader parent round carries the moved cells' digests
+        # to every other cell: O(cells) messages, one round of latency
+        self._hier_rounds += 1
+        self.virtual_ms += self._hier_round_ms
+        self.metrics.incr("hierarchy.parent_rounds")
+        self.metrics.set_gauge("hierarchy.live_cells", len(self._hier_rows))
+        self.recorder.record(
+            "parent_round",
+            virtual_ms=self.virtual_ms,
+            round=self._hier_rounds,
+            cells=len(self._hier_rows),
+            touched=len(touched),
+            global_fingerprint=after,
+            trace_id=vc_span.trace_id,
+        )
+
+    @property
+    def hierarchy_enabled(self) -> bool:
+        return self._hier_cell_of is not None
+
+    @property
+    def parent_rounds(self) -> int:
+        """Parent rounds billed since enable_hierarchy."""
+        return self._hier_rounds
+
+    def hierarchy_rows(self):
+        """The composed global view: CellState rows sorted by cell."""
+        return tuple(
+            self._hier_rows[cell] for cell in sorted(self._hier_rows)
+        )
+
+    def global_fingerprint(self) -> int:
+        """Composed global fingerprint (hierarchy/parent.py fold) of the
+        mirror's current rows."""
+        from ..hierarchy.parent import compose_fingerprint
+
+        return compose_fingerprint(self.hierarchy_rows())
+
+    def cell_of_slot(self, slot: int) -> int:
+        """Cell of device slot ``slot`` (enable_hierarchy must have run)."""
+        return int(self._hier_cell_of[slot])
 
     def serving_drive_open_loop(self, arrivals):
         """Drive the serving mirror with an open-loop arrival stream
@@ -1935,6 +2082,12 @@ class Simulator:  # guarded-by: sim-loop
                             old_assign[:, 0] != self._placement.assign[:, 0]
                         )),
                     )
+        if self._hier_cell_of is not None:
+            # composition mirror: touched cells' rows recompute on their
+            # cell-local slices, one virtual-time parent round when the
+            # composed fingerprint moved (billed after install, like
+            # handoff: the stable-view distributions stay untouched)
+            self._hierarchy_view_change(record, vc_span)
         vc_span.attrs.update(
             cut=len(record.cut), added=len(record.added),
             removed=len(record.removed),

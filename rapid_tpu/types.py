@@ -411,6 +411,73 @@ class ClusterStatusResponse:
     hlc_physical_ms: int = 0
     hlc_logical: int = 0
     hlc_incarnation: int = 0
+    # hierarchy plane (0/absent when hierarchy is not enabled; plane-on is
+    # signalled by a non-empty global_cells, which always carries at least
+    # the member's own cell): this member's cell, its cell-local
+    # membership size, the parent (leader-set) configuration id, the
+    # composed global fingerprint, and the parallel per-cell rows of the
+    # composed global view -- the single-integer agreement surfaces
+    # statusz cross-checks
+    cell_id: int = 0
+    cell_size: int = 0
+    parent_configuration_id: int = 0
+    global_fingerprint: int = 0
+    global_cells: Tuple[int, ...] = ()
+    global_epochs: Tuple[int, ...] = ()
+    global_sizes: Tuple[int, ...] = ()
+    global_leaders: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class CellDigestMessage:
+    """Hierarchy plane, leader-to-leader: one cell's row of the composed
+    global view, announced by that cell's rank-0 leader after every
+    intra-cell view change (hierarchy/plane.py).
+
+    ``configuration_id`` is the cell's local Rapid configuration id -- its
+    epoch in the composed view, so stale/reordered digests are rejected
+    deterministically. ``fingerprint`` is the fold over the cell's sorted
+    member hashes (hierarchy/parent.py cell_fingerprint): two leaders
+    disagreeing about who is in a cell compose differently even at equal
+    sizes. ``parent_round`` is the sender's parent-round counter, the
+    liveness stamp whole-cell eviction ages against. Carried by the native
+    codec (tag 26) and the gRPC transport (oneof field 19); pre-hierarchy
+    peers never see one (the plane is off by default)."""
+
+    sender: Endpoint
+    cell: int = 0
+    configuration_id: int = 0
+    membership_size: int = 0
+    leader: str = ""
+    fingerprint: int = 0
+    parent_round: int = 0
+
+
+@dataclass(frozen=True)
+class GlobalViewMessage:
+    """Hierarchy plane, leader-to-cell: the composed global view a leader
+    fans back into its own cell after the composition moves, as parallel
+    per-cell arrays (the ClusterStatusResponse digest shape).
+
+    ``parent_configuration_id`` / ``global_fingerprint`` are the two
+    single-integer agreement surfaces: the fold over the sorted leader-set
+    hashes, and the fold over the per-cell row hashes
+    (hierarchy/parent.py). Carried by the native codec (tag 27) and the
+    gRPC transport (oneof field 20); intra-cell only, so it never crosses
+    a cell boundary by construction."""
+
+    sender: Endpoint
+    parent_configuration_id: int = 0
+    global_fingerprint: int = 0
+    cells: Tuple[int, ...] = ()
+    epochs: Tuple[int, ...] = ()
+    sizes: Tuple[int, ...] = ()
+    leaders: Tuple[str, ...] = ()
+    fingerprints: Tuple[int, ...] = ()
+    # the sending leader's monotonic parent-round counter: epochs are
+    # configuration-id hashes (unordered), so receivers gate reordered
+    # frames from the same leader by this stamp instead
+    parent_round: int = 0
 
 
 @dataclass(frozen=True)

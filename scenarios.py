@@ -83,11 +83,16 @@ def register(name: str, fn, **params) -> None:
     REGISTRY[name] = (fn, params)
 
 
-def run_scenario(name: str, seed: "int | None" = None) -> dict:
-    """Run one registered scenario; ``seed`` overrides the bound seed."""
+def run_scenario(
+    name: str, seed: "int | None" = None, **overrides
+) -> dict:
+    """Run one registered scenario; ``seed`` overrides the bound seed, and
+    further keyword overrides replace bound params (the --cells knob)."""
     fn, params = REGISTRY[name]
     if seed is not None:
         params = {**params, "seed": seed}
+    if overrides:
+        params = {**params, **overrides}
     result = fn(**params)
     result["scenario"] = name
     return result
@@ -155,17 +160,35 @@ def scenario_10_node_cross_plane(seed=1):
     }
 
 
-def scenario_crash(n, n_fail, seed, label):
+def _hierarchy_digest(sim) -> dict:
+    """Result fields for a sim with the hierarchy mirror attached: the
+    composed rows, parent-round bill, and the incremental-vs-scratch
+    fingerprint cross-check (the sim analogue of every member agreeing)."""
+    rows = sim.hierarchy_rows()
+    incremental = sim.global_fingerprint()
+    for state in list(rows):
+        sim._hierarchy_recompute_cell(state.cell)
+    return {
+        "cells": {int(r.cell): int(r.size) for r in rows},
+        "parent_rounds": sim.parent_rounds,
+        "global_fingerprint": incremental,
+        "fingerprint_ok": bool(incremental == sim.global_fingerprint()),
+    }
+
+
+def scenario_crash(n, n_fail, seed, label, cells=0):
     from rapid_tpu.sim.driver import Simulator
 
     rng = np.random.default_rng(seed)
     sim = Simulator(n, seed=seed)
+    if cells:
+        sim.enable_hierarchy(cells=cells)
     victims = rng.choice(n, size=n_fail, replace=False)
     sim.crash(victims)
     t0 = time.perf_counter()
     rec = sim.run_until_decision(max_rounds=32, batch=16)
     wall = time.perf_counter() - t0
-    return {
+    result = {
         "config": label,
         "n": n,
         "virtual_ms": rec.virtual_time_ms if rec else None,
@@ -176,19 +199,24 @@ def scenario_crash(n, n_fail, seed, label):
             and rec.configuration_id == recomputed_config_id(sim)
         ),
     }
+    if cells:
+        result["hierarchy"] = _hierarchy_digest(sim)
+    return result
 
 
-def scenario_one_way_loss(n, n_fail, seed):
+def scenario_one_way_loss(n, n_fail, seed, cells=0):
     from rapid_tpu.sim.driver import Simulator
 
     rng = np.random.default_rng(seed)
     sim = Simulator(n, seed=seed)
+    if cells:
+        sim.enable_hierarchy(cells=cells)
     victims = rng.choice(n, size=n_fail, replace=False)
     sim.one_way_ingress_partition(victims)
     t0 = time.perf_counter()
     rec = sim.run_until_decision(max_rounds=32, batch=16)
     wall = time.perf_counter() - t0
-    return {
+    result = {
         "config": f"{n//1000}k nodes, asymmetric one-way link loss",
         "n": n,
         "virtual_ms": rec.virtual_time_ms if rec else None,
@@ -199,13 +227,18 @@ def scenario_one_way_loss(n, n_fail, seed):
             and rec.configuration_id == recomputed_config_id(sim)
         ),
     }
+    if cells:
+        result["hierarchy"] = _hierarchy_digest(sim)
+    return result
 
 
-def scenario_flip_flop_with_join_wave(n, capacity, seed):
+def scenario_flip_flop_with_join_wave(n, capacity, seed, cells=0):
     from rapid_tpu.sim.driver import Simulator
 
     rng = np.random.default_rng(seed)
     sim = Simulator(n, capacity=capacity, seed=seed)
+    if cells:
+        sim.enable_hierarchy(cells=cells)
     victims = rng.choice(n, size=n // 100, replace=False)
     joiners = np.arange(n, capacity)
     sim.request_joins(joiners)
@@ -229,7 +262,7 @@ def scenario_flip_flop_with_join_wave(n, capacity, seed):
         and not sim.active[victims].any()
         and sim.active[joiners].all()
     )
-    return {
+    result = {
         "config": f"{n//1000}k nodes, flip-flop reachability + concurrent join wave",
         "n": n,
         "virtual_ms": decided[-1].virtual_time_ms if decided else None,
@@ -241,6 +274,9 @@ def scenario_flip_flop_with_join_wave(n, capacity, seed):
             and decided[-1].configuration_id == recomputed_config_id(sim)
         ),
     }
+    if cells:
+        result["hierarchy"] = _hierarchy_digest(sim)
+    return result
 
 
 def scenario_nemesis_protocol(seed=7, n=5):
@@ -385,6 +421,80 @@ def scenario_wan_zone_loss(seed=11, n=1024):
             and records[-1].configuration_id == recomputed_config_id(sim)
         ),
         "zone_detection_ms": per_zone,
+    }
+
+
+def scenario_hierarchy_zone_churn(seed=19, zones=8, per_zone=256):
+    """Hierarchy plane: ``zones`` topology cells of ``per_zone`` members
+    each, ordinary churn in flight (a scatter of crashes across cells),
+    then one whole cell -- its deterministic leader included -- killed.
+
+    Oracle: the surviving cells' composed global view agrees (the
+    incremental composition matches a from-scratch recompute and the dead
+    cell's row is gone), the lost cell is evicted in O(1) parent rounds
+    (bounded by the view changes, never by member count), and there are
+    zero collateral evictions (the union of cuts is exactly the union of
+    victims)."""
+    from rapid_tpu.hierarchy.parent import cell_leaders
+    from rapid_tpu.sim.driver import Simulator
+    from rapid_tpu.sim.engine import SimConfig
+    from rapid_tpu.sim.topology import LatencyTopology
+    from rapid_tpu.types import Endpoint
+
+    n = zones * per_zone
+    topo = LatencyTopology(racks=zones * 2, zones=zones,
+                           rack_rtt_ms=0, zone_rtt_ms=2, region_rtt_ms=4,
+                           inter_region_rtt_ms=8)
+    rng = np.random.default_rng(seed)
+    sim = Simulator(n, config=SimConfig(capacity=n, groups=8), seed=seed)
+    sim.enable_hierarchy(topology=topo, parent_round_ms=4)
+    lost_zone = int(rng.integers(zones))
+    zone_victims = [i for i in range(n) if topo.zone_of(i) == lost_zone]
+    # the zone kill provably includes the cell's deterministic leader
+    members = [
+        Endpoint(hostname=h, port=p)
+        for h, p in (sim.endpoint_of(s) for s in zone_victims)
+    ]
+    leader = str(cell_leaders(members, 1)[0])
+    assert leader in {str(m) for m in members}
+    # mid-churn: a scatter of ordinary crashes lands first
+    others = [i for i in range(n) if topo.zone_of(i) != lost_zone]
+    scatter = [int(i) for i in rng.choice(others, size=8, replace=False)]
+    t0 = time.perf_counter()
+    sim.crash(np.array(scatter))
+    records = [sim.run_until_decision(max_rounds=32, batch=16)]
+    sim.crash(np.array(zone_victims))
+    records.append(sim.run_until_decision(max_rounds=32, batch=16))
+    wall = time.perf_counter() - t0
+    records = [r for r in records if r is not None]
+    cut = sorted({int(c) for rec in records for c in rec.cut})
+    digest = _hierarchy_digest(sim)
+    surviving = set(range(zones)) - {lost_zone}
+    return {
+        "config": (
+            f"hierarchy zone churn: {zones} cells x {per_zone} members, "
+            f"scatter crashes then whole cell {lost_zone} killed, leader "
+            f"{leader} included (seed {seed})"
+        ),
+        "n": n,
+        "virtual_ms": records[-1].virtual_time_ms if records else None,
+        "wall_s": round(wall, 3),
+        # zero collateral evictions: exactly the victims were cut
+        "cut_ok": bool(cut == sorted(scatter + zone_victims)),
+        "config_id_ok": bool(
+            records
+            and records[-1].configuration_id == recomputed_config_id(sim)
+        ),
+        "hierarchy": digest,
+        "cell_evicted_ok": bool(
+            set(digest["cells"]) == surviving
+            and digest["fingerprint_ok"]
+        ),
+        # O(1) parent rounds: one per composition move, bounded by the
+        # two churn edges -- independent of the 256-member cell size
+        "parent_rounds_ok": bool(
+            0 < digest["parent_rounds"] <= len(records) + 1
+        ),
     }
 
 
@@ -1007,6 +1117,7 @@ register("flip-flop-join-100k", scenario_flip_flop_with_join_wave,
 register("nemesis-protocol", scenario_nemesis_protocol, seed=7, n=5)
 register("nemesis-smoke", scenario_nemesis_smoke, n=1000, seed=7)
 register("wan-zone-loss", scenario_wan_zone_loss, seed=11)
+register("hierarchy-zone-churn", scenario_hierarchy_zone_churn, seed=19)
 register("gray-slow-node", scenario_gray_slow_node, seed=7)
 register("gray-flapping", scenario_gray_flapping, seed=17)
 register("clock-skew", scenario_clock_skew, seed=13)
@@ -1027,6 +1138,7 @@ register("flip-flop-join-1m", scenario_flip_flop_with_join_wave,
 BATTERY = [
     "cross-plane-10", "crash-1k", "crash-10k", "one-way-loss-50k",
     "flip-flop-join-100k", "nemesis-smoke", "wan-zone-loss",
+    "hierarchy-zone-churn",
     "gray-slow-node", "gray-flapping", "clock-skew", "rolling-upgrade",
     "serving-sawtooth", "rolling-restart", "overload-recover",
 ]
@@ -1118,8 +1230,14 @@ def main() -> None:
         _write_telemetry()
         return
     names = BATTERY + (SCALE_1M if "--scale-1m" in sys.argv else [])
+    # --cells N arms the hierarchy mirror on the 1M-scale sims: same
+    # seeds, same faults, plus the composed-view maintenance and its
+    # parent-round bill in each result's "hierarchy" digest
+    cells_arg = _flag_value("--cells")
+    cells = int(cells_arg) if cells_arg else 0
     for name in names:
-        print(json.dumps(run_scenario(name)))
+        overrides = {"cells": cells} if cells and name in SCALE_1M else {}
+        print(json.dumps(run_scenario(name, **overrides)))
     _write_telemetry()
 
 

@@ -75,6 +75,26 @@ HLC_RESPONSE = ClusterStatusResponse(
     hlc_incarnation=2,
 )
 
+# a hierarchy-plane-bearing status: the member's cell coordinates plus a
+# two-cell composed global view as parallel arrays (proto fields 46-53)
+# -- the single-integer agreement surfaces (parent config id, composed
+# fingerprint) statusz cross-checks; negative ids pin signed carriage
+HIERARCHY_RESPONSE = ClusterStatusResponse(
+    sender=MEMBER,
+    configuration_id=-6148914691236517206,
+    membership_size=3,
+    reports_tracked=1,
+    consensus_votes=2,
+    cell_id=1,
+    cell_size=3,
+    parent_configuration_id=-4242424242424242424,
+    global_fingerprint=7777777777777777777,
+    global_cells=(0, 1),
+    global_epochs=(-111, -222),
+    global_sizes=(2, 3),
+    global_leaders=("10.9.1.9:7109", "10.9.1.2:7102"),
+)
+
 # named (request_no, message) pairs pinned on the native msgpack wire
 TCP_SCRAPES = {
     "request_with_history": (11, SCRAPE_REQUEST),
@@ -84,4 +104,5 @@ TCP_SCRAPES = {
     "response_with_history": (13, SCRAPE_RESPONSE),
     "response_with_slo": (14, SLO_RESPONSE),
     "response_with_hlc": (15, HLC_RESPONSE),
+    "response_with_hierarchy": (16, HIERARCHY_RESPONSE),
 }

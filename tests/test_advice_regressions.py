@@ -260,6 +260,7 @@ def test_service_shutdown_cancels_detectors_on_protocol_executor():
     fake = SimpleNamespace(
         _shut_down=False,
         _alert_batcher_job=SimpleNamespace(cancel=lambda: None),
+        _hierarchy_job=None,
         _resources=SimpleNamespace(protocol_executor=executor),
         _client=SimpleNamespace(shutdown=lambda: client_calls.append(1)),
         _cancel_failure_detectors=lambda: None,

@@ -1,0 +1,645 @@
+"""Hierarchy plane: cells, deterministic leaders, composed global views.
+
+The acceptance scenario is the one from the PR issue: a multi-cell
+cluster (each cell an ordinary Rapid cluster) whose leader sets agree on
+a composed global view; killing a member, killing a leader (failover is
+a non-event), and killing a whole cell -- leader included -- must each
+reconverge every survivor to one composed fingerprint, with the lost
+cell evicted in O(1) parent rounds and zero collateral evictions.
+Everything runs on virtual time, so the whole file is tier-1.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import pytest
+
+from rapid_tpu.hierarchy.cells import (
+    cell_count,
+    cell_members,
+    cell_of,
+    cell_of_endpoint,
+    cell_sizes,
+)
+from rapid_tpu.hierarchy.parent import (
+    CellState,
+    GlobalView,
+    cell_fingerprint,
+    cell_leaders,
+    compose_fingerprint,
+    leader_key,
+    parent_configuration_id,
+)
+from rapid_tpu.hierarchy.plane import HierarchyPlane
+from rapid_tpu.hierarchy.routing import CellRouter
+from rapid_tpu.messaging import codec
+from rapid_tpu.messaging import grpc_transport as gt
+from rapid_tpu.messaging.wire_schema import MSG
+from rapid_tpu.settings import HierarchySettings, Settings
+from rapid_tpu.sim.topology import LatencyTopology
+from rapid_tpu.types import (
+    CellDigestMessage,
+    ClusterStatusResponse,
+    Endpoint,
+    GlobalViewMessage,
+)
+
+from harness import ClusterHarness
+
+
+def _ep(i: int) -> Endpoint:
+    return Endpoint(hostname=b"10.0.0.%d" % (i // 256), port=5000 + i)
+
+
+def _hier_settings(**kw) -> Settings:
+    kw.setdefault("enabled", True)
+    return Settings(hierarchy=HierarchySettings(**kw))
+
+
+# ---------------------------------------------------------------------------
+# Cell assignment
+# ---------------------------------------------------------------------------
+
+
+class TestCells:
+    def test_rendezvous_assignment_is_deterministic_and_in_range(self):
+        eps = [_ep(i) for i in range(200)]
+        cells = [cell_of_endpoint(ep, 8) for ep in eps]
+        assert cells == [cell_of_endpoint(ep, 8) for ep in eps]
+        assert all(0 <= c < 8 for c in cells)
+        # rendezvous hashing spreads: no cell grabs everything
+        assert len(set(cells)) == 8
+
+    def test_single_cell_short_circuits(self):
+        assert cell_of_endpoint(_ep(3), 1) == 0
+        assert cell_of(_ep(3), 0) == 0  # no topology, no explicit count
+
+    def test_rendezvous_is_minimally_disruptive(self):
+        # growing 8 -> 9 cells only ever moves members INTO the new cell
+        eps = [_ep(i) for i in range(300)]
+        before = {ep: cell_of_endpoint(ep, 8) for ep in eps}
+        after = {ep: cell_of_endpoint(ep, 9) for ep in eps}
+        moved = [ep for ep in eps if before[ep] != after[ep]]
+        assert all(after[ep] == 8 for ep in moved)
+
+    def test_topology_zone_is_the_default_cell_boundary(self):
+        topo = LatencyTopology(racks=8, zones=4)
+        eps = [_ep(i) for i in range(16)]
+        slots = {ep: i for i, ep in enumerate(eps)}
+        for ep, slot in slots.items():
+            assert (
+                cell_of(ep, 0, topology=topo, slots=slots)
+                == topo.zone_of(slot)
+            )
+        # an endpoint the slot map doesn't know falls back to rendezvous
+        stranger = _ep(999)
+        assert cell_of(stranger, 0, topology=topo, slots=slots) == (
+            cell_of_endpoint(stranger, 4)
+        )
+
+    def test_cell_count_precedence(self):
+        topo = LatencyTopology(racks=8, zones=4)
+        assert cell_count(16, topo) == 16  # explicit wins
+        assert cell_count(0, topo) == 4  # topology zones next
+        assert cell_count(0, None) == 1  # flat fallback
+
+    def test_cell_members_partitions_preserving_ring_order(self):
+        eps = [_ep(i) for i in range(40)]
+        groups = cell_members(eps, 4)
+        flat = [ep for cell in sorted(groups) for ep in groups[cell]]
+        assert sorted(map(str, flat)) == sorted(map(str, eps))
+        for cell, members in groups.items():
+            assert members == [ep for ep in eps if cell_of(ep, 4) == cell]
+        assert cell_sizes(eps, 4) == tuple(
+            (cell, len(groups[cell])) for cell in sorted(groups)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Leaders and the composed view
+# ---------------------------------------------------------------------------
+
+
+class TestParent:
+    def test_leaders_are_a_pure_function_of_the_view(self):
+        members = [_ep(i) for i in range(10)]
+        a = cell_leaders(members, 3)
+        b = cell_leaders(list(reversed(members)), 3)
+        assert a == b
+        assert len(a) == 3
+        assert set(a) <= set(members)
+        assert a == tuple(sorted(members, key=leader_key)[:3])
+
+    def test_failover_promotes_the_next_in_leader_order(self):
+        members = [_ep(i) for i in range(10)]
+        order = sorted(members, key=leader_key)
+        survivors = [ep for ep in members if ep != order[0]]
+        assert cell_leaders(survivors, 1) == (order[1],)
+
+    def test_parent_configuration_id_ignores_order_and_duplicates(self):
+        leaders = [_ep(1), _ep(2), _ep(3)]
+        a = parent_configuration_id(leaders)
+        assert a == parent_configuration_id(list(reversed(leaders)))
+        assert a == parent_configuration_id(leaders + [_ep(2)])
+        assert a != parent_configuration_id(leaders[:2])
+
+    def test_compose_fingerprint_covers_every_row_field(self):
+        rows = [
+            CellState(cell=0, epoch=11, size=5, leader="a:1"),
+            CellState(cell=1, epoch=22, size=7, leader="b:2"),
+        ]
+        base = compose_fingerprint(rows)
+        bumped = [rows[0], CellState(cell=1, epoch=23, size=7, leader="b:2")]
+        assert base != compose_fingerprint(bumped)
+        assert base == compose_fingerprint(list(reversed(rows)))
+
+    def test_cell_fingerprint_is_membership_sensitive(self):
+        members = [_ep(i) for i in range(5)]
+        assert cell_fingerprint(members) == cell_fingerprint(members[::-1])
+        assert cell_fingerprint(members) != cell_fingerprint(members[:-1])
+
+    def test_global_view_install_and_evict(self):
+        view = GlobalView()
+        row = CellState(cell=2, epoch=5, size=3, leader="x:1")
+        assert view.install(row) is True
+        assert view.install(row) is False  # identical row is a no-op
+        assert view.install(
+            CellState(cell=2, epoch=6, size=3, leader="x:1")
+        ) is True
+        assert view.member_count() == 3
+        assert view.evict_cell(2) is True
+        assert view.evict_cell(2) is False
+        assert view.rows() == ()
+
+
+# ---------------------------------------------------------------------------
+# Wire surface
+# ---------------------------------------------------------------------------
+
+
+DIGEST = CellDigestMessage(
+    sender=_ep(1), cell=3, configuration_id=-77, membership_size=12,
+    leader="10.0.0.0:5001", fingerprint=-12345, parent_round=9,
+)
+GLOBAL_VIEW = GlobalViewMessage(
+    sender=_ep(1), parent_configuration_id=-9000, global_fingerprint=4242,
+    cells=(0, 3), epochs=(-1, -77), sizes=(4, 12),
+    leaders=("10.0.0.0:5000", "10.0.0.0:5001"), fingerprints=(1, 2),
+    parent_round=9,
+)
+
+
+class TestWire:
+    @pytest.mark.parametrize("msg", [DIGEST, GLOBAL_VIEW],
+                             ids=["digest", "global_view"])
+    def test_native_codec_roundtrip(self, msg):
+        assert codec.decode(codec.encode(7, msg)) == (7, msg)
+
+    @pytest.mark.parametrize("msg", [DIGEST, GLOBAL_VIEW],
+                             ids=["digest", "global_view"])
+    def test_grpc_roundtrip(self, msg):
+        wire = gt.to_wire_request(msg)
+        assert gt.from_wire_request(
+            MSG["RapidRequest"].FromString(wire.SerializeToString())
+        ) == msg
+
+    def test_status_response_hierarchy_fields_roundtrip(self):
+        resp = ClusterStatusResponse(
+            sender=_ep(0), membership_size=11,
+            configuration_id=-5, cell_id=2, cell_size=9,
+            parent_configuration_id=-321, global_fingerprint=654,
+            global_cells=(0, 2), global_epochs=(-5, -6),
+            global_sizes=(4, 9), global_leaders=("a:1", "b:2"),
+        )
+        wire = gt.to_wire_response(resp)
+        assert gt.from_wire_response(
+            MSG["RapidResponse"].FromString(wire.SerializeToString())
+        ) == resp
+
+    def test_flat_status_response_skips_hierarchy_fields_on_the_wire(self):
+        # proto3 zero-defaults: a flat-mode response must serialize to the
+        # exact pre-hierarchy bytes (also golden-pinned in test_profiling)
+        resp = ClusterStatusResponse(
+            sender=_ep(0), membership_size=3, configuration_id=-5
+        )
+        wire = gt.to_wire_response(resp).SerializeToString(deterministic=True)
+        hierarchy_fields = {46, 47, 48, 49, 50, 51, 52, 53}
+        seen = {
+            field.number
+            for field, _ in MSG["RapidResponse"].FromString(
+                wire
+            ).clusterStatusResponse.ListFields()
+        }
+        assert not (seen & hierarchy_fields)
+
+
+# ---------------------------------------------------------------------------
+# Plane unit semantics (fake channel, no cluster)
+# ---------------------------------------------------------------------------
+
+
+class _FakeChannel:
+    def __init__(self):
+        self.leader_sends = []  # (recipients, msg)
+        self.cell_sends = []  # (recipients, msg)
+
+    def send_to_leaders(self, leaders, msg):
+        self.leader_sends.append((tuple(leaders), msg))
+        return len(tuple(leaders))
+
+    def send_to_cell(self, members, msg):
+        self.cell_sends.append((tuple(members), msg))
+        return len(tuple(members))
+
+
+def _plane_for(members, cells=4, **kw):
+    """A plane for the member of ``members`` that leads its cell."""
+    groups = cell_members(members, cells)
+    cell, cellmates = next(iter(sorted(groups.items())))
+    leader = cell_leaders(cellmates, 1)[0]
+    chan = _FakeChannel()
+    plane = HierarchyPlane(leader, channel=chan, cells=cells, **kw)
+    plane.on_view_installed(cellmates, configuration_id=-100)
+    return plane, chan, cellmates
+
+
+class TestPlane:
+    def test_view_install_refreshes_own_row(self):
+        plane, _, cellmates = _plane_for([_ep(i) for i in range(24)])
+        own = plane.global_view.cells[plane.my_cell]
+        assert own.epoch == -100
+        assert own.size == len(cellmates)
+        assert own.leader == str(plane._my_addr)
+        assert plane.is_leader
+
+    def test_follower_does_not_advance_rounds(self):
+        members = [_ep(i) for i in range(24)]
+        groups = cell_members(members, 4)
+        cell, cellmates = next(iter(sorted(groups.items())))
+        follower = [
+            ep for ep in cellmates
+            if ep != cell_leaders(cellmates, 1)[0]
+        ][0]
+        plane = HierarchyPlane(follower, channel=_FakeChannel(), cells=4)
+        plane.on_view_installed(cellmates, configuration_id=-100)
+        assert not plane.is_leader
+        assert plane.parent_round == 0
+
+    def test_stale_digest_from_same_leader_is_gated(self):
+        plane, _, _ = _plane_for([_ep(i) for i in range(24)])
+        other = next(c for c in range(4) if c != plane.my_cell)
+        fresh = CellDigestMessage(
+            sender=_ep(400), cell=other, configuration_id=-1,
+            membership_size=6, leader="l:1", fingerprint=111, parent_round=5,
+        )
+        plane.handle_digest(fresh)
+        stale = CellDigestMessage(
+            sender=_ep(400), cell=other, configuration_id=-2,
+            membership_size=9, leader="l:1", fingerprint=222, parent_round=3,
+        )
+        plane.handle_digest(stale)
+        assert plane.global_view.cells[other].fingerprint == 111
+        # a changed leader resets the gate (deterministic failover)
+        takeover = CellDigestMessage(
+            sender=_ep(401), cell=other, configuration_id=-3,
+            membership_size=5, leader="l2:1", fingerprint=333, parent_round=0,
+        )
+        plane.handle_digest(takeover)
+        assert plane.global_view.cells[other].fingerprint == 333
+
+    def test_own_cell_row_is_never_adopted_from_the_wire(self):
+        plane, _, cellmates = _plane_for([_ep(i) for i in range(24)])
+        poison = CellDigestMessage(
+            sender=_ep(400), cell=plane.my_cell, configuration_id=-999,
+            membership_size=1, leader="evil:1", fingerprint=666,
+            parent_round=50,
+        )
+        plane.handle_digest(poison)
+        assert plane.global_view.cells[plane.my_cell].size == len(cellmates)
+
+    def test_follower_relays_digests_to_its_leader(self):
+        members = [_ep(i) for i in range(24)]
+        groups = cell_members(members, 4)
+        cell, cellmates = next(iter(sorted(groups.items())))
+        leader = cell_leaders(cellmates, 1)[0]
+        follower = [ep for ep in cellmates if ep != leader][0]
+        chan = _FakeChannel()
+        plane = HierarchyPlane(follower, channel=chan, cells=4)
+        plane.on_view_installed(cellmates, configuration_id=-100)
+        other = next(c for c in range(4) if c != cell)
+        msg = CellDigestMessage(
+            sender=_ep(400), cell=other, configuration_id=-1,
+            membership_size=6, leader="l:1", fingerprint=1, parent_round=1,
+        )
+        plane.handle_digest(msg)
+        assert chan.leader_sends == [((leader,), msg)]
+
+    def test_tick_evicts_idle_cells_and_fans_the_removal(self):
+        plane, chan, _ = _plane_for(
+            [_ep(i) for i in range(24)], eviction_rounds=3
+        )
+        other = next(c for c in range(4) if c != plane.my_cell)
+        plane.handle_digest(CellDigestMessage(
+            sender=_ep(400), cell=other, configuration_id=-1,
+            membership_size=6, leader="l:1", fingerprint=1, parent_round=1,
+        ))
+        assert other in plane.global_view.cells
+        chan.cell_sends.clear()
+        for _ in range(3):
+            plane.tick()
+        assert other not in plane.global_view.cells
+        # the eviction was fanned into the cell so followers adopt it
+        fanned = chan.cell_sends[-1][1]
+        assert isinstance(fanned, GlobalViewMessage)
+        assert other not in fanned.cells
+
+    def test_followers_adopt_evictions_via_absent_row_diff(self):
+        members = [_ep(i) for i in range(24)]
+        groups = cell_members(members, 4)
+        cell, cellmates = next(iter(sorted(groups.items())))
+        leader = cell_leaders(cellmates, 1)[0]
+        follower = [ep for ep in cellmates if ep != leader][0]
+        plane = HierarchyPlane(follower, channel=_FakeChannel(), cells=4)
+        plane.on_view_installed(cellmates, configuration_id=-100)
+        other = next(c for c in range(4) if c != cell)
+        plane.handle_digest(CellDigestMessage(
+            sender=_ep(400), cell=other, configuration_id=-1,
+            membership_size=6, leader="l:1", fingerprint=1, parent_round=1,
+        ))
+        assert other in plane.global_view.cells
+        plane.handle_global_view(GlobalViewMessage(
+            sender=leader, parent_configuration_id=1, global_fingerprint=2,
+            cells=(cell,), epochs=(-100,), sizes=(len(cellmates),),
+            leaders=(str(leader),), fingerprints=(0,), parent_round=4,
+        ))
+        assert other not in plane.global_view.cells
+
+    def test_status_fields_shape(self):
+        plane, _, cellmates = _plane_for([_ep(i) for i in range(24)])
+        fields = plane.status_fields()
+        assert fields["cell_id"] == plane.my_cell
+        assert fields["cell_size"] == len(cellmates)
+        assert fields["global_cells"] == (plane.my_cell,)
+        assert set(fields) == {
+            "cell_id", "cell_size", "parent_configuration_id",
+            "global_fingerprint", "global_cells", "global_epochs",
+            "global_sizes", "global_leaders",
+        }
+
+
+# ---------------------------------------------------------------------------
+# Cell router (broadcast confinement)
+# ---------------------------------------------------------------------------
+
+
+class _RecordingBroadcaster:
+    def __init__(self):
+        self.recipients = None
+
+    def broadcast(self, msg):
+        return []
+
+    def set_membership(self, recipients):
+        self.recipients = list(recipients)
+
+
+class TestCellRouter:
+    def test_set_membership_confines_to_own_cell(self):
+        members = [_ep(i) for i in range(40)]
+        inner = _RecordingBroadcaster()
+        me = members[0]
+        router = CellRouter(inner, me, 4)
+        router.set_membership(members)
+        mine = cell_of(me, 4)
+        assert inner.recipients == [
+            ep for ep in members if cell_of(ep, 4) == mine
+        ]
+        assert me in inner.recipients
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: the acceptance scenario on virtual time
+# ---------------------------------------------------------------------------
+
+
+def _boot_cells(h: ClusterHarness, n: int, cells: int):
+    """Bootstrap each cell as its own Rapid cluster; returns cell->indices."""
+    by_cell = defaultdict(list)
+    for i in range(n):
+        by_cell[cell_of_endpoint(h.addr(i), cells)].append(i)
+    for idxs in by_cell.values():
+        h.start_seed(idxs[0])
+        for i in idxs[1:]:
+            h.join(i, seed_index=idxs[0])
+    seed_eps = [h.addr(idxs[0]) for idxs in by_cell.values()]
+    for inst in h.instances.values():
+        inst.hierarchy.seed_parent(seed_eps)
+    return dict(by_cell)
+
+
+def _agreed(h: ClusterHarness, expected_cells) -> bool:
+    fingerprints = set()
+    for inst in h.instances.values():
+        plane = inst.hierarchy
+        if set(plane.global_view.cells) != set(expected_cells):
+            return False
+        fingerprints.add(plane.global_view.fingerprint())
+    return len(fingerprints) == 1
+
+
+class TestEngineIntegration:
+    def test_composed_view_agreement_member_kill_and_whole_cell_loss(self):
+        h = ClusterHarness(
+            seed=7, settings=_hier_settings(cells=4, parent_flush_ms=0)
+        )
+        by_cell = _boot_cells(h, 24, 4)
+        assert h.scheduler.run_until(
+            lambda: _agreed(h, by_cell), timeout_ms=600_000
+        ), "composed views never agreed after bootstrap"
+        any_plane = next(iter(h.instances.values())).hierarchy
+        assert any_plane.global_view.member_count() == 24
+
+        # single-member kill inside the largest cell: local churn, global
+        # agreement follows the cell's own digest
+        big = max(by_cell, key=lambda c: len(by_cell[c]))
+        h.fail_nodes([h.addr(by_cell[big][-1])])
+        assert h.scheduler.run_until(
+            lambda: _agreed(h, by_cell) and next(
+                iter(h.instances.values())
+            ).hierarchy.global_view.member_count() == 23,
+            timeout_ms=1_200_000,
+        ), "agreement lost after a single-member kill"
+
+        # whole-cell loss, leader included: survivors evict it in O(1)
+        # parent rounds with zero collateral evictions
+        small = min(by_cell, key=lambda c: len(by_cell[c]))
+        h.fail_nodes([h.addr(i) for i in by_cell[small]])
+        remaining = set(by_cell) - {small}
+        assert h.scheduler.run_until(
+            lambda: _agreed(h, remaining), timeout_ms=2_400_000
+        ), "whole-cell loss never evicted from the composed view"
+        for c in remaining:
+            alive = [i for i in by_cell[c] if h.addr(i) in h.instances]
+            for i in alive:
+                assert len(
+                    h.instances[h.addr(i)].get_memberlist()
+                ) == len(alive), "collateral eviction in a surviving cell"
+
+    def test_leader_failover_is_a_non_event(self):
+        h = ClusterHarness(
+            seed=11, settings=_hier_settings(cells=3, parent_flush_ms=0)
+        )
+        by_cell = _boot_cells(h, 18, 3)
+        assert h.scheduler.run_until(
+            lambda: _agreed(h, by_cell), timeout_ms=600_000
+        )
+        # kill the rank-0 leader of the largest cell
+        big = max(by_cell, key=lambda c: len(by_cell[c]))
+        cellmates = [h.addr(i) for i in by_cell[big]]
+        old_leader = cell_leaders(cellmates, 1)[0]
+        survivors = [ep for ep in cellmates if ep != old_leader]
+        new_leader = cell_leaders(survivors, 1)[0]
+        h.fail_nodes([old_leader])
+
+        def failed_over():
+            if not _agreed(h, by_cell):
+                return False
+            for inst in h.instances.values():
+                row = inst.hierarchy.global_view.cells[big]
+                if row.leader != str(new_leader) or row.size != len(survivors):
+                    return False
+            return True
+
+        assert h.scheduler.run_until(failed_over, timeout_ms=1_200_000), (
+            "leader failover did not converge to the next deterministic "
+            "leader"
+        )
+        # no other cell saw churn
+        for c, idxs in by_cell.items():
+            if c == big:
+                continue
+            for i in idxs:
+                assert len(
+                    h.instances[h.addr(i)].get_memberlist()
+                ) == len(idxs)
+
+    def test_kill_switch_off_has_no_plane(self):
+        h = ClusterHarness(seed=3)
+        h.start_seed(0)
+        inst = h.instances[h.addr(0)]
+        assert inst.hierarchy is None
+        status = inst.get_cluster_status()
+        assert status.cell_id == 0
+        assert status.global_cells == ()
+
+
+# ---------------------------------------------------------------------------
+# statusz: hierarchy digest rendering + composed-fingerprint disagreement
+# ---------------------------------------------------------------------------
+
+
+def _load_statusz():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "statusz", os.path.join(os.path.dirname(__file__), "..", "tools",
+                                "statusz.py")
+    )
+    statusz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(statusz)
+    return statusz
+
+
+def test_statusz_flags_global_fingerprint_disagreement(monkeypatch, capsys):
+    """tools/statusz.py renders the per-member hierarchy digest (cell id,
+    cell size, parent configuration id), exports the composed view in
+    JSON, and exits 2 when hierarchy-enabled members disagree on the
+    composed global-view fingerprint."""
+    statusz = _load_statusz()
+    a = ClusterStatusResponse(
+        sender=Endpoint.from_parts("h", 1), configuration_id=5,
+        membership_size=4, cell_id=1, cell_size=2,
+        parent_configuration_id=777, global_fingerprint=4242,
+        global_cells=(0, 1), global_epochs=(10, 11),
+        global_sizes=(2, 2), global_leaders=("h:1", "h:3"),
+    )
+    text = statusz.render(a)
+    assert ("hierarchy: cell=1 cell-size=2 parent-config=777"
+            " cells=2 members=4 fingerprint=4242") in text
+    blob = statusz.to_json(a)
+    assert blob["hierarchy"]["parent_configuration_id"] == 777
+    assert blob["hierarchy"]["cells"]["1"] == {
+        "epoch": 11, "size": 2, "leader": "h:3",
+    }
+    # flat members render no hierarchy line and export None
+    bare = ClusterStatusResponse(
+        sender=Endpoint.from_parts("h", 9), configuration_id=5,
+        membership_size=4,
+    )
+    assert "hierarchy:" not in statusz.render(bare)
+    assert statusz.to_json(bare)["hierarchy"] is None
+
+    diverged = ClusterStatusResponse(
+        sender=Endpoint.from_parts("h", 2), configuration_id=5,
+        membership_size=4, cell_id=0, cell_size=2,
+        parent_configuration_id=777, global_fingerprint=9999,
+        global_cells=(0, 1), global_epochs=(10, 12),
+        global_sizes=(2, 2), global_leaders=("h:1", "h:3"),
+    )
+    replies = {"h1:1": a, "h2:2": diverged}
+    monkeypatch.setattr(
+        statusz, "fetch_status",
+        lambda client, target, timeout: replies[
+            f"{target.hostname.decode()}:{target.port}"
+        ],
+    )
+    rc = statusz.main(["h1:1", "h2:2"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "composed global view" in err
+
+    # agreement (or one flat member among hierarchical ones) is clean
+    replies["h2:2"] = a
+    assert statusz.main(["h1:1", "h2:2"]) == 0
+    replies["h2:2"] = bare
+    assert statusz.main(["h1:1", "h2:2"]) == 0
+
+
+def test_statusz_config_disagreement_is_cell_scoped(monkeypatch, capsys):
+    """In hierarchical mode each cell is its own Rapid cluster, so members
+    of different cells legitimately carry different cell-local config ids
+    -- statusz must only flag disagreement *within* one cell (and keep the
+    flat check for members without a hierarchy digest)."""
+    statusz = _load_statusz()
+
+    def member(port, config_id, cell=None):
+        kw = {}
+        if cell is not None:
+            kw = dict(cell_id=cell, cell_size=1, parent_configuration_id=7,
+                      global_fingerprint=4242, global_cells=(0, 1),
+                      global_epochs=(1, 2), global_sizes=(1, 1),
+                      global_leaders=("h:1", "h:2"))
+        return ClusterStatusResponse(
+            sender=Endpoint.from_parts("h", port),
+            configuration_id=config_id, membership_size=1, **kw)
+
+    replies = {}
+    monkeypatch.setattr(
+        statusz, "fetch_status",
+        lambda client, target, timeout: replies[
+            f"{target.hostname.decode()}:{target.port}"
+        ],
+    )
+    # cross-cell config divergence with an agreeing composed view: clean
+    replies = {"h:1": member(1, 100, cell=0), "h:2": member(2, 200, cell=1)}
+    assert statusz.main(["h:1", "h:2"]) == 0
+    # same-cell divergence: rc 2, named by cell
+    replies = {"h:1": member(1, 100, cell=0), "h:2": member(2, 200, cell=0)}
+    assert statusz.main(["h:1", "h:2"]) == 2
+    assert "cell 0 configuration id" in capsys.readouterr().err
+    # flat members keep the pre-hierarchy check and message
+    replies = {"h:1": member(1, 100), "h:2": member(2, 200)}
+    assert statusz.main(["h:1", "h:2"]) == 2
+    assert "disagree on configuration id" in capsys.readouterr().err

@@ -35,6 +35,7 @@ import numpy as np
 import pytest
 
 from golden.scrape_fixtures import (
+    HIERARCHY_RESPONSE,
     HISTORY_LINES,
     HLC_RESPONSE,
     SCRAPE_REQUEST,
@@ -387,6 +388,17 @@ def test_scrape_grpc_bytes_golden():
     assert parsed.hlc_incarnation == 2
     assert parsed.journal_dropped == 6
 
+    # the hierarchy digest (cell coordinates + composed global view,
+    # fields 46-53) rides the same response
+    wire = gt.to_wire_response(HIERARCHY_RESPONSE).SerializeToString(
+        deterministic=True
+    )
+    assert wire.hex() == GOLDEN["grpc"]["ClusterStatusResponse_hierarchy"]
+    parsed = gt.from_wire_response(MSG["RapidResponse"].FromString(wire))
+    assert parsed == HIERARCHY_RESPONSE
+    assert parsed.cell_id == 1
+    assert parsed.global_cells == (0, 1)
+
 
 def test_pre_profiling_frames_parse_to_defaults():
     """Rolling upgrade both ways: an old peer's frame (no scrape fields)
@@ -687,6 +699,11 @@ def _check_artifact() -> dict:
         "gray_detection_ms": {
             "gray_slow_node": {"speedup": 4.2},
             "gray_flapping": {"speedup": 2.4},
+        },
+        "hierarchy_scale": {
+            "member_ceiling_ratio": 10.0,
+            "agreement_virtual_ms": 2200.0,
+            "hierarchical": {"parent_rounds": 3},
         },
     }
 

@@ -662,6 +662,7 @@ SETTINGS_GROUPS = {
     "durability": "DurabilitySettings",
     "slo": "SLOSettings",
     "forensics": "ForensicsSettings",
+    "hierarchy": "HierarchySettings",
 }
 
 

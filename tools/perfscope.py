@@ -220,8 +220,20 @@ def _bench_line(text: str) -> Optional[dict]:
 
 def load_bench_artifact(path: str) -> dict:
     """The bench's single JSON line (tolerating surrounding log lines: the
-    first line that parses as a dict with a 'metric' key wins)."""
-    doc = _bench_line(open(path).read())
+    first line that parses as a dict with a 'metric' key wins). Also
+    accepts the repo's BENCH_rNN.json run wrapper, unwrapping its
+    "parsed" artifact (or re-scanning its "tail" for older wrappers)."""
+    text = open(path).read()
+    try:
+        whole = json.loads(text)
+    except ValueError:
+        whole = None
+    if isinstance(whole, dict) and "rc" in whole and "tail" in whole:
+        doc = whole.get("parsed")
+        if not isinstance(doc, dict):
+            doc = _bench_line(str(whole.get("tail", "")))
+    else:
+        doc = _bench_line(text)
     if doc is None:
         raise ValueError(f"{path}: no bench JSON artifact line found")
     return doc
@@ -365,6 +377,15 @@ DIMENSION_BUDGETS: Tuple[Tuple[str, Tuple[str, ...], str, float], ...] = (
                    "messages_per_s"), ">=", 1.0),
     ("gray", ("gray_detection_ms", "gray_slow_node", "speedup"), ">=", 2.0),
     ("gray", ("gray_detection_ms", "gray_flapping", "speedup"), ">=", 2.0),
+    # hierarchy dimension: the flat-vs-hierarchical A/B must seat >= 10x
+    # the flat anchor's members, reach composed agreement within a loose
+    # protocol-time ceiling (FD detection dominates at ~10-11s virtual;
+    # the ceiling catches a detection/agreement blowup, not jitter), and
+    # bill at least one parent round doing it
+    ("hierarchy", ("hierarchy_scale", "member_ceiling_ratio"), ">=", 10.0),
+    ("hierarchy", ("hierarchy_scale", "agreement_virtual_ms"), "<=", 15000.0),
+    ("hierarchy", ("hierarchy_scale", "hierarchical", "parent_rounds"),
+     ">=", 1.0),
 )
 
 _BUDGET_OPS = {

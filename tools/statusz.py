@@ -59,6 +59,18 @@ def render(status: ClusterStatusResponse, journal_lines: int = 5) -> str:
         f"  consensus: decided={status.consensus_decided}"
         f" votes={status.consensus_votes}",
     ]
+    # hierarchy digest: which cell this member sits in, its parent
+    # configuration, and the composed global view it has adopted
+    # (hierarchy on <=> global_cells is non-empty)
+    if status.global_cells:
+        lines.append(
+            f"  hierarchy: cell={status.cell_id}"
+            f" cell-size={status.cell_size}"
+            f" parent-config={status.parent_configuration_id}"
+            f" cells={len(status.global_cells)}"
+            f" members={sum(status.global_sizes)}"
+            f" fingerprint={status.global_fingerprint}"
+        )
     if status.placement_partitions:
         lines.append(
             f"  placement: version={status.placement_version}"
@@ -178,6 +190,19 @@ def to_json(status: ClusterStatusResponse) -> dict:
         "updates_in_progress": status.updates_in_progress,
         "consensus_decided": status.consensus_decided,
         "consensus_votes": status.consensus_votes,
+        "hierarchy": {
+            "cell_id": status.cell_id,
+            "cell_size": status.cell_size,
+            "parent_configuration_id": status.parent_configuration_id,
+            "global_fingerprint": status.global_fingerprint,
+            "cells": {
+                str(cell): {"epoch": epoch, "size": size, "leader": leader}
+                for cell, epoch, size, leader in zip(
+                    status.global_cells, status.global_epochs,
+                    status.global_sizes, status.global_leaders,
+                )
+            },
+        } if status.global_cells else None,
         "placement_version": status.placement_version,
         "placement_partitions": status.placement_partitions,
         "placement_owned": status.placement_owned,
@@ -280,8 +305,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     # client half only: no start() means no listening socket is ever bound
     client = TcpClientServer(Endpoint(b"127.0.0.1", 0), Settings())
     rc = 0
-    configs = set()
+    # cell id (None = flat member) -> configuration ids seen there. In
+    # hierarchical mode each cell is its own Rapid cluster, so members of
+    # *different* cells legitimately carry different (cell-local) config
+    # ids -- disagreement only means trouble within one cell.
+    configs: dict = {}
     placements = set()
+    # composed global-view fingerprints from hierarchy-enabled members
+    hier_fps = set()
     # partition id -> set of content fingerprints reported by its holders
     fingerprints: dict = {}
     # partition id -> set of serving leaders reported by its replicas
@@ -306,9 +337,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rc = 1
                 continue
             statuses.append(status)
-            configs.add(status.configuration_id)
+            cell_key = status.cell_id if status.global_cells else None
+            configs.setdefault(cell_key, set()).add(status.configuration_id)
             if status.placement_partitions:
                 placements.add(status.placement_version)
+            if status.global_cells:
+                hier_fps.add(status.global_fingerprint)
             for part, fp in zip(
                 status.handoff_partitions, status.handoff_fingerprints
             ):
@@ -325,9 +359,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(render_timeseries(statuses))
     finally:
         client.shutdown()
-    if len(configs) > 1:
+    for cell_key in sorted(configs, key=lambda k: (k is not None, k or 0)):
+        ids = configs[cell_key]
+        if len(ids) <= 1:
+            continue
+        scope = ("configuration id" if cell_key is None
+                 else f"cell {cell_key} configuration id")
         print(
-            f"WARNING: members disagree on configuration id: {sorted(configs)}",
+            f"WARNING: members disagree on {scope}: {sorted(ids)}",
+            file=sys.stderr,
+        )
+        rc = max(rc, 2)
+    # the composed global view folds every cell's (epoch, size, leader,
+    # membership) into one integer, so fingerprint disagreement among
+    # hierarchy-enabled members is the cross-cell analogue of config-id
+    # disagreement: somebody has not adopted the parent decision
+    if len(hier_fps) > 1:
+        print(
+            "WARNING: members disagree on the composed global view "
+            f"fingerprint: {sorted(hier_fps)}",
             file=sys.stderr,
         )
         rc = max(rc, 2)
